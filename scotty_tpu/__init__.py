@@ -1,0 +1,64 @@
+"""scotty_tpu — a TPU-native stream window aggregation framework.
+
+A from-scratch JAX/XLA re-design of the general stream slicing technique
+(reference: the Scotty window processor, a JVM library — see SURVEY.md): the
+stream is cut into non-overlapping slices, each slice holds one partial
+aggregate per registered aggregation, and every concurrent window (tumbling /
+sliding / session / fixed-band, time- or count-measured, thousands at once)
+is answered by merging the partial aggregates of the slices it covers.
+
+Architecture (TPU-first, not a port):
+
+* ``core``      — window taxonomy + aggregation algebra (lift/combine/lower),
+                  with vectorized faces for the device engine.
+* ``state``     — pluggable state cells for the host path (checkpoint seam).
+* ``simulator`` — full-fidelity host operator: correctness oracle and general
+                  fallback, exact reference semantics.
+* ``engine``    — the TPU path: slice ring buffers in HBM, batched ingest via
+                  segment reductions, watermark triggering via closed-form
+                  window enumeration + prefix-sum range queries.
+* ``parallel``  — keys as a batch dimension; multi-chip scaling via
+                  ``jax.sharding.Mesh`` + ``shard_map`` (keys are
+                  embarrassingly parallel, exactly like the reference's
+                  per-key operator partitioning).
+* ``connectors``— thin adapters from host stream sources to the operator API.
+* ``bench``     — config-driven throughput harness (JSON configs mirroring
+                  the reference benchmark module).
+"""
+
+from .core import (
+    AggregateFunction,
+    AggregateWindow,
+    CountAggregation,
+    DDSketchQuantileAggregation,
+    FixedBandWindow,
+    HyperLogLogAggregation,
+    InvertibleReduceAggregateFunction,
+    MaxAggregation,
+    MeanAggregation,
+    MinAggregation,
+    QuantileAggregation,
+    ReduceAggregateFunction,
+    SessionWindow,
+    SlidingWindow,
+    SumAggregation,
+    TimeMeasure,
+    TumblingWindow,
+    Window,
+    WindowMeasure,
+    WindowOperator,
+)
+from .simulator import SlicingWindowOperator
+from .state import MemoryStateFactory, StateFactory
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AggregateFunction", "AggregateWindow", "CountAggregation",
+    "DDSketchQuantileAggregation", "FixedBandWindow", "HyperLogLogAggregation",
+    "InvertibleReduceAggregateFunction", "MaxAggregation", "MeanAggregation",
+    "MinAggregation", "QuantileAggregation", "ReduceAggregateFunction",
+    "SessionWindow", "SlidingWindow", "SumAggregation", "TimeMeasure",
+    "TumblingWindow", "Window", "WindowMeasure", "WindowOperator",
+    "SlicingWindowOperator", "MemoryStateFactory", "StateFactory",
+]

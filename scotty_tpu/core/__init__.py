@@ -1,0 +1,54 @@
+"""L1 core: window taxonomy, aggregation algebra, operator contracts.
+
+Parity layer for the reference's ``core/`` module (SURVEY.md §2.1)."""
+
+from .windows import (
+    Window,
+    WindowMeasure,
+    TIME,
+    COUNT,
+    ContextFreeWindow,
+    ForwardContextAware,
+    ForwardContextFree,
+    TumblingWindow,
+    SlidingWindow,
+    SessionWindow,
+    FixedBandWindow,
+    WindowContext,
+    ActiveWindow,
+    AddModification,
+    DeleteModification,
+    ShiftModification,
+)
+from .aggregates import (
+    AggregateFunction,
+    ReduceAggregateFunction,
+    InvertibleReduceAggregateFunction,
+    DeviceAggregateSpec,
+    SumAggregation,
+    CountAggregation,
+    MinAggregation,
+    MaxAggregation,
+    MeanAggregation,
+    QuantileAggregation,
+    DDSketchQuantileAggregation,
+    HyperLogLogAggregation,
+    BUILTIN_AGGREGATIONS,
+)
+from .operator import AggregateWindow, WindowCollector, WindowOperator
+from .time_measure import TimeMeasure
+
+__all__ = [
+    "Window", "WindowMeasure", "TIME", "COUNT",
+    "ContextFreeWindow", "ForwardContextAware", "ForwardContextFree",
+    "TumblingWindow", "SlidingWindow", "SessionWindow", "FixedBandWindow",
+    "WindowContext", "ActiveWindow",
+    "AddModification", "DeleteModification", "ShiftModification",
+    "AggregateFunction", "ReduceAggregateFunction",
+    "InvertibleReduceAggregateFunction", "DeviceAggregateSpec",
+    "SumAggregation", "CountAggregation", "MinAggregation", "MaxAggregation",
+    "MeanAggregation", "QuantileAggregation", "DDSketchQuantileAggregation",
+    "HyperLogLogAggregation", "BUILTIN_AGGREGATIONS",
+    "AggregateWindow", "WindowCollector", "WindowOperator",
+    "TimeMeasure",
+]

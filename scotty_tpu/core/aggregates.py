@@ -1,0 +1,531 @@
+"""Aggregation algebra: lift / combine / lower (+ invert / clone).
+
+Re-design of the reference's ``core/windowFunction`` package
+(core/.../windowFunction/AggregateFunction.java:6-58,
+InvertibleAggregateFunction.java:3-16, ReduceAggregateFunction.java:4-16,
+CloneablePartialStateFunction.java:3-12) plus the example aggregations the
+reference ships in its demo/benchmark modules (Sum/Min/Max/Count/Mean/Quantile,
+demo/flink-demo/.../windowFunctions/*.java).
+
+Every aggregate has two faces:
+
+* the *scalar* face (``lift``/``combine``/``lower``) used by the host-side
+  reference-semantics operator — works on arbitrary Python values, supports
+  holistic aggregates with unbounded partials (exact quantiles);
+* the *device* face (:class:`DeviceAggregateSpec`) used by the TPU engine —
+  fixed-width array partials combined with one of the XLA-friendly segment
+  primitives (``sum`` / ``min`` / ``max``), which is what lets thousands of
+  slices fold in one fused kernel. Holistic aggregates map to fixed-width
+  mergeable sketches (DDSketch histogram for quantiles, HyperLogLog registers
+  for distinct counts) because unbounded tree partials are not
+  XLA-representable — see SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Device face
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DeviceAggregateSpec:
+    """How the TPU engine realizes one aggregation over slice partials.
+
+    ``kind`` is the segment-combine primitive ('sum' | 'min' | 'max').
+    ``width`` is the fixed partial width per slice.
+
+    Two lift modes:
+
+    * dense: ``lift_dense(values) -> [B, width]`` array (sum/min/max/mean);
+    * sparse: ``lift_sparse(values) -> (col[B], val[B])`` — each tuple touches
+      exactly one of the ``width`` columns (sketches: one histogram bucket /
+      one HLL register per tuple), so ingest stays O(B) instead of O(B*width).
+
+    ``lower(partials[N, width], counts[N]) -> [N]`` produces final values.
+    ``identity`` is the combine-neutral element used for empty slices.
+    """
+
+    kind: str
+    width: int
+    identity: float
+    lower: Callable[[np.ndarray, np.ndarray], np.ndarray]
+    lift_dense: Callable[[Any], Any] | None = None
+    lift_sparse: Callable[[Any], tuple] | None = None
+    dtype: Any = np.float32
+
+    @property
+    def is_sparse(self) -> bool:
+        return self.lift_sparse is not None
+
+
+# ---------------------------------------------------------------------------
+# Scalar face
+# ---------------------------------------------------------------------------
+
+
+class AggregateFunction:
+    """lift/combine/lower algebra (AggregateFunction.java:6-58).
+
+    ``combine`` must be associative; that associativity is the license for the
+    engine to fold slice partials in any grouping (tree reductions, prefix
+    scans) instead of the reference's left-to-right loop.
+    """
+
+    #: True → supports ``invert`` (InvertibleAggregateFunction.java:3-16),
+    #: enabling O(1) removal instead of slice recompute on out-of-order repair.
+    invertible: bool = False
+
+    def lift(self, value):
+        raise NotImplementedError
+
+    def combine(self, a, b):
+        raise NotImplementedError
+
+    def lower(self, partial):
+        raise NotImplementedError
+
+    def lift_and_combine(self, partial, value):
+        # AggregateFunction.java:44-47 default
+        return self.combine(partial, self.lift(value))
+
+    def invert(self, current, to_remove):
+        raise NotImplementedError(f"{type(self).__name__} is not invertible")
+
+    def lift_and_invert(self, partial, value):
+        # InvertibleAggregateFunction.java default
+        return self.invert(partial, self.lift(value))
+
+    def clone_partial(self, partial):
+        """CloneablePartialStateFunction.java:3-12 — copy hook so merging a
+        shared slice partial into a window result can't alias mutable state.
+        Immutable partials return themselves."""
+        return partial
+
+    def device_spec(self) -> DeviceAggregateSpec | None:
+        """Fixed-width device realization, or None if host-only."""
+        return None
+
+
+class ReduceAggregateFunction(AggregateFunction):
+    """In == Partial == Final; lift/lower are identity
+    (ReduceAggregateFunction.java:4-16). Lambda-friendly:
+
+    >>> op.add_aggregation(ReduceAggregateFunction(lambda a, b: a + b))
+    """
+
+    def __init__(self, fn: Callable[[Any, Any], Any], invert_fn: Callable | None = None):
+        self.fn = fn
+        self.invert_fn = invert_fn
+        self.invertible = invert_fn is not None
+
+    def lift(self, value):
+        return value
+
+    def combine(self, a, b):
+        return self.fn(a, b)
+
+    def lower(self, partial):
+        return partial
+
+    def invert(self, current, to_remove):
+        if self.invert_fn is None:
+            raise NotImplementedError("no invert_fn provided")
+        return self.invert_fn(current, to_remove)
+
+
+class InvertibleReduceAggregateFunction(ReduceAggregateFunction):
+    """Marker parity with InvertibleReduceAggregateFunction.java:3-6."""
+
+    def __init__(self, fn, invert_fn):
+        super().__init__(fn, invert_fn)
+
+
+# ---------------------------------------------------------------------------
+# Built-in aggregations (reference demo windowFunctions/ + benchmark SumAggregation)
+# ---------------------------------------------------------------------------
+
+
+class SumAggregation(AggregateFunction):
+    """Invertible sum (benchmark/.../aggregations/SumAggregation.java:8-19)."""
+
+    invertible = True
+
+    def lift(self, value):
+        return value
+
+    def combine(self, a, b):
+        return a + b
+
+    def lower(self, partial):
+        return partial
+
+    def invert(self, current, to_remove):
+        return current - to_remove
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        return DeviceAggregateSpec(
+            kind="sum",
+            width=1,
+            identity=0.0,
+            lift_dense=lambda v: v.reshape(-1, 1),
+            lower=lambda p, c: p[:, 0],
+        )
+
+
+class CountAggregation(AggregateFunction):
+    """Tuple count (demo windowFunctions Count)."""
+
+    invertible = True
+
+    def lift(self, value):
+        return 1
+
+    def combine(self, a, b):
+        return a + b
+
+    def lower(self, partial):
+        return partial
+
+    def invert(self, current, to_remove):
+        return current - to_remove
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        import jax.numpy as jnp
+
+        return DeviceAggregateSpec(
+            kind="sum",
+            width=1,
+            identity=0.0,
+            lift_dense=lambda v: jnp.ones((v.shape[0], 1), dtype=jnp.float32),
+            lower=lambda p, c: p[:, 0],
+        )
+
+
+class MinAggregation(AggregateFunction):
+    """Minimum (demo windowFunctions Min)."""
+
+    def lift(self, value):
+        return value
+
+    def combine(self, a, b):
+        return a if a <= b else b
+
+    def lower(self, partial):
+        return partial
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        return DeviceAggregateSpec(
+            kind="min",
+            width=1,
+            identity=float("inf"),
+            lift_dense=lambda v: v.reshape(-1, 1),
+            lower=lambda p, c: p[:, 0],
+        )
+
+
+class MaxAggregation(AggregateFunction):
+    """Maximum (demo windowFunctions Max)."""
+
+    def lift(self, value):
+        return value
+
+    def combine(self, a, b):
+        return a if a >= b else b
+
+    def lower(self, partial):
+        return partial
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        return DeviceAggregateSpec(
+            kind="max",
+            width=1,
+            identity=-float("inf"),
+            lift_dense=lambda v: v.reshape(-1, 1),
+            lower=lambda p, c: p[:, 0],
+        )
+
+
+class MeanAggregation(AggregateFunction):
+    """Arithmetic mean with (sum, count) partial (demo windowFunctions Mean)."""
+
+    invertible = True
+
+    def lift(self, value):
+        return (value, 1)
+
+    def combine(self, a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def lower(self, partial):
+        s, c = partial
+        return s / c if c else None
+
+    def invert(self, current, to_remove):
+        return (current[0] - to_remove[0], current[1] - to_remove[1])
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        import jax.numpy as jnp
+
+        return DeviceAggregateSpec(
+            kind="sum",
+            width=2,
+            identity=0.0,
+            lift_dense=lambda v: jnp.stack([v, jnp.ones_like(v)], axis=-1),
+            lower=lambda p, c: p[:, 0] / np.maximum(p[:, 1], 1.0),
+        )
+
+
+class QuantileAggregation(AggregateFunction):
+    """Exact quantile — holistic aggregate with an unbounded sorted-list
+    partial, mirroring the reference's QuantileTreeMap demo aggregate
+    (demo/flink-demo/.../windowFunctions/QuantileTreeMap.java:6-90,
+    QuantileWindowFunction.java:98-135). Host-only: the device realization is
+    :class:`DDSketchQuantileAggregation`.
+
+    The partial is mutable (a list), so ``clone_partial`` copies it — same
+    contract as CloneablePartialStateFunction.
+    """
+
+    def __init__(self, quantile: float):
+        assert 0.0 <= quantile <= 1.0
+        self.quantile = quantile
+
+    def lift(self, value):
+        return [value]
+
+    def combine(self, a, b):
+        # merge two sorted lists; 'a' may be a shared slice partial → do not
+        # mutate either input (AggregateValueState.java:55-69 merge contract).
+        merged = list(a)
+        for v in b:
+            bisect.insort(merged, v)
+        return merged
+
+    def lift_and_combine(self, partial, value):
+        bisect.insort(partial, value)
+        return partial
+
+    def lower(self, partial):
+        if not partial:
+            return None
+        idx = min(len(partial) - 1, int(self.quantile * len(partial)))
+        return partial[idx]
+
+    def clone_partial(self, partial):
+        return list(partial)
+
+
+class DDSketchQuantileAggregation(AggregateFunction):
+    """Fixed-width mergeable quantile sketch (DDSketch-style log-bucketed
+    histogram). The device substitute for the reference's unbounded
+    QuantileTreeMap (SURVEY.md §7: sketching is the capability-preserving
+    substitute for holistic aggregates under XLA's static shapes).
+
+    Partial = [n_buckets] bucket counts (+ bucket 0 reserved for zero /
+    non-positive values); combine = elementwise add → additive, so window
+    merges ride the same prefix-sum path as sums. Relative error is bounded
+    by ``alpha``.
+    """
+
+    def __init__(self, quantile: float, alpha: float = 0.01, n_buckets: int = 512,
+                 min_value: float = 1e-9):
+        self.quantile = quantile
+        self.alpha = alpha
+        self.n_buckets = n_buckets
+        self.gamma = (1 + alpha) / (1 - alpha)
+        self.log_gamma = math.log(self.gamma)
+        self.min_value = min_value
+
+    # -- scalar face (also the oracle for the device sketch) ---------------
+    def _bucket(self, value) -> int:
+        if value <= self.min_value:
+            return 0
+        b = int(math.ceil(math.log(value / self.min_value) / self.log_gamma)) + 1
+        return min(b, self.n_buckets - 1)
+
+    def lift(self, value):
+        counts = [0] * self.n_buckets
+        counts[self._bucket(value)] = 1
+        return counts
+
+    def lift_and_combine(self, partial, value):
+        partial = list(partial)
+        partial[self._bucket(value)] += 1
+        return partial
+
+    def combine(self, a, b):
+        return [x + y for x, y in zip(a, b)]
+
+    def lower(self, partial):
+        total = sum(partial)
+        if total == 0:
+            return None
+        rank = self.quantile * (total - 1)
+        acc = 0
+        for b, cnt in enumerate(partial):
+            acc += cnt
+            if acc > rank:
+                if b == 0:
+                    return 0.0
+                # bucket b covers (min*gamma^(b-2), min*gamma^(b-1)]; midpoint
+                upper = self.min_value * self.gamma ** (b - 1)
+                return 2.0 * upper / (1.0 + self.gamma)
+        return None
+
+    def clone_partial(self, partial):
+        return list(partial)
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        import jax.numpy as jnp
+
+        log_gamma = self.log_gamma
+        min_value = self.min_value
+        n_buckets = self.n_buckets
+        q = self.quantile
+        gamma = self.gamma
+
+        def lift_sparse(v):
+            pos = v > min_value
+            b = jnp.ceil(jnp.log(jnp.maximum(v, min_value) / min_value) / log_gamma) + 1
+            col = jnp.where(pos, jnp.minimum(b, n_buckets - 1), 0).astype(jnp.int32)
+            return col, jnp.ones_like(v, dtype=jnp.float32)
+
+        def lower(partials: np.ndarray, counts: np.ndarray) -> np.ndarray:
+            # partials: [N, n_buckets] bucket counts
+            total = partials.sum(axis=-1)
+            rank = q * np.maximum(total - 1, 0)
+            cum = np.cumsum(partials, axis=-1)
+            b = np.argmax(cum > rank[..., None], axis=-1)
+            upper = min_value * gamma ** (b - 1)
+            vals = np.where(b == 0, 0.0, 2.0 * upper / (1.0 + gamma))
+            return np.where(total > 0, vals, np.nan)
+
+        return DeviceAggregateSpec(
+            kind="sum",
+            width=self.n_buckets,
+            identity=0.0,
+            lift_sparse=lift_sparse,
+            lower=lower,
+        )
+
+
+class HyperLogLogAggregation(AggregateFunction):
+    """HyperLogLog distinct count with 2**p registers; combine = register-wise
+    max → rides the engine's segment-max path. Fixed-width substitute for a
+    distinct-count holistic aggregate (BASELINE.json config 5)."""
+
+    def __init__(self, p: int = 8):
+        assert 4 <= p <= 14
+        self.p = p
+        self.m = 1 << p
+        if self.m >= 128:
+            self.alpha = 0.7213 / (1.0 + 1.079 / self.m)
+        elif self.m == 64:
+            self.alpha = 0.709
+        elif self.m == 32:
+            self.alpha = 0.697
+        else:
+            self.alpha = 0.673
+
+    @staticmethod
+    def _hash64(x: np.ndarray) -> np.ndarray:
+        """splitmix64 finalizer — deterministic 64-bit avalanche hash."""
+        z = np.asarray(x, dtype=np.uint64)
+        z = (z + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        return z ^ (z >> np.uint64(31))
+
+    def _register_and_rho(self, value):
+        h = int(self._hash64(np.uint64(np.int64(hash(value)) & 0xFFFFFFFFFFFFFFFF)))
+        reg = h & (self.m - 1)
+        rest = h >> self.p
+        # rho = leading position of first 1 bit in the remaining 64-p bits
+        rho = (64 - self.p) - rest.bit_length() + 1
+        return reg, rho
+
+    def lift(self, value):
+        regs = [0] * self.m
+        reg, rho = self._register_and_rho(value)
+        regs[reg] = rho
+        return regs
+
+    def lift_and_combine(self, partial, value):
+        partial = list(partial)
+        reg, rho = self._register_and_rho(value)
+        partial[reg] = max(partial[reg], rho)
+        return partial
+
+    def combine(self, a, b):
+        return [max(x, y) for x, y in zip(a, b)]
+
+    def clone_partial(self, partial):
+        return list(partial)
+
+    def _estimate(self, regs: np.ndarray) -> np.ndarray:
+        regs = np.asarray(regs, dtype=np.float64)
+        raw = self.alpha * self.m * self.m / np.sum(2.0 ** (-regs), axis=-1)
+        zeros = np.sum(regs == 0, axis=-1)
+        # small-range correction (linear counting)
+        with np.errstate(divide="ignore"):
+            lc = self.m * np.log(np.where(zeros > 0, self.m / np.maximum(zeros, 1), 1.0))
+        return np.where((raw <= 2.5 * self.m) & (zeros > 0), lc, raw)
+
+    def lower(self, partial):
+        return float(self._estimate(np.asarray(partial)))
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        import jax.numpy as jnp
+
+        p, m = self.p, self.m
+
+        def lift_sparse(v):
+            # hash the value bits on device (splitmix-style in 2x32-bit lanes)
+            x = v.astype(jnp.float32).view(jnp.int32).astype(jnp.uint32)
+            x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+            x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+            x = x ^ (x >> 16)
+            y = (x ^ jnp.uint32(0x9E3779B9)) * jnp.uint32(0x85EBCA6B)
+            y = y ^ (y >> 13)
+            reg = (x & jnp.uint32(m - 1)).astype(jnp.int32)
+            rest = y >> jnp.uint32(p)
+            # rho: position of first set bit from MSB side of (32-p) bits
+            nbits = 32 - p
+            hi = jnp.where(rest == 0, jnp.int32(0),
+                           jnp.floor(jnp.log2(rest.astype(jnp.float32) + 0.5)).astype(jnp.int32) + 1)
+            rho = (nbits - hi + 1).astype(jnp.float32)
+            return reg, rho
+
+        est = self._estimate
+
+        def lower(partials: np.ndarray, counts: np.ndarray) -> np.ndarray:
+            return est(np.maximum(partials, 0.0)).astype(np.float64)
+
+        return DeviceAggregateSpec(
+            kind="max",
+            width=self.m,
+            identity=0.0,
+            lift_sparse=lift_sparse,
+            lower=lower,
+        )
+
+
+BUILTIN_AGGREGATIONS = {
+    "sum": SumAggregation,
+    "count": CountAggregation,
+    "min": MinAggregation,
+    "max": MaxAggregation,
+    "mean": MeanAggregation,
+    "quantile": QuantileAggregation,
+    "ddsketch": DDSketchQuantileAggregation,
+    "hll": HyperLogLogAggregation,
+}

@@ -1,0 +1,101 @@
+"""Operator and result contracts.
+
+Parity with the reference L1 API:
+* ``WindowOperator`` — core/.../WindowOperator.java:9-37
+* ``AggregateWindow`` — core/.../AggregateWindow.java:8-21
+* ``WindowCollector`` — core/.../WindowCollector.java:5-8
+
+The TPU framework adds a batched entry point ``process_elements`` (arrays of
+values + timestamps) because per-tuple Python calls cannot feed an
+accelerator; ``process_element`` remains for API parity and tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from .windows import Window, WindowMeasure
+from .aggregates import AggregateFunction
+
+
+class AggregateWindow:
+    """An emitted window result (AggregateWindow.java:8-21 +
+    AggregateWindowState.java result semantics): measure, [start, end) bounds
+    and one aggregate value per registered aggregation that produced one."""
+
+    __slots__ = ("measure", "start", "end", "agg_values", "_has_value")
+
+    def __init__(self, measure: WindowMeasure, start: int, end: int,
+                 agg_values: Sequence[Any], has_value: bool):
+        self.measure = measure
+        self.start = start
+        self.end = end
+        self.agg_values = list(agg_values)
+        self._has_value = has_value
+
+    def get_measure(self) -> WindowMeasure:
+        return self.measure
+
+    def get_start(self) -> int:
+        return self.start
+
+    def get_end(self) -> int:
+        return self.end
+
+    def get_agg_values(self) -> List[Any]:
+        return self.agg_values
+
+    def has_value(self) -> bool:
+        return self._has_value
+
+    def __repr__(self) -> str:
+        return (f"WindowResult({self.measure.value},{self.start}-{self.end},"
+                f"{self.agg_values})")
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, AggregateWindow)
+                and self.measure == other.measure
+                and self.start == other.start
+                and self.end == other.end
+                and self.agg_values == other.agg_values)
+
+    def __hash__(self):
+        return hash((self.measure, self.start, self.end))
+
+
+class WindowCollector:
+    """Trigger sink passed into window types (WindowCollector.java:5-8)."""
+
+    def trigger(self, start: int, end: int, measure: WindowMeasure) -> None:
+        raise NotImplementedError
+
+
+class WindowOperator:
+    """The operator contract every backend implements
+    (WindowOperator.java:9-37). Backends: the host reference-semantics
+    operator (`scotty_tpu.simulator.SlicingWindowOperator`) and the TPU
+    engine (`scotty_tpu.engine.TpuWindowOperator`)."""
+
+    def process_element(self, element: Any, ts: int) -> None:
+        raise NotImplementedError
+
+    def process_elements(self, elements, timestamps) -> None:
+        """Batched ingest (TPU-native extension). Default: per-tuple loop."""
+        for element, ts in zip(elements, timestamps):
+            self.process_element(element, int(ts))
+
+    def process_watermark(self, watermark_ts: int) -> List[AggregateWindow]:
+        raise NotImplementedError
+
+    def add_window_assigner(self, window: Window) -> None:
+        raise NotImplementedError
+
+    def add_aggregation(self, window_function: AggregateFunction) -> None:
+        raise NotImplementedError
+
+    # alias parity: SlicingWindowOperator.addWindowFunction
+    def add_window_function(self, window_function: AggregateFunction) -> None:
+        self.add_aggregation(window_function)
+
+    def set_max_lateness(self, max_lateness: int) -> None:
+        raise NotImplementedError

@@ -1,0 +1,42 @@
+"""Time interval value type (core/.../TimeMeasure.java:24-109)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True, order=True)
+class TimeMeasure:
+    """An immutable millisecond interval with unit factories."""
+
+    millis: int
+
+    @staticmethod
+    def of(millis: int) -> "TimeMeasure":
+        return TimeMeasure(millis)
+
+    @staticmethod
+    def milliseconds(n: int) -> "TimeMeasure":
+        return TimeMeasure(n)
+
+    @staticmethod
+    def seconds(n: int) -> "TimeMeasure":
+        return TimeMeasure(n * 1000)
+
+    @staticmethod
+    def minutes(n: int) -> "TimeMeasure":
+        return TimeMeasure(n * 60 * 1000)
+
+    @staticmethod
+    def hours(n: int) -> "TimeMeasure":
+        return TimeMeasure(n * 60 * 60 * 1000)
+
+    @staticmethod
+    def days(n: int) -> "TimeMeasure":
+        return TimeMeasure(n * 24 * 60 * 60 * 1000)
+
+    def to_milliseconds(self) -> int:
+        return self.millis
+
+    def __int__(self) -> int:
+        return self.millis

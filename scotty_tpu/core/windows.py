@@ -1,0 +1,466 @@
+"""Window taxonomy: the window-type algebra of the framework.
+
+Re-design of the reference's ``core/windowType`` package
+(core/.../windowType/Window.java:7-9, ContextFreeWindow.java:6-13,
+TumblingWindow.java:6-53, SlidingWindow.java:6-72, SessionWindow.java:6-128,
+FixedBandWindow.java:5-73, WindowMeasure.java:3-5) as plain Python dataclasses
+with two faces:
+
+* a *scalar* face (``assign_next_window_start`` / ``trigger_windows``) used by
+  the host-side reference-semantics operator (`scotty_tpu.simulator`), and
+* a *vectorized* face (``edges_in_range`` / ``trigger_arrays``) used by the TPU
+  engine to enumerate slice edges and triggered windows in closed form with
+  NumPy/JAX array ops instead of per-tuple Python loops.
+
+Semantics notes (pinned by the reference test-suite):
+
+* Tumbling ``assign_next_window_start(t) = t + size - t % size`` — i.e. the
+  next grid point *strictly after* ``t`` when t is on the grid
+  (TumblingWindow.java:29-31).
+* Sliding triggers walk *backwards* from the last slide-aligned start at the
+  current watermark (SlidingWindow.java:50-57); tumbling triggers walk
+  forwards (TumblingWindow.java:34-39). Result order matters and is part of
+  the public contract.
+* Sessions are context-aware: per-operator mutable session list, inverted
+  ``has_active_windows`` naming preserved as ``_is_empty`` internally
+  (WindowContext.java:15-17).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+LONG_MAX = (1 << 63) - 1
+LONG_MIN = -(1 << 63)
+
+
+class WindowMeasure(enum.Enum):
+    """Every window is either event-time measured or arrival-count measured
+    (core/.../windowType/WindowMeasure.java:3-5)."""
+
+    Time = "Time"
+    Count = "Count"
+
+
+# Aliases matching common spelling in configs / DSL.
+TIME = WindowMeasure.Time
+COUNT = WindowMeasure.Count
+
+
+def java_mod(a: int, b: int) -> int:
+    """Java's ``%`` truncates toward zero; Python's floors. The reference's
+    edge arithmetic (TumblingWindow.java:30, SlidingWindow.java:42,48) relies
+    on Java semantics for negative operands."""
+    r = a % b
+    if r != 0 and (a < 0) != (b < 0):
+        r -= b
+    return r
+
+
+class Window:
+    """Base marker (core/.../windowType/Window.java:7-9)."""
+
+    measure: WindowMeasure
+
+    @property
+    def window_measure(self) -> WindowMeasure:
+        return self.measure
+
+    def get_window_measure(self) -> WindowMeasure:
+        return self.measure
+
+
+class ContextFreeWindow(Window):
+    """Windows whose edges are computable from a timestamp alone
+    (core/.../windowType/ContextFreeWindow.java:6-13)."""
+
+    def assign_next_window_start(self, position: int) -> int:
+        raise NotImplementedError
+
+    def trigger_windows(self, collector, last_watermark: int, current_watermark: int) -> None:
+        raise NotImplementedError
+
+    def clear_delay(self) -> int:
+        raise NotImplementedError
+
+    # --- vectorized face (TPU engine) -------------------------------------
+    def edges_in_range(self, lo: int, hi: int) -> np.ndarray:
+        """All slice edges e with ``lo < e <= hi`` this window induces.
+        Closed-form equivalent of iterating ``assign_next_window_start``."""
+        raise NotImplementedError
+
+    def trigger_arrays(self, last_watermark: int, current_watermark: int):
+        """(starts, ends) int64 arrays of triggered windows, in the exact
+        order the scalar ``trigger_windows`` would emit them."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class TumblingWindow(ContextFreeWindow):
+    """Fixed-size non-overlapping windows (core/.../TumblingWindow.java:6-53)."""
+
+    measure: WindowMeasure
+    size: int
+
+    def assign_next_window_start(self, position: int) -> int:
+        # TumblingWindow.java:29-31
+        return position + self.size - java_mod(position, self.size)
+
+    def trigger_windows(self, collector, last_watermark: int, current_watermark: int) -> None:
+        # TumblingWindow.java:34-39: emit every complete [w, w+size) with
+        # w >= lastStart and w+size <= currentWatermark, ascending.
+        last_start = last_watermark - java_mod(last_watermark + self.size, self.size)
+        start = last_start
+        while start + self.size <= current_watermark:
+            collector.trigger(start, start + self.size, self.measure)
+            start += self.size
+
+    def clear_delay(self) -> int:
+        return self.size
+
+    def edges_in_range(self, lo: int, hi: int) -> np.ndarray:
+        # grid points k*size with lo < k*size <= hi
+        first = (lo // self.size + 1) * self.size
+        if first > hi:
+            return np.empty(0, dtype=np.int64)
+        return np.arange(first, hi + 1, self.size, dtype=np.int64)
+
+    def trigger_arrays(self, last_watermark: int, current_watermark: int):
+        last_start = last_watermark - java_mod(last_watermark + self.size, self.size)
+        n = max(0, (current_watermark - last_start) // self.size)
+        starts = last_start + self.size * np.arange(n, dtype=np.int64)
+        return starts, starts + self.size
+
+    def __str__(self) -> str:
+        return f"TumblingWindow{{measure={self.measure.value}, size={self.size}}}"
+
+
+@dataclass(frozen=True)
+class SlidingWindow(ContextFreeWindow):
+    """Overlapping windows of ``size`` sliding by ``slide``
+    (core/.../SlidingWindow.java:6-72)."""
+
+    measure: WindowMeasure
+    size: int
+    slide: int
+
+    def assign_next_window_start(self, position: int) -> int:
+        # SlidingWindow.java:41-43 — next slide-grid point strictly after.
+        return position + self.slide - java_mod(position, self.slide)
+
+    @staticmethod
+    def window_start_with_offset(timestamp: int, window_size: int) -> int:
+        # SlidingWindow.java:46-48
+        return timestamp - java_mod(timestamp + window_size, window_size)
+
+    def trigger_windows(self, collector, last_watermark: int, current_watermark: int) -> None:
+        # SlidingWindow.java:50-57 — walk backwards from the last aligned
+        # start; guard 0 <= start and start+size <= currentWatermark+1.
+        start = self.window_start_with_offset(current_watermark, self.slide)
+        while start + self.size > last_watermark:
+            if start >= 0 and start + self.size <= current_watermark + 1:
+                collector.trigger(start, start + self.size, self.measure)
+            start -= self.slide
+
+    def clear_delay(self) -> int:
+        return self.size
+
+    def edges_in_range(self, lo: int, hi: int) -> np.ndarray:
+        first = (lo // self.slide + 1) * self.slide
+        if first > hi:
+            return np.empty(0, dtype=np.int64)
+        return np.arange(first, hi + 1, self.slide, dtype=np.int64)
+
+    def trigger_arrays(self, last_watermark: int, current_watermark: int):
+        last_start = self.window_start_with_offset(current_watermark, self.slide)
+        # descending starts s: s + size > last_wm, s >= 0, s + size <= wm + 1
+        n_total = (last_start - (last_watermark - self.size)) // self.slide
+        n_total = max(0, n_total)
+        starts = last_start - self.slide * np.arange(n_total, dtype=np.int64)
+        keep = (starts >= 0) & (starts + self.size <= current_watermark + 1)
+        starts = starts[keep]
+        return starts, starts + self.size
+
+    def __str__(self) -> str:
+        return (
+            f"SlidingWindow{{measure={self.measure.value}, size={self.size},"
+            f" slide={self.slide}}}"
+        )
+
+
+@dataclass(frozen=True)
+class FixedBandWindow(ContextFreeWindow):
+    """One-shot band ``[start, start+size)``; afterwards all tuples share one
+    big slice (core/.../FixedBandWindow.java:5-73)."""
+
+    measure: WindowMeasure
+    start: int
+    size: int
+
+    def assign_next_window_start(self, position: int) -> int:
+        # FixedBandWindow.java:36-48
+        if position == LONG_MAX or position < self.start:
+            return self.start
+        if self.start <= position < self.start + self.size:
+            return self.start + self.size
+        return LONG_MAX
+
+    def trigger_windows(self, collector, last_watermark: int, current_watermark: int) -> None:
+        # FixedBandWindow.java:51-57
+        end = self.start + self.size
+        if last_watermark <= end <= current_watermark:
+            collector.trigger(self.start, end, self.measure)
+
+    def clear_delay(self) -> int:
+        return self.size
+
+    def edges_in_range(self, lo: int, hi: int) -> np.ndarray:
+        pts = [e for e in (self.start, self.start + self.size) if lo < e <= hi]
+        return np.asarray(pts, dtype=np.int64)
+
+    def trigger_arrays(self, last_watermark: int, current_watermark: int):
+        end = self.start + self.size
+        if last_watermark <= end <= current_watermark:
+            return (
+                np.asarray([self.start], dtype=np.int64),
+                np.asarray([end], dtype=np.int64),
+            )
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+
+    def __str__(self) -> str:
+        return (
+            f"FixedBandWindow{{measure={self.measure.value}, start={self.start},"
+            f" size={self.size}}}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Context-aware windows (sessions and user-defined forward-context windows)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AddModification:
+    """A new window edge appeared at ``post``
+    (core/.../windowContext/AddModification.java:3-9)."""
+
+    post: int
+
+
+@dataclass(frozen=True)
+class DeleteModification:
+    """The window edge at ``pre`` disappeared
+    (core/.../windowContext/DeleteModification.java:3-9)."""
+
+    pre: int
+
+
+@dataclass(frozen=True)
+class ShiftModification:
+    """The window edge at ``pre`` moved to ``post``
+    (core/.../windowContext/ShiftModification.java:3-11)."""
+
+    pre: int
+    post: int
+
+
+class ActiveWindow:
+    """A live context window ``[start, end]``
+    (WindowContext.java:77-106 inner class)."""
+
+    __slots__ = ("start", "end")
+
+    def __init__(self, start: int, end: int):
+        self.start = start
+        self.end = end
+
+    def get_start(self) -> int:
+        return self.start
+
+    def get_end(self) -> int:
+        return self.end
+
+    def __repr__(self) -> str:
+        return f"ActiveWindow({self.start}, {self.end})"
+
+
+class WindowContext:
+    """Per-operator mutable state for context-aware windows
+    (core/.../windowContext/WindowContext.java:9-107).
+
+    Edit hooks record `WindowModifications` into a caller-supplied set; these
+    records drive slice repair in the slice manager. The reference's
+    ``hasActiveWindows()`` returns *true when the list is empty*
+    (WindowContext.java:15-17) — session logic depends on that inversion, so
+    we keep the behavior under the honest name ``has_no_active_windows``.
+    """
+
+    def __init__(self):
+        self.active_windows: list[ActiveWindow] = []
+        self._modified_window_edges: set | None = None
+
+    # -- reference-parity helpers ------------------------------------------
+    def has_no_active_windows(self) -> bool:
+        return len(self.active_windows) == 0
+
+    def get_active_windows(self) -> list[ActiveWindow]:
+        return self.active_windows
+
+    def get_window(self, i: int) -> ActiveWindow:
+        return self.active_windows[i]
+
+    def number_of_active_windows(self) -> int:
+        return len(self.active_windows)
+
+    def add_new_window(self, i: int, start: int, end: int) -> ActiveWindow:
+        # WindowContext.java:19-26: records Add for BOTH edges.
+        w = ActiveWindow(start, end)
+        self.active_windows.insert(i, w)
+        self._modified_window_edges.add(AddModification(start))
+        self._modified_window_edges.add(AddModification(end))
+        return w
+
+    def merge_with_pre(self, index: int) -> ActiveWindow:
+        # WindowContext.java:38-45
+        assert index >= 1
+        window = self.active_windows[index]
+        pre = self.active_windows[index - 1]
+        self.shift_end(pre, window.end)
+        self.remove_window(index)
+        return pre
+
+    def remove_window(self, index: int) -> None:
+        # WindowContext.java:47-51: records Delete for BOTH edges.
+        w = self.active_windows[index]
+        self._modified_window_edges.add(DeleteModification(w.start))
+        self._modified_window_edges.add(DeleteModification(w.end))
+        del self.active_windows[index]
+
+    def shift_start(self, window: ActiveWindow, position: int) -> None:
+        # WindowContext.java:54-57
+        self._modified_window_edges.add(ShiftModification(window.start, position))
+        window.start = position
+
+    def shift_end(self, window: ActiveWindow, position: int) -> None:
+        # WindowContext.java:59-62 — deliberately does NOT record a shift.
+        window.end = position
+
+    # -- abstract ----------------------------------------------------------
+    def update_context(self, tuple_, position: int):
+        raise NotImplementedError
+
+    def update_context_with_modifications(self, tuple_, position: int, modifications: set):
+        # WindowContext.java:68-71
+        self._modified_window_edges = modifications
+        return self.update_context(tuple_, position)
+
+    def assign_next_window_start(self, position: int) -> int:
+        raise NotImplementedError
+
+    def trigger_windows(self, collector, last_watermark: int, current_watermark: int) -> None:
+        raise NotImplementedError
+
+
+class ForwardContextAware(Window):
+    """Window that needs per-stream forward context (e.g. sessions)
+    (core/.../ForwardContextAware.java:6-9)."""
+
+    def create_context(self) -> WindowContext:
+        raise NotImplementedError
+
+
+class ForwardContextFree(Window):
+    """Context windows whose edges do not depend on tuple values
+    (core/.../ForwardContextFree.java:5-8)."""
+
+    def create_context(self) -> WindowContext:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class SessionWindow(ForwardContextAware):
+    """Gap-based session windows (core/.../SessionWindow.java:6-128)."""
+
+    measure: WindowMeasure
+    gap: int
+
+    def create_context(self) -> "SessionWindow.SessionContext":
+        return SessionWindow.SessionContext(self.gap, self.measure)
+
+    class SessionContext(WindowContext):
+        """SessionWindow.java:37-118 inner class, reimplemented faithfully."""
+
+        def __init__(self, gap: int, measure: WindowMeasure):
+            super().__init__()
+            self.gap = gap
+            self.measure = measure
+
+        def update_context(self, tuple_, position: int):
+            # SessionWindow.java:40-84
+            gap = self.gap
+            if self.has_no_active_windows():
+                self.add_new_window(0, position, position)
+                return self.get_window(0)
+            session_index = self.get_session(position)
+
+            if session_index == -1:
+                self.add_new_window(0, position, position)
+                return None
+
+            s = self.get_window(session_index)
+            if s.start - gap > position:
+                # add new session before
+                return self.add_new_window(session_index, position, position)
+            elif s.start > position and s.start - gap < position:
+                # expand start
+                self.shift_start(s, position)
+                if session_index > 0:
+                    pre = self.get_window(session_index - 1)
+                    if pre.end + gap >= s.start:
+                        return self.merge_with_pre(session_index)
+                return s
+            elif s.end < position and s.end + gap >= position:
+                self.shift_end(s, position)
+                if session_index < self.number_of_active_windows() - 1:
+                    nxt = self.get_window(session_index + 1)
+                    if s.end + gap >= nxt.start:
+                        return self.merge_with_pre(session_index + 1)
+                return s
+            elif s.end + gap < position:
+                # add new session after
+                return self.add_new_window(session_index + 1, position, position)
+            return None
+
+        def get_session(self, position: int) -> int:
+            # SessionWindow.java:86-98 — linear scan over ordered sessions.
+            i = 0
+            while i < self.number_of_active_windows():
+                s = self.get_window(i)
+                if s.start - self.gap <= position and s.end + self.gap >= position:
+                    return i
+                elif s.start - self.gap > position:
+                    return i - 1
+                i += 1
+            return i - 1
+
+        def assign_next_window_start(self, position: int) -> int:
+            # SessionWindow.java:102-104
+            return position + self.gap
+
+        def trigger_windows(self, collector, last_watermark: int, current_watermark: int) -> None:
+            # SessionWindow.java:107-116
+            if self.has_no_active_windows():
+                return
+            session = self.get_window(0)
+            while session.end + self.gap < current_watermark:
+                collector.trigger(session.start, session.end + self.gap, self.measure)
+                self.remove_window(0)
+                if self.has_no_active_windows():
+                    return
+                session = self.get_window(0)
+
+    def __str__(self) -> str:
+        return f"SessionWindow{{measure={self.measure.value}, gap={self.gap}}}"

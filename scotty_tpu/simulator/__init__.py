@@ -1,0 +1,29 @@
+"""Host-side reference-semantics operator (oracle + general fallback)."""
+
+from .operator import (
+    AggregateWindowState,
+    LazyAggregateStore,
+    SliceManager,
+    SlicingWindowOperator,
+    StreamSlicer,
+    WindowManager,
+)
+from .slices import (
+    AbstractSlice,
+    AggregateState,
+    AggregateValueState,
+    EagerSlice,
+    Fixed,
+    Flexible,
+    LazySlice,
+    SliceFactory,
+    StreamRecord,
+)
+
+__all__ = [
+    "SlicingWindowOperator", "WindowManager", "StreamSlicer", "SliceManager",
+    "LazyAggregateStore", "AggregateWindowState",
+    "AbstractSlice", "EagerSlice", "LazySlice", "SliceFactory",
+    "AggregateState", "AggregateValueState", "StreamRecord",
+    "Fixed", "Flexible",
+]

@@ -1,0 +1,291 @@
+"""Slice data structures for the host-side operator.
+
+Parity with the reference ``slicing/slice`` + ``slicing/state`` packages:
+Slice.java:5-122 (incl. the Fixed/Flexible edge types), AbstractSlice.java,
+EagerSlice.java:8-29, LazySlice.java:12-66, StreamRecord.java:5-33,
+SliceFactory.java:7-28, AggregateState.java:10-93, AggregateValueState.java:7-85.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..core.aggregates import AggregateFunction
+from ..core.windows import LONG_MAX
+from ..state import SetState, StateFactory
+
+
+class StreamRecord:
+    """(ts, record) pair ordered by ts (StreamRecord.java:5-33). Ordering is
+    by timestamp only — two records with equal ts compare equal, which is
+    what makes the ordered record set deduplicate them (TreeSet semantics)."""
+
+    __slots__ = ("ts", "record")
+
+    def __init__(self, ts: int, record: Any):
+        self.ts = ts
+        self.record = record
+
+    def __lt__(self, other: "StreamRecord") -> bool:
+        return self.ts < other.ts
+
+    def __repr__(self) -> str:
+        return f"StreamRecord({self.ts}, {self.record!r})"
+
+
+class SliceType:
+    """Edge type of a slice's end (Slice.java:80-121)."""
+
+    def is_movable(self) -> bool:
+        raise NotImplementedError
+
+
+class Fixed(SliceType):
+    """Immovable edge from a context-free window grid (Slice.java:86-92)."""
+
+    def is_movable(self) -> bool:
+        return False
+
+    def __repr__(self) -> str:
+        return "Fixed"
+
+
+class Flexible(SliceType):
+    """Movable edge shared by ``counter`` context windows; movable iff
+    exactly one window owns it (Slice.java:94-121)."""
+
+    def __init__(self, counter: int = 1):
+        self.counter = counter
+
+    def is_movable(self) -> bool:
+        return self.counter == 1
+
+    def decrement_count(self) -> None:
+        self.counter -= 1
+
+    def increment_count(self) -> None:
+        self.counter += 1
+
+    def __repr__(self) -> str:
+        return f"Flexible({self.counter})"
+
+
+class AggregateValueState:
+    """One aggregation's partial for one slice
+    (AggregateValueState.java:7-85)."""
+
+    __slots__ = ("partial", "empty", "fn", "records")
+
+    def __init__(self, fn: AggregateFunction, records: Optional[SetState]):
+        self.fn = fn
+        self.records = records
+        self.partial = None
+        self.empty = True
+
+    def add_element(self, element) -> None:
+        # AggregateValueState.java:23-31
+        if self.empty or self.partial is None:
+            self.partial = self.fn.lift(element)
+            self.empty = False
+        else:
+            self.partial = self.fn.lift_and_combine(self.partial, element)
+
+    def remove_element(self, stream_record: StreamRecord) -> None:
+        # AggregateValueState.java:33-49 — invert if possible, else recompute
+        # the whole slice partial from the retained record set.
+        if self.fn.invertible:
+            self.partial = self.fn.lift_and_invert(self.partial, stream_record.record)
+        else:
+            self.recompute()
+
+    def recompute(self) -> None:
+        assert self.records is not None
+        self.clear()
+        for record in self.records:
+            self.add_element(record.record)
+
+    def clear(self) -> None:
+        self.partial = None
+        self.empty = True
+
+    def merge(self, other: "AggregateValueState") -> None:
+        # AggregateValueState.java:55-69
+        if self.empty and not other.empty:
+            self.partial = self.fn.clone_partial(other.partial)
+            self.empty = False
+        elif not other.empty:
+            self.partial = self.fn.combine(self.partial, other.partial)
+
+    def has_value(self) -> bool:
+        return not self.empty
+
+    def get_value(self):
+        if self.partial is not None:
+            return self.fn.lower(self.partial)
+        return None
+
+    def __repr__(self) -> str:
+        return f"{type(self.fn).__name__}->{self.partial!r}"
+
+
+class AggregateState:
+    """Vector of per-aggregation partials (AggregateState.java:10-93)."""
+
+    __slots__ = ("value_states",)
+
+    def __init__(self, window_functions: List[AggregateFunction],
+                 records: Optional[SetState] = None):
+        self.value_states = [AggregateValueState(fn, records) for fn in window_functions]
+
+    def add_element(self, element) -> None:
+        for vs in self.value_states:
+            vs.add_element(element)
+
+    def remove_element(self, record: StreamRecord) -> None:
+        for vs in self.value_states:
+            vs.remove_element(record)
+
+    def clear(self) -> None:
+        for vs in self.value_states:
+            vs.clear()
+
+    def merge(self, other: "AggregateState") -> None:
+        # AggregateState.java:44-54: mergeable iff other has no more states.
+        if len(other.value_states) <= len(self.value_states):
+            for mine, theirs in zip(self.value_states, other.value_states):
+                mine.merge(theirs)
+
+    def has_values(self) -> bool:
+        return any(vs.has_value() for vs in self.value_states)
+
+    def get_values(self) -> list:
+        return [vs.get_value() for vs in self.value_states if vs.has_value()]
+
+    def __repr__(self) -> str:
+        return repr(self.value_states)
+
+
+class AbstractSlice:
+    """Boundary/count bookkeeping shared by eager and lazy slices
+    (AbstractSlice.java:3-122)."""
+
+    def __init__(self, start_ts: int, end_ts: int, c_start: int, c_last: int,
+                 type_: SliceType):
+        self.t_start = start_ts
+        self.t_end = end_ts
+        self.type = type_
+        self.t_last = start_ts          # AbstractSlice.java ctor: tLast = startTs
+        self.t_first = LONG_MAX
+        self.c_start = c_start
+        self.c_last = c_last
+
+    def add_element(self, element, ts: int) -> None:
+        # AbstractSlice.java:27-31
+        self.t_last = max(self.t_last, ts)
+        self.t_first = min(self.t_first, ts)
+        self.c_last += 1
+
+    def merge(self, other: "AbstractSlice") -> None:
+        # AbstractSlice.java:34-39
+        self.t_last = max(self.t_last, other.t_last)
+        self.t_first = min(self.t_first, other.t_first)
+        self.t_end = max(self.t_end, other.t_end)
+        self.agg_state.merge(other.agg_state)
+
+    @property
+    def agg_state(self) -> AggregateState:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return (f"Slice{{tStart={self.t_start}, tEnd={self.t_end},"
+                f" tLast={self.t_last}, tFirst={self.t_first},"
+                f" cFirst={self.c_start}, cLast={self.c_last},"
+                f" measure={self.type!r}}}")
+
+
+class EagerSlice(AbstractSlice):
+    """Partial-aggregate-only slice, no tuple retention (EagerSlice.java:8-29).
+    Chosen when tuples never need replay."""
+
+    def __init__(self, window_functions, start_ts, end_ts, c_start, c_last, type_):
+        super().__init__(start_ts, end_ts, c_start, c_last, type_)
+        self._state = AggregateState(window_functions, None)
+
+    @property
+    def agg_state(self) -> AggregateState:
+        return self._state
+
+    def add_element(self, element, ts: int) -> None:
+        super().add_element(element, ts)
+        self._state.add_element(element)
+
+
+class LazySlice(AbstractSlice):
+    """Slice that retains raw records for out-of-order repair
+    (LazySlice.java:12-66)."""
+
+    def __init__(self, state_factory: StateFactory, window_functions,
+                 start_ts, end_ts, c_start, c_last, type_):
+        super().__init__(start_ts, end_ts, c_start, c_last, type_)
+        self.records: SetState = state_factory.create_set_state()
+        self._state = AggregateState(window_functions, self.records)
+
+    @property
+    def agg_state(self) -> AggregateState:
+        return self._state
+
+    def add_element(self, element, ts: int) -> None:
+        super().add_element(element, ts)
+        self._state.add_element(element)
+        self.records.add(StreamRecord(ts, element))
+
+    def prepend_element(self, record: StreamRecord) -> None:
+        # LazySlice.java:30-34 — reuses addElement bookkeeping.
+        AbstractSlice.add_element(self, record.record, record.ts)
+        self.records.add(record)
+        self._state.add_element(record.record)
+
+    def drop_last_element(self) -> StreamRecord:
+        # LazySlice.java:36-45
+        drop = self.records.drop_last()
+        self.c_last -= 1
+        if not self.records.is_empty():
+            self.t_last = self.records.get_last().ts
+        self._state.remove_element(drop)
+        return drop
+
+    def drop_first_element(self) -> StreamRecord:
+        # LazySlice.java:47-54 — note: reads the new first AFTER dropping.
+        drop = self.records.drop_first()
+        current_first = self.records.get_first()
+        self.c_last -= 1
+        self.t_first = current_first.ts
+        self._state.remove_element(drop)
+        return drop
+
+
+class SliceFactory:
+    """The eager/lazy decision tree (SliceFactory.java:7-28): eager iff no
+    count measure AND (no context-aware windows OR pure-session workload) AND
+    maxLateness > 0 — i.e. tuples are retained only when count windows or
+    non-session context windows can force replay or shifting."""
+
+    def __init__(self, window_manager, state_factory: StateFactory):
+        self.window_manager = window_manager
+        self.state_factory = state_factory
+
+    def create_slice(self, start_ts: int, end_ts: int, start_count: int,
+                     end_count: int, type_: SliceType) -> AbstractSlice:
+        wm = self.window_manager
+        if (not wm.has_count_measure()
+                and (not wm.has_context_aware_window() or wm.is_session_window_case())
+                and wm.get_max_lateness() > 0):
+            return EagerSlice(wm.get_aggregations(), start_ts, end_ts,
+                              start_count, end_count, type_)
+        return LazySlice(self.state_factory, wm.get_aggregations(), start_ts,
+                         end_ts, start_count, end_count, type_)
+
+    def create_slice_now(self, start_ts: int, end_ts: int, type_: SliceType) -> AbstractSlice:
+        """3-arg overload (SliceFactory.java:24-26): counts = current count."""
+        count = self.window_manager.get_current_count()
+        return self.create_slice(start_ts, end_ts, count, count, type_)

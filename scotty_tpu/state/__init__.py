@@ -1,0 +1,203 @@
+"""Pluggable state primitives (parity with the reference ``state/`` module,
+SURVEY.md §2.3).
+
+``StateFactory`` (state/.../StateFactory.java:5-12) creates three cell types:
+``ValueState`` (ValueState.java:3-9), ``ListState`` (ListState.java:5-12) and
+``SetState`` (SetState.java:3-15). The host-side operator keeps every slice
+partial in a ``ValueState`` and every lazy slice's record buffer in a
+``SetState``, exactly like the reference — this is the seam reserved for
+checkpointable backends (README.md:66). The TPU engine does not use these
+cells (its state is a device pytree checkpointed via orbax); they exist for
+the host path and for API parity.
+
+The in-memory ``SetState`` is *ordered and deduplicating on the sort key*,
+mirroring the reference's ``TreeSet``-backed MemorySetState
+(state/.../memory/MemorySetState.java:7-50): two records comparing equal
+(same timestamp — StreamRecord.compareTo, slicing/.../StreamRecord.java:24-27)
+collapse to one entry. That quirk is observable in lazy-slice repair and is
+preserved deliberately.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Generic, Iterable, Iterator, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class State:
+    """Base state cell (state/.../State.java:5-10)."""
+
+    def clean(self) -> None:
+        raise NotImplementedError
+
+    def is_empty(self) -> bool:
+        raise NotImplementedError
+
+
+class ValueState(State, Generic[T]):
+    """Single-value cell (state/.../ValueState.java:3-9)."""
+
+    def get(self) -> Optional[T]:
+        raise NotImplementedError
+
+    def set(self, value: T) -> None:
+        raise NotImplementedError
+
+
+class ListState(State, Generic[T]):
+    """Indexed list cell (state/.../ListState.java:5-12)."""
+
+    def get(self, index: int) -> T:
+        raise NotImplementedError
+
+    def append(self, value: T) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[T]:
+        raise NotImplementedError
+
+
+class SetState(State, Generic[T]):
+    """Ordered set cell (state/.../SetState.java:3-15). The reference API
+    spells ``dropFrist`` [sic]; we use ``drop_first``."""
+
+    def get_first(self) -> T:
+        raise NotImplementedError
+
+    def get_last(self) -> T:
+        raise NotImplementedError
+
+    def drop_first(self) -> T:
+        raise NotImplementedError
+
+    def drop_last(self) -> T:
+        raise NotImplementedError
+
+    def add(self, value: T) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[T]:
+        raise NotImplementedError
+
+
+class StateFactory:
+    """Creates the three cell types (state/.../StateFactory.java:5-12)."""
+
+    def create_value_state(self) -> ValueState:
+        raise NotImplementedError
+
+    def create_list_state(self) -> ListState:
+        raise NotImplementedError
+
+    def create_set_state(self) -> SetState:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# In-memory implementations (state/.../memory/)
+# ---------------------------------------------------------------------------
+
+
+class MemoryValueState(ValueState[T]):
+    """Field-backed value cell (memory/MemoryValueState.java:7-50)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value: Optional[T] = None
+
+    def get(self) -> Optional[T]:
+        return self._value
+
+    def set(self, value: T) -> None:
+        self._value = value
+
+    def clean(self) -> None:
+        self._value = None
+
+    def is_empty(self) -> bool:
+        return self._value is None
+
+    def __repr__(self) -> str:
+        return f"MemoryValueState({self._value!r})"
+
+
+class MemoryListState(ListState[T]):
+    """List-backed cell (memory/MemoryListState.java:8-36)."""
+
+    __slots__ = ("_values",)
+
+    def __init__(self):
+        self._values: List[T] = []
+
+    def get(self, index: int) -> T:
+        return self._values[index]
+
+    def append(self, value: T) -> None:
+        self._values.append(value)
+
+    def clean(self) -> None:
+        self._values.clear()
+
+    def is_empty(self) -> bool:
+        return not self._values
+
+    def __iter__(self) -> Iterator[T]:
+        return iter(self._values)
+
+
+class MemorySetState(SetState[T]):
+    """Ordered, key-deduplicating set cell — the Python analogue of the
+    reference's TreeSet (memory/MemorySetState.java:7-50). Elements must be
+    mutually comparable; an element comparing equal to an existing one is NOT
+    inserted (TreeSet.add semantics)."""
+
+    __slots__ = ("_values",)
+
+    def __init__(self):
+        self._values: List[T] = []
+
+    def add(self, value: T) -> None:
+        i = bisect.bisect_left(self._values, value)
+        if i < len(self._values) and not (value < self._values[i] or self._values[i] < value):
+            return  # compares equal → TreeSet drops it
+        self._values.insert(i, value)
+
+    def get_first(self) -> T:
+        return self._values[0]
+
+    def get_last(self) -> T:
+        return self._values[-1]
+
+    def drop_first(self) -> T:
+        return self._values.pop(0)
+
+    def drop_last(self) -> T:
+        return self._values.pop()
+
+    def clean(self) -> None:
+        self._values.clear()
+
+    def is_empty(self) -> bool:
+        return not self._values
+
+    def __iter__(self) -> Iterator[T]:
+        return iter(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+
+class MemoryStateFactory(StateFactory):
+    """In-memory factory (memory/MemoryStateFactory.java:5-20)."""
+
+    def create_value_state(self) -> MemoryValueState:
+        return MemoryValueState()
+
+    def create_list_state(self) -> MemoryListState:
+        return MemoryListState()
+
+    def create_set_state(self) -> MemorySetState:
+        return MemorySetState()

@@ -1,0 +1,61 @@
+"""Store lookup/insert tests — transliterated from
+slicing/src/test/.../LazyAggregateStoreTest.java."""
+
+import pytest
+
+from scotty_tpu.core import ReduceAggregateFunction
+from scotty_tpu.simulator import (
+    Fixed,
+    LazyAggregateStore,
+    SliceFactory,
+    WindowManager,
+)
+from scotty_tpu.state import MemoryStateFactory
+
+
+@pytest.fixture
+def env():
+    store = LazyAggregateStore()
+    state_factory = MemoryStateFactory()
+    window_manager = WindowManager(state_factory, store)
+    slice_factory = SliceFactory(window_manager, state_factory)
+    window_manager.add_aggregation(ReduceAggregateFunction(lambda a, b: a + b))
+    return store, slice_factory
+
+
+def _fill(store, sf, bounds=((0, 10), (10, 20), (20, 30), (40, 50))):
+    slices = [sf.create_slice_now(a, b, Fixed()) for a, b in bounds]
+    for s in slices:
+        store.append_slice(s)
+    return slices
+
+
+def test_get_slice_by_index(env):
+    store, sf = env
+    slices = _fill(store, sf)
+    for i, s in enumerate(slices):
+        assert store.get_slice(i) is s
+    assert store.get_current_slice() is slices[-1]
+
+
+def test_find_slice_by_ts(env):
+    store, sf = env
+    slices = _fill(store, sf)
+    for i, s in enumerate(slices):
+        assert store.find_slice_index_by_timestamp(s.t_start) == i
+        assert store.find_slice_index_by_timestamp(s.t_end - 1) == i
+        assert store.find_slice_index_by_timestamp(s.t_start + 5) == i
+
+
+def test_insert_value(env):
+    store, sf = env
+    _fill(store, sf)
+
+    store.insert_value_to_slice(1, 1, 14)
+    store.insert_value_to_slice(2, 2, 22)
+    store.insert_value_to_current_slice(3, 22)
+
+    assert store.get_slice(0).agg_state.get_values() == []
+    assert store.get_slice(1).agg_state.get_values()[0] == 1
+    assert store.get_slice(2).agg_state.get_values()[0] == 2
+    assert store.get_slice(3).agg_state.get_values()[0] == 3

@@ -1,0 +1,157 @@
+"""Fixed-band window operator tests — transliterated from
+slicing/src/test/.../windowTest/FixedBandWindowTest.java."""
+
+import pytest
+
+from scotty_tpu import (
+    FixedBandWindow,
+    ReduceAggregateFunction,
+    SlicingWindowOperator,
+    WindowMeasure,
+)
+from window_assert import assert_window
+
+
+@pytest.fixture
+def op():
+    return SlicingWindowOperator()
+
+
+def sum_fn():
+    return ReduceAggregateFunction(lambda a, b: a + b)
+
+
+def test_in_order(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 1, 10))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(55)
+    assert_window(results[0], 1, 11, 1)
+
+
+def test_in_order_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 0, 10))
+    op.process_element(1, 0)
+    op.process_element(2, 0)
+    op.process_element(3, 20)
+    op.process_element(4, 30)
+    op.process_element(5, 40)
+
+    results = op.process_watermark(22)
+    assert_window(results[0], 0, 10, 3)
+
+    results = op.process_watermark(55)
+    assert results == []
+
+
+def test_in_order_3(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 18, 10))
+    op.process_element(1, 0)
+    op.process_element(2, 0)
+    op.process_element(3, 20)
+    op.process_element(4, 30)
+    op.process_element(5, 40)
+
+    results = op.process_watermark(22)
+    assert results == []
+
+    results = op.process_watermark(55)
+    assert_window(results[0], 18, 28, 3)
+
+
+def test_in_order_two_windows(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 10, 10))
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 20, 10))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 2
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+
+
+def test_in_order_two_windows_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 14, 11))
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 23, 10))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(26)
+    assert results[0].get_agg_values()[0] == 2
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+
+
+def test_in_order_two_windows_dynamic(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 10, 10))
+
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 20, 10))
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 2
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+
+
+def test_in_order_two_windows_dynamic_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 10, 10))
+
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 2
+
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 20, 21))
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 7
+
+
+def test_out_of_order(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(FixedBandWindow(WindowMeasure.Time, 10, 20))
+    op.process_element(1, 1)
+    op.process_element(1, 29)
+
+    # out-of-order tuples have to be inserted into the window
+    op.process_element(1, 20)
+    op.process_element(1, 23)
+    op.process_element(1, 25)
+
+    op.process_element(1, 45)
+
+    results = op.process_watermark(22)
+    assert results == []
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 4

@@ -1,0 +1,195 @@
+"""Session-window operator tests — transliterated from
+slicing/src/test/.../windowTest/SessionWindowOperatorTest.java."""
+
+import pytest
+
+from scotty_tpu import (
+    ReduceAggregateFunction,
+    SessionWindow,
+    SlicingWindowOperator,
+    TumblingWindow,
+    WindowMeasure,
+)
+from window_assert import assert_contains, assert_window
+
+
+@pytest.fixture
+def op():
+    return SlicingWindowOperator()
+
+
+def sum_fn():
+    return ReduceAggregateFunction(lambda a, b: a + b)
+
+
+def test_in_order(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 23)
+    op.process_element(4, 31)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 1
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 9
+    results = op.process_watermark(80)
+    assert results[0].get_agg_values()[0] == 5
+
+
+def test_in_order_clean(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10000))
+    op.process_element(1, 1000)
+    op.process_element(2, 19000)
+    op.process_element(3, 23000)
+    op.process_element(4, 31000)
+    op.process_element(5, 49000)
+
+    results = op.process_watermark(22000)
+    assert results[0].get_agg_values()[0] == 1
+
+    results = op.process_watermark(55000)
+    assert results[0].get_agg_values()[0] == 9
+    results = op.process_watermark(80000)
+    assert results[0].get_agg_values()[0] == 5
+
+
+def test_in_order_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 0)
+    op.process_element(2, 0)
+    op.process_element(3, 20)
+    op.process_element(4, 31)
+    op.process_element(5, 42)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 3
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+    assert results[1].get_agg_values()[0] == 4
+    assert results[2].get_agg_values()[0] == 5
+
+
+def test_out_of_order_simple_insert(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 1)
+
+    op.process_element(1, 9)
+    op.process_element(1, 15)
+    op.process_element(1, 30)
+    op.process_element(1, 12)
+
+    results = op.process_watermark(50)
+    assert_window(results[0], 1, 25, 4)
+    assert_window(results[1], 30, 40, 1)
+
+
+def test_out_of_order_right_insert(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 1)
+
+    op.process_element(1, 9)
+    op.process_element(1, 10)
+    op.process_element(1, 30)
+    op.process_element(1, 12)
+
+    results = op.process_watermark(50)
+    assert_window(results[0], 1, 22, 4)
+    assert_window(results[1], 30, 40, 1)
+
+
+def test_out_of_order_left_insert(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 1)
+
+    op.process_element(1, 9)
+    op.process_element(1, 10)
+    op.process_element(1, 30)
+    op.process_element(1, 27)
+
+    results = op.process_watermark(22)
+    assert_window(results[0], 1, 20, 3)
+
+    results = op.process_watermark(50)
+    assert_window(results[0], 27, 40, 2)
+
+
+def test_out_of_order_split_slice(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 1)
+
+    op.process_element(1, 30)
+    op.process_element(1, 12)
+
+    results = op.process_watermark(22)
+    assert_window(results[0], 1, 11, 1)
+
+    results = op.process_watermark(50)
+    assert_window(results[0], 12, 22, 1)
+    assert_window(results[1], 30, 40, 1)
+
+
+def test_out_of_order_merge_slice(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 7)
+
+    op.process_element(1, 30)
+    op.process_element(1, 51)
+    op.process_element(1, 15)
+    op.process_element(1, 21)
+
+    results = op.process_watermark(70)
+    assert_window(results[0], 7, 40, 4)
+    assert_window(results[1], 51, 61, 1)
+
+
+def test_out_of_order_combined_session_tumbling_merge_session(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 40))
+    op.process_element(1, 7)
+
+    op.process_element(1, 22)
+    op.process_element(1, 51)
+    op.process_element(1, 15)   # merge slice
+    op.process_element(1, 37)   # add new session / split
+
+    results = op.process_watermark(70)
+    assert_window(results[0], 0, 40, 4)
+    assert_window(results[1], 7, 32, 3)
+    assert_window(results[2], 37, 47, 1)
+    assert_window(results[3], 51, 61, 1)
+
+
+def test_out_of_order_combined_multi_session(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 10))
+    op.add_window_assigner(SessionWindow(WindowMeasure.Time, 5))
+    # events -> 20, 31, 33, 40, 50, 57
+    # [20-25, 31-38, 40-45, 50-55, 57-62, 20-30, 31-67]
+    op.process_element(1, 20)
+    op.process_element(1, 40)
+    op.process_element(1, 50)
+    op.process_element(1, 57)
+    op.process_element(1, 33)   # extend one left
+    op.process_element(1, 31)   # extend one left
+
+    results = op.process_watermark(70)
+    assert_contains(results, 20, 25, 1)
+    assert_contains(results, 31, 38, 2)
+    assert_contains(results, 40, 45, 1)
+    assert_contains(results, 50, 55, 1)
+    assert_contains(results, 57, 62, 1)
+    assert_contains(results, 20, 30, 1)
+    assert_contains(results, 31, 67, 5)

@@ -1,0 +1,86 @@
+"""Eager/lazy decision-table tests — transliterated from
+slicing/src/test/.../SliceFactoryTest.java (pins the storage-mode selection
+to the general-stream-slicing paper's decision tree)."""
+
+import pytest
+
+from scotty_tpu.core import (
+    ForwardContextAware,
+    ReduceAggregateFunction,
+    SessionWindow,
+    WindowMeasure,
+)
+from scotty_tpu.simulator import (
+    EagerSlice,
+    Fixed,
+    LazyAggregateStore,
+    LazySlice,
+    SliceFactory,
+    WindowManager,
+)
+from scotty_tpu.state import MemoryStateFactory
+
+
+class FakeContextWindow(ForwardContextAware):
+    def __init__(self, measure):
+        self.measure = measure
+
+    def create_context(self):
+        return None
+
+
+@pytest.fixture
+def env():
+    store = LazyAggregateStore()
+    state_factory = MemoryStateFactory()
+    window_manager = WindowManager(state_factory, store)
+    slice_factory = SliceFactory(window_manager, state_factory)
+    window_manager.add_aggregation(ReduceAggregateFunction(lambda a, b: a + b))
+    return window_manager, slice_factory
+
+
+def test_lazy_slice_context_aware(env):
+    wm, sf = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    assert wm.get_max_lateness() > 0
+    assert wm.has_context_aware_window()
+    assert not wm.is_session_window_case()
+
+    assert isinstance(sf.create_slice_now(0, 10, Fixed()), LazySlice)
+
+
+def test_lazy_slice_count(env):
+    wm, sf = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Count))
+
+    assert wm.has_count_measure()
+    assert isinstance(sf.create_slice_now(0, 10, Fixed()), LazySlice)
+
+
+def test_eager_slice_session(env):
+    wm, sf = env
+    wm.add_window_assigner(SessionWindow(WindowMeasure.Time, 1000))
+
+    assert wm.get_max_lateness() > 0
+    assert wm.has_context_aware_window()
+    assert wm.is_session_window_case()
+    assert not wm.has_count_measure()
+
+    assert isinstance(sf.create_slice_now(0, 10, Fixed()), EagerSlice)
+
+    wm.add_window_assigner(SessionWindow(WindowMeasure.Time, 2000))
+    assert wm.is_session_window_case()
+    assert isinstance(sf.create_slice_now(0, 10, Fixed()), EagerSlice)
+
+
+def test_lazy_slice_session_plus_context_aware(env):
+    wm, sf = env
+    wm.add_window_assigner(SessionWindow(WindowMeasure.Time, 1000))
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    assert wm.get_max_lateness() > 0
+    assert wm.has_context_aware_window()
+    assert not wm.is_session_window_case()
+
+    assert isinstance(sf.create_slice_now(0, 10, Fixed()), LazySlice)

@@ -1,0 +1,259 @@
+"""Slice-topology tests for out-of-order repair — transliterated from
+slicing/src/test/.../SliceManagerTest.java (shift / split / add / delete
+cases driven by a scripted fake context window emitting modifications at
+magic timestamps 5/15/25/35)."""
+
+import pytest
+
+from scotty_tpu.core import (
+    ForwardContextAware,
+    ReduceAggregateFunction,
+    WindowContext,
+    WindowMeasure,
+)
+from scotty_tpu.simulator import (
+    Flexible,
+    LazyAggregateStore,
+    LazySlice,
+    SliceFactory,
+    SliceManager,
+    WindowManager,
+)
+from scotty_tpu.state import MemoryStateFactory
+
+
+class ScriptedWindowContext(WindowContext):
+    """SliceManagerTest.java:297-367 scripted context."""
+
+    def __init__(self, measure):
+        super().__init__()
+        self.measure = measure
+
+    def update_context(self, tuple_, position):
+        index = self.get_window_index(position)
+        if index == -1:
+            return self.add_new_window(0, position - position % 10,
+                                       position + 10 - position % 10)
+        elif position % 5 != 0 and position > self.get_window(index).end:
+            return self.add_new_window(index + 1, position - position % 10,
+                                       position + 10 - position % 10)
+
+        if position == 5:
+            self.shift_start(self.get_window(index + 1), position)
+        elif position == 15:
+            self.shift_start(self.get_window(index), position)
+        elif position == 25:
+            return self.add_new_window(index, position,
+                                       position + 10 - position % 10)
+        elif position == 35:
+            return self.merge_with_pre(index)
+        return None
+
+    def get_window_index(self, position):
+        i = 0
+        while i < self.number_of_active_windows():
+            s = self.get_window(i)
+            if s.start <= position and s.end > position:
+                return i
+            i += 1
+        return i - 1
+
+    def assign_next_window_start(self, position):
+        return position + 10 - position % 10
+
+    def trigger_windows(self, collector, last_watermark, current_watermark):
+        if self.has_no_active_windows():
+            return
+        w = self.get_window(0)
+        while w.end <= current_watermark:
+            collector.trigger(w.start, w.end, self.measure)
+            self.remove_window(0)
+            if self.has_no_active_windows():
+                return
+            w = self.get_window(0)
+
+
+class FakeContextWindow(ForwardContextAware):
+    def __init__(self, measure):
+        self.measure = measure
+
+    def create_context(self):
+        return ScriptedWindowContext(self.measure)
+
+
+@pytest.fixture
+def env():
+    store = LazyAggregateStore()
+    state_factory = MemoryStateFactory()
+    window_manager = WindowManager(state_factory, store)
+    slice_factory = SliceFactory(window_manager, state_factory)
+    slice_manager = SliceManager(slice_factory, store, window_manager)
+    window_manager.add_aggregation(ReduceAggregateFunction(lambda a, b: a + b))
+    return store, window_manager, slice_factory, slice_manager
+
+
+def check_records(values, lazy_slice: LazySlice):
+    actual = [r.ts for r in lazy_slice.records]
+    # the reference helper compares records positionally while records remain
+    # (SliceManagerTest.java:289-295) — i.e. actual must be a prefix of values
+    assert actual == list(values)[: len(actual)]
+    assert len(actual) <= len(values)
+
+
+def check_slice(s, t_start, t_end, t_first, t_last):
+    assert s.t_start == t_start
+    assert s.t_end == t_end
+    assert s.t_first == t_first
+    assert s.t_last == t_last
+
+
+def test_shift_lower_modification(env):
+    store, wm, sf, sm = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    store.append_slice(sf.create_slice_now(0, 10, Flexible()))
+    sm.process_element(1, 1)
+    sm.process_element(1, 4)
+    sm.process_element(1, 8)
+    sm.process_element(1, 9)
+
+    store.append_slice(sf.create_slice_now(10, 20, Flexible()))
+    sm.process_element(1, 14)
+    sm.process_element(1, 19)
+
+    store.append_slice(sf.create_slice_now(20, 30, Flexible()))
+    sm.process_element(1, 24)
+
+    # out-of-order: shift slice start 10->5; move records 8, 9 to next slice
+    sm.process_element(1, 5)
+
+    check_slice(store.get_slice(0), 0, 5, 1, 4)
+    check_slice(store.get_slice(1), 5, 20, 5, 19)
+    check_records([5, 8, 9, 14, 19], store.get_slice(1))
+
+
+def test_shift_higher_modification(env):
+    store, wm, sf, sm = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    store.append_slice(sf.create_slice_now(0, 10, Flexible()))
+    sm.process_element(1, 1)
+
+    store.append_slice(sf.create_slice_now(10, 20, Flexible()))
+    sm.process_element(1, 12)
+    sm.process_element(1, 14)
+    sm.process_element(1, 19)
+
+    store.append_slice(sf.create_slice_now(20, 30, Flexible()))
+    sm.process_element(1, 24)
+
+    # out-of-order: shift slice end 10->15; move records 12, 14 back
+    sm.process_element(1, 15)
+
+    check_slice(store.get_slice(0), 0, 15, 1, 14)
+    check_slice(store.get_slice(1), 15, 20, 15, 19)
+    check_records([1, 12, 14, 15], store.get_slice(0))
+
+
+def test_shift_modification_split(env):
+    store, wm, sf, sm = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    store.append_slice(sf.create_slice_now(0, 10, Flexible(2)))
+    assert not store.get_slice(0).type.is_movable()
+
+    sm.process_element(1, 1)
+    sm.process_element(1, 4)
+    sm.process_element(1, 8)
+    sm.process_element(1, 9)
+
+    store.append_slice(sf.create_slice_now(10, 20, Flexible(2)))
+    sm.process_element(1, 14)
+    sm.process_element(1, 19)
+
+    store.append_slice(sf.create_slice_now(20, 30, Flexible(2)))
+    sm.process_element(1, 24)
+
+    # out-of-order: unmovable edge -> split 0-10 into 0-5 / 5-10
+    sm.process_element(1, 5)
+
+    check_slice(store.get_slice(0), 0, 5, 1, 4)
+    check_slice(store.get_slice(1), 5, 10, 5, 9)
+    check_slice(store.get_slice(2), 10, 20, 14, 19)
+    check_records([5, 8, 9], store.get_slice(1))
+
+
+def test_shift_modification_split_2(env):
+    store, wm, sf, sm = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    store.append_slice(sf.create_slice_now(0, 10, Flexible(2)))
+    assert not store.get_slice(0).type.is_movable()
+
+    sm.process_element(1, 1)
+
+    store.append_slice(sf.create_slice_now(10, 20, Flexible(2)))
+    sm.process_element(1, 12)
+    sm.process_element(1, 14)
+    sm.process_element(1, 17)
+    sm.process_element(1, 19)
+
+    store.append_slice(sf.create_slice_now(20, 30, Flexible(2)))
+    sm.process_element(1, 24)
+
+    # out-of-order: split 10-20 into 10-15 / 15-20
+    sm.process_element(1, 15)
+
+    check_slice(store.get_slice(0), 0, 10, 1, 1)
+    check_slice(store.get_slice(1), 10, 15, 12, 14)
+    check_slice(store.get_slice(2), 15, 20, 15, 19)
+    check_records([15, 17, 19], store.get_slice(2))
+
+
+def test_add_modification_split(env):
+    store, wm, sf, sm = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    store.append_slice(sf.create_slice_now(0, 10, Flexible()))
+    sm.process_element(1, 1)
+
+    store.append_slice(sf.create_slice_now(10, 20, Flexible()))
+    sm.process_element(1, 14)
+    sm.process_element(1, 19)
+
+    store.append_slice(sf.create_slice_now(20, 30, Flexible()))
+    sm.process_element(1, 22)
+    sm.process_element(1, 24)
+    sm.process_element(1, 26)
+    sm.process_element(1, 27)
+
+    # out-of-order: split 20-30 into 20-25 / 25-30
+    sm.process_element(1, 25)
+
+    check_slice(store.get_slice(2), 20, 25, 22, 24)
+    check_slice(store.get_slice(3), 25, 30, 25, 27)
+    check_records([25, 26, 27, 30], store.get_slice(3))
+
+
+def test_delete_modification(env):
+    store, wm, sf, sm = env
+    wm.add_window_assigner(FakeContextWindow(WindowMeasure.Time))
+
+    store.append_slice(sf.create_slice_now(0, 10, Flexible()))
+    sm.process_element(1, 1)
+    store.append_slice(sf.create_slice_now(10, 20, Flexible()))
+    sm.process_element(1, 14)
+    sm.process_element(1, 19)
+    store.append_slice(sf.create_slice_now(20, 30, Flexible()))
+    sm.process_element(1, 24)
+    store.append_slice(sf.create_slice_now(30, 35, Flexible()))
+    sm.process_element(1, 31)
+    sm.process_element(1, 33)
+    store.append_slice(sf.create_slice_now(35, 45, Flexible()))
+    sm.process_element(1, 38)
+
+    sm.process_element(1, 35)  # merge slices 20-30 and 30-35
+
+    check_slice(store.get_slice(2), 20, 35, 24, 33)
+    check_slice(store.get_slice(3), 35, 45, 35, 38)
+    check_records([24, 31, 33], store.get_slice(2))

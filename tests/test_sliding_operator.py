@@ -1,0 +1,177 @@
+"""Sliding-window operator tests — transliterated from
+slicing/src/test/.../windowTest/SlidingWindowOperatorTest.java."""
+
+import pytest
+
+from scotty_tpu import (
+    ReduceAggregateFunction,
+    SlicingWindowOperator,
+    SlidingWindow,
+    TumblingWindow,
+    WindowMeasure,
+)
+
+
+@pytest.fixture
+def op():
+    return SlicingWindowOperator()
+
+
+def sum_fn():
+    return ReduceAggregateFunction(lambda a, b: a + b)
+
+
+def test_in_order(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SlidingWindow(WindowMeasure.Time, 10, 5))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[2].get_agg_values()[0] == 1
+    assert not results[1].has_value()
+    assert results[0].get_agg_values()[0] == 2
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 5  # 45 - 55
+    assert results[1].get_agg_values()[0] == 5  # 40 - 50
+    assert results[2].get_agg_values()[0] == 4  # 35 - 45
+    assert results[3].get_agg_values()[0] == 4  # 30 - 40
+    assert results[4].get_agg_values()[0] == 3  # 25 - 35
+    assert results[5].get_agg_values()[0] == 3  # 20 - 30
+    assert results[6].get_agg_values()[0] == 2  # 15 - 25
+
+
+def test_in_order_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SlidingWindow(WindowMeasure.Time, 10, 5))
+    op.process_element(1, 0)
+    op.process_element(2, 0)
+    op.process_element(3, 20)
+    op.process_element(4, 30)
+    op.process_element(5, 40)
+
+    results = op.process_watermark(22)
+    assert not results[0].has_value()              # 10 - 20
+    assert not results[1].has_value()              # 5 - 15
+    assert results[2].get_agg_values()[0] == 3     # 0 - 10
+
+    results = op.process_watermark(55)
+    assert not results[0].has_value()              # 45 - 55
+    assert results[1].get_agg_values()[0] == 5     # 40 - 50
+    assert results[2].get_agg_values()[0] == 5     # 35 - 45
+    assert results[3].get_agg_values()[0] == 4     # 30 - 40
+    assert results[4].get_agg_values()[0] == 4     # 25 - 35
+    assert results[5].get_agg_values()[0] == 3     # 20 - 30
+    assert results[6].get_agg_values()[0] == 3     # 15 - 25
+
+
+def test_in_order_two_windows(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SlidingWindow(WindowMeasure.Time, 10, 5))
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 20))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 2     # 10 - 20
+    assert not results[1].has_value()              # 5 - 15
+    assert results[2].get_agg_values()[0] == 1     # 0 - 10
+    assert results[3].get_agg_values()[0] == 3     # 0 - 20
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 5     # 45 - 55
+    assert results[1].get_agg_values()[0] == 5     # 40 - 50
+    assert results[2].get_agg_values()[0] == 4     # 35 - 45
+    assert results[3].get_agg_values()[0] == 4     # 30 - 40
+    assert results[4].get_agg_values()[0] == 3     # 25 - 35
+    assert results[5].get_agg_values()[0] == 3     # 20 - 30
+    assert results[6].get_agg_values()[0] == 2     # 15 - 25
+    assert results[7].get_agg_values()[0] == 7     # 20 - 40
+
+
+def test_in_order_two_windows_dynamic(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SlidingWindow(WindowMeasure.Time, 10, 5))
+
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 20))
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 2
+    assert not results[1].has_value()
+    assert results[2].get_agg_values()[0] == 1
+    assert results[3].get_agg_values()[0] == 3
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 5
+    assert results[1].get_agg_values()[0] == 5
+    assert results[2].get_agg_values()[0] == 4
+    assert results[3].get_agg_values()[0] == 4
+    assert results[4].get_agg_values()[0] == 3
+    assert results[5].get_agg_values()[0] == 3
+    assert results[6].get_agg_values()[0] == 2
+    assert results[7].get_agg_values()[0] == 7
+
+
+def test_in_order_two_windows_dynamic_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 20))
+
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 3
+
+    op.add_window_assigner(SlidingWindow(WindowMeasure.Time, 10, 5))
+
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 7
+    assert results[1].get_agg_values()[0] == 5
+    assert results[2].get_agg_values()[0] == 5
+    assert results[3].get_agg_values()[0] == 4
+    assert results[4].get_agg_values()[0] == 4
+    assert results[5].get_agg_values()[0] == 3
+    assert results[6].get_agg_values()[0] == 3
+
+
+def test_out_of_order(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(SlidingWindow(WindowMeasure.Time, 10, 5))
+    op.process_element(1, 1)
+
+    op.process_element(1, 30)
+    op.process_element(1, 20)
+    op.process_element(1, 23)
+    op.process_element(1, 25)
+
+    op.process_element(1, 45)
+
+    results = op.process_watermark(22)
+    assert not results[0].has_value()              # 10 - 20
+    assert not results[1].has_value()              # 5 - 15
+    assert results[2].get_agg_values()[0] == 1     # 0 - 10
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 1     # 45 - 55
+    assert results[1].get_agg_values()[0] == 1     # 40 - 50
+    assert not results[2].has_value()              # 35 - 45
+    assert results[3].get_agg_values()[0] == 1     # 30 - 40
+    assert results[4].get_agg_values()[0] == 2     # 25 - 35
+    assert results[5].get_agg_values()[0] == 3     # 20 - 30
+    assert results[6].get_agg_values()[0] == 2     # 15 - 25

@@ -1,0 +1,226 @@
+"""Tumbling-window operator tests — transliterated from the reference suite
+(slicing/src/test/.../windowTest/TumblingWindowOperatorTest.java). These are
+the golden scripted-stream tests: sequences of (value, ts) + watermark points
+with hand-computed results."""
+
+import pytest
+
+from scotty_tpu import (
+    ReduceAggregateFunction,
+    SlicingWindowOperator,
+    TumblingWindow,
+    WindowMeasure,
+)
+
+
+@pytest.fixture
+def op():
+    return SlicingWindowOperator()
+
+
+def sum_fn():
+    return ReduceAggregateFunction(lambda a, b: a + b)
+
+
+def test_in_order(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 1
+    assert results[1].get_agg_values()[0] == 2
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+    assert results[1].get_agg_values()[0] == 4
+    assert results[2].get_agg_values()[0] == 5
+
+
+def test_in_order_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 0)
+    op.process_element(2, 0)
+    op.process_element(3, 20)
+    op.process_element(4, 30)
+    op.process_element(5, 40)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 3
+    assert not results[1].has_value()
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+    assert results[1].get_agg_values()[0] == 4
+    assert results[2].get_agg_values()[0] == 5
+
+
+def test_in_order_two_windows(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 20))
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 1
+    assert results[1].get_agg_values()[0] == 2
+    assert results[2].get_agg_values()[0] == 3
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+    assert results[1].get_agg_values()[0] == 4
+    assert results[2].get_agg_values()[0] == 5
+    assert results[3].get_agg_values()[0] == 7
+
+
+def test_in_order_two_windows_dynamic(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 20))
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 1
+    assert results[1].get_agg_values()[0] == 2
+    assert results[2].get_agg_values()[0] == 3
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+    assert results[1].get_agg_values()[0] == 4
+    assert results[2].get_agg_values()[0] == 5
+    assert results[3].get_agg_values()[0] == 7
+
+
+def test_in_order_two_windows_dynamic_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 20))
+
+    op.process_element(1, 1)
+    op.process_element(2, 19)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 3
+
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+    op.process_element(3, 29)
+    op.process_element(4, 39)
+    op.process_element(5, 49)
+
+    results = op.process_watermark(55)
+    assert results[1].get_agg_values()[0] == 3
+    assert results[2].get_agg_values()[0] == 4
+    assert results[3].get_agg_values()[0] == 5
+    assert results[0].get_agg_values()[0] == 7
+
+
+def test_out_of_order(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+    op.process_element(1, 1)
+
+    op.process_element(1, 30)
+    op.process_element(1, 20)
+    op.process_element(1, 23)
+    op.process_element(1, 25)
+
+    op.process_element(1, 45)
+
+    results = op.process_watermark(22)
+    assert results[0].get_agg_values()[0] == 1
+    assert not results[1].has_value()
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+    assert results[1].get_agg_values()[0] == 1
+    assert results[2].get_agg_values()[0] == 1
+
+
+def test_in_order_count(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
+    op.process_element(1, 1)
+    op.process_element(1, 19)
+    op.process_element(1, 29)
+    op.process_element(2, 39)
+    op.process_element(2, 49)
+    op.process_element(2, 50)
+    op.process_element(1, 51)
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 3
+    assert results[1].get_agg_values()[0] == 6
+
+
+def test_out_of_order_count(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
+    op.process_element(1, 1)
+    op.process_element(1, 19)
+    op.process_element(1, 29)
+    op.process_element(2, 39)
+    # out of order
+    op.process_element(2, 10)
+    op.process_element(2, 50)
+    op.process_element(1, 51)
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 4
+    assert results[1].get_agg_values()[0] == 5
+
+
+def test_out_of_order_count_2(op):
+    op.add_window_function(sum_fn())
+    op.add_window_function(ReduceAggregateFunction(lambda a, b: a - b))
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 5))
+    op.process_element(1, 1)
+    op.process_element(1, 19)
+    op.process_element(1, 29)
+    op.process_element(2, 39)
+    op.process_element(1, 41)
+    # out of order
+    op.process_element(2, 10)
+    op.process_element(2, 50)
+    op.process_element(1, 51)
+    op.process_element(3, 52)
+
+    results = op.process_watermark(55)
+    assert results[0].get_agg_values()[0] == 4
+    assert results[1].get_agg_values()[0] == 4
+    assert results[2].get_agg_values()[0] == 6
+    assert results[3].get_agg_values()[0] == 7
+
+
+def test_out_of_order_count_3(op):
+    op.add_window_function(sum_fn())
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 5))
+    op.process_element(1, 1)
+    op.process_element(1, 19)
+    op.process_element(1, 29)
+    op.process_element(2, 39)
+    op.process_element(1, 41)
+    # out of order
+    op.process_element(2, 10)
+
+    results = op.process_watermark(30)
+    assert results[0].get_agg_values()[0] == 4
+
+    op.process_element(2, 50)
+    op.process_element(1, 51)
+    op.process_element(3, 52)
+    op.process_watermark(55)

@@ -1,0 +1,20 @@
+"""Assertion helpers mirroring the reference's WindowAssert
+(slicing/src/test/.../windowTest/WindowAssert.java:10-24)."""
+
+from __future__ import annotations
+
+
+def assert_window(window, start, end, value):
+    assert window.get_start() == start, f"start {window.get_start()} != {start} ({window})"
+    assert window.get_end() == end, f"end {window.get_end()} != {end} ({window})"
+    assert window.get_agg_values()[0] == value, (
+        f"value {window.get_agg_values()} != {value} ({window})")
+
+
+def assert_contains(windows, start, end, value):
+    for w in windows:
+        if (w.get_start() == start and w.get_end() == end
+                and w.has_value() and w.get_agg_values()[0] == value):
+            return
+    raise AssertionError(
+        f"no window ({start},{end},{value}) in {[repr(w) for w in windows]}")
