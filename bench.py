@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Headline benchmark: the reference's sliding-window suite at its hardest
+point — 60 s window, 1 ms slide ⇒ 60,000 concurrent sliding windows, sum
+aggregation, watermark every event-second (reference config
+benchmark/configurations/sliding_benchmark_Scotty.json; BASELINE.md
+north-star: ≥50 M tuples/s/chip, ≥10× the reference's 1.7 M tuples/s/core
+offered load; ~5 M/s Flink-bucket-style baseline).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+
+REFERENCE_SCOTTY_RATE = 1_700_000   # tuples/s/core offered load the reference
+                                    # Scotty suite sustains (BASELINE.md)
+
+
+def main() -> None:
+    from scotty_tpu.bench import BenchmarkConfig, run_benchmark
+
+    cfg = BenchmarkConfig(
+        name="sliding-60k",
+        throughput=8 * (1 << 21),       # ~16.8M tuples over runtime
+        runtime_s=8,
+        watermark_period_ms=1000,
+        batch_size=1 << 18,
+        capacity=1 << 17,
+    )
+    res = run_benchmark(cfg, "Sliding(60000,1)", "sum", engine="TpuEngine",
+                        warmup_batches=2)
+    out = {
+        "metric": "sliding_60k_concurrent_windows_sum_throughput",
+        "value": round(res.tuples_per_sec),
+        "unit": "tuples/s/chip",
+        "vs_baseline": round(res.tuples_per_sec / REFERENCE_SCOTTY_RATE, 2),
+        "p99_window_emit_ms": round(res.p99_emit_ms, 2),
+        "windows_emitted": res.n_windows_emitted,
+        "tuples": res.n_tuples,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

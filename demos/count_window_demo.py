@@ -1,0 +1,20 @@
+"""Count-measure tumbling window (every 1000 tuples) — the
+FlinkSumCountWindowDemo pipeline (demo/flink-demo combined listing :130-153)."""
+
+from data_generator import keyed_stream
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.connectors import KeyedScottyWindowOperator, run_keyed
+
+
+def main():
+    op = (KeyedScottyWindowOperator()
+          .add_window(TumblingWindow(WindowMeasure.Count, 1000))
+          .add_aggregation(SumAggregation())
+          .with_allowed_lateness(1000))
+    for key, window in run_keyed(keyed_stream(n=20_000, n_keys=2), op):
+        print(f"{key}: {window!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Shared demo data generator (the reference's per-engine DemoSource /
+DataGeneratorSource / DataGeneratorSpout equivalents, SURVEY.md §2.6):
+random keyed tuples with event-time, optional bounded disorder and session
+gaps."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def keyed_stream(n: int = 10_000, n_keys: int = 4, seed: int = 0,
+                 ms_per_tuple: float = 1.0, disorder_ms: int = 0,
+                 session_gap_every: int = 0, session_gap_ms: int = 0):
+    """Yield (key, value, ts) tuples with ascending (or boundedly disordered)
+    event time."""
+    rng = np.random.default_rng(seed)
+    ts = 0.0
+    for i in range(n):
+        ts += rng.exponential(ms_per_tuple)
+        if session_gap_every and i and i % session_gap_every == 0:
+            ts += session_gap_ms
+        t = int(ts)
+        if disorder_ms:
+            t = max(0, t - int(rng.integers(0, disorder_ms)))
+        yield (f"key-{int(rng.integers(0, n_keys))}",
+               int(rng.integers(1, 100)), t)
+
+
+def value_stream(n: int = 10_000, seed: int = 0, ms_per_tuple: float = 1.0):
+    for _, v, t in keyed_stream(n, 1, seed, ms_per_tuple):
+        yield v, t

@@ -1,0 +1,26 @@
+"""Keyed sum over tumbling 2 s + sliding 5 s / 1 s windows on a random
+source — the FlinkSumDemo pipeline (demo/flink-demo/.../FlinkSumDemo.java:13-39)
+on the iterable connector."""
+
+from data_generator import keyed_stream
+
+from scotty_tpu import (SlidingWindow, SumAggregation, TimeMeasure,
+                        TumblingWindow, WindowMeasure)
+from scotty_tpu.connectors import KeyedScottyWindowOperator, run_keyed
+
+
+def main():
+    op = (KeyedScottyWindowOperator()
+          .add_window(TumblingWindow(WindowMeasure.Time,
+                                     TimeMeasure.seconds(2).to_milliseconds()))
+          .add_window(SlidingWindow(WindowMeasure.Time,
+                                    TimeMeasure.seconds(5).to_milliseconds(),
+                                    TimeMeasure.seconds(1).to_milliseconds()))
+          .add_aggregation(SumAggregation())
+          .with_allowed_lateness(100))
+    for key, window in run_keyed(keyed_stream(n=20_000, ms_per_tuple=2.0), op):
+        print(f"{key}: {window!r}")
+
+
+if __name__ == "__main__":
+    main()

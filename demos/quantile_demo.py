@@ -1,0 +1,36 @@
+"""Quantile windows two ways — exact host quantile (the reference's
+QuantileTreeMap holistic aggregate, demo/flink-demo/.../QuantileWindowFunction.java:98-135)
+and the fixed-width DDSketch device realization (SURVEY.md §7's
+capability-preserving substitute)."""
+
+from data_generator import value_stream
+
+from scotty_tpu import (DDSketchQuantileAggregation, QuantileAggregation,
+                        SlicingWindowOperator, TumblingWindow, WindowMeasure)
+from scotty_tpu.engine import TpuWindowOperator
+
+
+def main():
+    host = SlicingWindowOperator()
+    host.add_window_assigner(TumblingWindow(WindowMeasure.Time, 1000))
+    host.add_aggregation(QuantileAggregation(0.5))
+
+    dev = TpuWindowOperator()
+    dev.add_window_assigner(TumblingWindow(WindowMeasure.Time, 1000))
+    dev.add_aggregation(DDSketchQuantileAggregation(0.5, alpha=0.01))
+
+    stream = list(value_stream(n=20_000, ms_per_tuple=0.5))
+    for v, t in stream:
+        host.process_element(v, t)
+    dev.process_elements([v for v, _ in stream], [t for _, t in stream])
+
+    wm = stream[-1][1] + 1
+    for hw, dw in zip(host.process_watermark(wm), dev.process_watermark(wm)):
+        if hw.has_value():
+            print(f"[{hw.get_start()},{hw.get_end()}) exact-median="
+                  f"{hw.get_agg_values()[0]} ddsketch-median="
+                  f"{dw.get_agg_values()[0]:.2f}")
+
+
+if __name__ == "__main__":
+    main()

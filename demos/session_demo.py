@@ -1,0 +1,23 @@
+"""Session windows with gaps in the generator — the reference's session demo
+patterns (SessionWindow usage in demo pipelines + benchmark sessionConfig,
+BenchmarkRunner.java:174-192)."""
+
+from data_generator import keyed_stream
+
+from scotty_tpu import SessionWindow, SumAggregation, WindowMeasure
+from scotty_tpu.connectors import KeyedScottyWindowOperator, run_keyed
+
+
+def main():
+    op = (KeyedScottyWindowOperator()
+          .add_window(SessionWindow(WindowMeasure.Time, 500))
+          .add_aggregation(SumAggregation())
+          .with_allowed_lateness(100))
+    src = keyed_stream(n=10_000, n_keys=2, ms_per_tuple=5.0,
+                       session_gap_every=500, session_gap_ms=2000)
+    for key, window in run_keyed(src, op):
+        print(f"{key}: session {window!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""The TPU engine head-on: 60 s sliding window with 100 ms slide (600
+concurrent windows) + multi-aggregate, batched device ingest — the pipeline
+shape of the reference's headline sliding benchmark
+(benchmark/configurations/sliding_benchmark_Scotty.json) as a demo."""
+
+import numpy as np
+
+from scotty_tpu import (MaxAggregation, MeanAggregation, MinAggregation,
+                        SlidingWindow, SumAggregation, WindowMeasure)
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+from scotty_tpu.utils import ThroughputLogger
+
+
+def main():
+    op = TpuWindowOperator(config=EngineConfig(capacity=1 << 14,
+                                               batch_size=1 << 14))
+    op.add_window_assigner(SlidingWindow(WindowMeasure.Time, 60_000, 100))
+    for agg in (SumAggregation(), MinAggregation(), MaxAggregation(),
+                MeanAggregation()):
+        op.add_aggregation(agg)
+
+    rng = np.random.default_rng(0)
+    logger = ThroughputLogger(log_every=1 << 18, sink=print)
+    n_batches, B = 64, 1 << 14
+    ts0 = 0
+    for i in range(n_batches):
+        span = 2_000                          # 2 event-seconds per batch
+        ts = np.sort(rng.integers(ts0, ts0 + span, size=B)).astype(np.int64)
+        vals = rng.random(B).astype(np.float32) * 100
+        op.process_elements(vals, ts)
+        logger.observe(B)
+        ts0 += span
+        if i % 4 == 3:
+            ws, we, cnt, lowered = op.process_watermark_arrays(ts0)
+            n = int((cnt > 0).sum())
+            print(f"watermark {ts0}: {len(ws)} windows triggered, "
+                  f"{n} non-empty, slices={op.n_slices}")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,10 +48,33 @@ from .core import (
     WindowMeasure,
     WindowOperator,
 )
+from .hybrid import HybridWindowOperator
 from .simulator import SlicingWindowOperator
 from .state import MemoryStateFactory, StateFactory
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # heavy submodules load lazily so `import scotty_tpu` stays cheap and
+    # jax-free until an operator is actually built.
+    if name == "TpuWindowOperator":
+        from .engine import TpuWindowOperator
+
+        return TpuWindowOperator
+    if name == "EngineConfig":
+        from .engine import EngineConfig
+
+        return EngineConfig
+    if name == "KeyedTpuWindowOperator":
+        from .parallel import KeyedTpuWindowOperator
+
+        return KeyedTpuWindowOperator
+    if name == "GlobalTpuWindowOperator":
+        from .parallel import GlobalTpuWindowOperator
+
+        return GlobalTpuWindowOperator
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "AggregateFunction", "AggregateWindow", "CountAggregation",
@@ -61,4 +84,6 @@ __all__ = [
     "SessionWindow", "SlidingWindow", "SumAggregation", "TimeMeasure",
     "TumblingWindow", "Window", "WindowMeasure", "WindowOperator",
     "SlicingWindowOperator", "MemoryStateFactory", "StateFactory",
+    "HybridWindowOperator", "TpuWindowOperator", "EngineConfig",
+    "KeyedTpuWindowOperator", "GlobalTpuWindowOperator",
 ]

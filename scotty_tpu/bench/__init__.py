@@ -1,0 +1,17 @@
+"""Benchmark harness (reference benchmark/ module parity, SURVEY.md §2.5)."""
+
+from .harness import (
+    BenchmarkConfig,
+    BenchResult,
+    ThroughputStatistics,
+    generate_batches,
+    make_aggregation,
+    parse_window_spec,
+    run_benchmark,
+)
+
+__all__ = [
+    "BenchmarkConfig", "BenchResult", "ThroughputStatistics",
+    "generate_batches", "make_aggregation", "parse_window_spec",
+    "run_benchmark",
+]

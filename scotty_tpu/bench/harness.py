@@ -1,0 +1,393 @@
+"""Config-driven throughput harness.
+
+Mirrors the reference benchmark module (SURVEY.md §2.5): BenchmarkRunner's
+JSON configs with the window-spec string DSL (benchmark/.../BenchmarkRunner.java:96-171),
+LoadGeneratorSource (:10-87), ThroughputLogger/ThroughputStatistics (:24-49,
+:3-44) — re-designed for batched device execution: the generator produces
+event-time batches, the logger samples tuples/s per batch interval, and the
+runner reports mean throughput + p99 window-emit latency per configuration.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.aggregates import (
+    BUILTIN_AGGREGATIONS,
+    AggregateFunction,
+    CountAggregation,
+    DDSketchQuantileAggregation,
+    HyperLogLogAggregation,
+    MaxAggregation,
+    MeanAggregation,
+    MinAggregation,
+    SumAggregation,
+)
+from ..core.windows import (
+    FixedBandWindow,
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+    Window,
+    WindowMeasure,
+)
+
+
+# ---------------------------------------------------------------------------
+# Window-spec DSL (BenchmarkRunner.java:96-171)
+# ---------------------------------------------------------------------------
+
+_SPEC_RE = re.compile(r"^\s*(\w+)\s*\(([^)]*)\)\s*$")
+
+
+def parse_window_spec(spec: str, seed: int = 0) -> List[Window]:
+    """Parse the reference's window-spec strings:
+
+    ``Tumbling(size)``, ``Sliding(size,slide)``, ``Session(gap)``,
+    ``FixedBand(start,size)``, ``CountTumbling(size)``,
+    ``randomTumbling(n,min,max)``, ``RandomSession(n,min,max)``,
+    ``randomCount(n,min,max)`` — random variants use a fixed seed like the
+    reference (BenchmarkRunner.java:96-171).
+    """
+    m = _SPEC_RE.match(spec)
+    if not m:
+        raise ValueError(f"bad window spec: {spec!r}")
+    name, args_s = m.group(1), m.group(2)
+    args = [int(a) for a in args_s.replace(" ", "").split(",") if a]
+    T, C = WindowMeasure.Time, WindowMeasure.Count
+    rng = np.random.default_rng(seed)
+    name_l = name.lower()
+    if name_l == "tumbling":
+        return [TumblingWindow(T, args[0])]
+    if name_l == "sliding":
+        return [SlidingWindow(T, args[0], args[1])]
+    if name_l == "session":
+        return [SessionWindow(T, args[0])]
+    if name_l == "fixedband":
+        return [FixedBandWindow(T, args[0], args[1])]
+    if name_l == "counttumbling":
+        return [TumblingWindow(C, args[0])]
+    if name_l == "countsliding":
+        return [SlidingWindow(C, args[0], args[1])]
+    if name_l == "randomtumbling":
+        n, lo, hi = args
+        return [TumblingWindow(T, int(rng.integers(lo, hi)))
+                for _ in range(n)]
+    if name_l == "randomsession":
+        n, lo, hi = args
+        return [SessionWindow(T, int(rng.integers(lo, hi))) for _ in range(n)]
+    if name_l == "randomcount":
+        n, lo, hi = args
+        return [TumblingWindow(C, int(rng.integers(lo, hi)))
+                for _ in range(n)]
+    raise ValueError(f"unknown window spec {name!r}")
+
+
+def make_aggregation(name: str) -> AggregateFunction:
+    """Aggregation factory by config name (benchmark aggFunctions)."""
+    key = name.lower()
+    table = {
+        "sum": SumAggregation, "count": CountAggregation,
+        "min": MinAggregation, "max": MaxAggregation,
+        "mean": MeanAggregation,
+    }
+    if key in table:
+        return table[key]()
+    if key in ("quantile", "ddsketch"):
+        return DDSketchQuantileAggregation(0.5)
+    if key in ("hll", "distinct"):
+        return HyperLogLogAggregation(8)
+    raise ValueError(f"unknown aggregation {name!r} "
+                     f"(known: {sorted(BUILTIN_AGGREGATIONS)})")
+
+
+# ---------------------------------------------------------------------------
+# Config (BenchmarkConfig.java:8-29)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BenchmarkConfig:
+    name: str = "bench"
+    throughput: int = 10_000_000           # offered tuples per event-second
+    runtime_s: int = 10                    # event-time seconds to simulate
+    window_configurations: List[str] = field(default_factory=list)
+    configurations: List[str] = field(default_factory=lambda: ["TpuEngine"])
+    agg_functions: List[str] = field(default_factory=lambda: ["sum"])
+    watermark_period_ms: int = 1000
+    batch_size: int = 1 << 15
+    capacity: int = 1 << 17
+    n_keys: int = 1
+    out_of_order_pct: float = 0.0
+    max_lateness: int = 1000
+    seed: int = 42
+
+    @staticmethod
+    def from_json(path: str) -> "BenchmarkConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        return BenchmarkConfig(
+            name=raw.get("name", "bench"),
+            throughput=raw.get("throughput", 10_000_000),
+            runtime_s=raw.get("runtime", raw.get("runtime_s", 10)),
+            window_configurations=raw.get("windowConfigurations", []),
+            configurations=raw.get("configurations", ["TpuEngine"]),
+            agg_functions=raw.get("aggFunctions", ["sum"]),
+            watermark_period_ms=raw.get("watermarkPeriodMs", 1000),
+            batch_size=raw.get("batchSize", 1 << 15),
+            capacity=raw.get("capacity", 1 << 17),
+            n_keys=raw.get("nKeys", 1),
+            out_of_order_pct=raw.get("outOfOrderPct", 0.0),
+            max_lateness=raw.get("maxLateness", 1000),
+            seed=raw.get("seed", 42),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Load generator (LoadGeneratorSource.java:10-87, device-batch edition)
+# ---------------------------------------------------------------------------
+
+
+def generate_batches(cfg: BenchmarkConfig):
+    """Pre-generate the whole stream as numpy batches: values f32, event-time
+    ms i64 (ascending, with optional bounded disorder), watermark points every
+    ``watermark_period_ms`` of event time."""
+    rng = np.random.default_rng(cfg.seed)
+    n_total = cfg.throughput * cfg.runtime_s
+    B = cfg.batch_size
+    n_batches = max(1, n_total // B)
+    span_ms = cfg.runtime_s * 1000
+    batches = []
+    per_batch_span = span_ms / n_batches
+    for i in range(n_batches):
+        lo = i * per_batch_span
+        ts = np.sort(rng.integers(int(lo), int(lo + per_batch_span),
+                                  size=B)).astype(np.int64)
+        if cfg.out_of_order_pct > 0:
+            late = rng.random(B) < cfg.out_of_order_pct
+            ts = np.where(
+                late, np.maximum(ts - rng.integers(
+                    0, cfg.max_lateness, size=B), 0), ts).astype(np.int64)
+        vals = rng.integers(1, 10_000, size=B).astype(np.float32)
+        batches.append((vals, ts))
+    return batches
+
+
+def make_device_source(cfg: BenchmarkConfig):
+    """Device-resident load generator — the TPU-native analogue of the
+    reference's in-process LoadGeneratorSource (LoadGeneratorSource.java:10-87):
+    tuples are synthesized on-chip (sorted event times via a cumulative-gap
+    construction — no device sort needed), so host→device bandwidth never
+    bounds the measured operator throughput, exactly as the reference's
+    generator never crosses a process boundary.
+
+    Returns ``gen(i) -> (vals_dev, ts_dev, ts_min, ts_max)`` for batch i.
+    """
+    from .. import jax_config  # noqa: F401  (x64 before tracing)
+    import jax
+    import jax.numpy as jnp
+
+    B = cfg.batch_size
+    n_total = cfg.throughput * cfg.runtime_s
+    n_batches = max(1, n_total // B)
+    span_ms = max(1, cfg.runtime_s * 1000 // n_batches)
+
+    @jax.jit
+    def _gen(key, lo):
+        gaps = jax.random.uniform(key, (B,), dtype=jnp.float32)
+        gaps = gaps / jnp.sum(gaps) * span_ms
+        ts = lo + jnp.cumsum(gaps).astype(jnp.int64)
+        ts = jnp.minimum(ts, lo + span_ms - 1)
+        vals = jax.random.uniform(key, (B,), dtype=jnp.float32) * 10_000
+        return vals, ts
+
+    root = jax.random.PRNGKey(cfg.seed)
+
+    def gen(i: int):
+        vals, ts = _gen(jax.random.fold_in(root, i), np.int64(i * span_ms))
+        return vals, ts, i * span_ms, (i + 1) * span_ms - 1
+
+    gen.n_batches = n_batches
+    gen.span_ms = span_ms
+    return gen
+
+
+# ---------------------------------------------------------------------------
+# Throughput statistics (ThroughputStatistics.java:3-44)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ThroughputStatistics:
+    tuples: int = 0
+    seconds: float = 0.0
+    emit_latencies_ms: List[float] = field(default_factory=list)
+
+    @property
+    def mean_throughput(self) -> float:
+        return self.tuples / self.seconds if self.seconds else 0.0
+
+    def p99_emit_latency_ms(self) -> float:
+        if not self.emit_latencies_ms:
+            return 0.0
+        return float(np.percentile(self.emit_latencies_ms, 99))
+
+
+@dataclass
+class BenchResult:
+    name: str
+    windows: str
+    aggregation: str
+    tuples_per_sec: float
+    p99_emit_ms: float
+    n_windows_emitted: int
+    n_tuples: int
+    wall_s: float
+
+    def to_dict(self):
+        return {
+            "name": self.name, "windows": self.windows,
+            "aggregation": self.aggregation,
+            "tuples_per_sec": self.tuples_per_sec,
+            "p99_emit_ms": self.p99_emit_ms,
+            "windows_emitted": self.n_windows_emitted,
+            "tuples": self.n_tuples, "wall_s": self.wall_s,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Runner (BenchmarkRunner.java:20-202)
+# ---------------------------------------------------------------------------
+
+
+def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
+                  engine: str = "TpuEngine",
+                  warmup_batches: int = 2) -> BenchResult:
+    """One (window-config × aggregation × engine) cell: feed the whole
+    generated stream, watermark every ``watermark_period_ms`` event-ms,
+    report mean tuples/s + p99 window-emit latency."""
+    import jax
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    device_source = engine == "TpuEngine" and cfg.out_of_order_pct == 0
+    if device_source:
+        gen = make_device_source(cfg)
+        batches = None
+    else:
+        batches = generate_batches(cfg)
+
+    if engine == "TpuEngine":
+        from ..engine import EngineConfig, TpuWindowOperator
+
+        op = TpuWindowOperator(config=EngineConfig(
+            capacity=cfg.capacity, batch_size=cfg.batch_size))
+    elif engine == "Simulator":
+        from ..simulator import SlicingWindowOperator
+
+        op = SlicingWindowOperator()
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(make_aggregation(agg_name))
+    op.set_max_lateness(cfg.max_lateness)
+
+    # warmup: compile ingest + query + gc paths on a throwaway twin
+    if engine == "TpuEngine" and warmup_batches > 0:
+        from ..engine import EngineConfig, TpuWindowOperator
+
+        twin = TpuWindowOperator(config=EngineConfig(
+            capacity=cfg.capacity, batch_size=cfg.batch_size))
+        for w in windows:
+            twin.add_window_assigner(w)
+        twin.add_aggregation(make_aggregation(agg_name))
+        twin.set_max_lateness(cfg.max_lateness)
+        if device_source:
+            last = 0
+            for i in range(warmup_batches):
+                vals, ts, lo, hi = gen(i)
+                twin.ingest_device_batch(vals, ts, lo, hi)
+                last = hi
+            twin.process_watermark_async(last + 1)
+            twin.process_watermark_async(last + cfg.watermark_period_ms + 1)
+            jax.block_until_ready(twin._state.starts)
+        else:
+            for vals, ts in batches[:warmup_batches]:
+                twin.process_elements(vals, ts)
+            twin.process_watermark(int(batches[warmup_batches - 1][1][-1]) + 1)
+            twin.process_watermark(int(batches[warmup_batches - 1][1][-1])
+                                   + cfg.watermark_period_ms + 1)
+
+    stats = ThroughputStatistics()
+    n_emitted = 0
+    next_wm = cfg.watermark_period_ms
+    n_tuples = 0
+    pending = []                 # (T, cnt_dev) handles, fetched at drain
+    t0 = time.perf_counter()
+    if device_source:
+        for i in range(gen.n_batches):
+            vals, ts, lo, hi = gen(i)
+            op.ingest_device_batch(vals, ts, lo, hi)
+            n_tuples += cfg.batch_size
+            while hi >= next_wm:
+                t_wm = time.perf_counter()
+                out = op.process_watermark_async(next_wm)
+                if out[3] is not None:
+                    pending.append((out[0].shape[0], out[3]))
+                stats.emit_latencies_ms.append(
+                    (time.perf_counter() - t_wm) * 1e3)
+                next_wm += cfg.watermark_period_ms
+        batches = []
+    for vals, ts in batches:
+        if engine == "TpuEngine":
+            op.process_elements(vals, ts)
+        else:
+            for v, t in zip(vals, ts):
+                op.process_element(float(v), int(t))
+        n_tuples += len(vals)
+        last_ts = int(ts[-1])
+        while last_ts >= next_wm:
+            t_wm = time.perf_counter()
+            if engine == "TpuEngine":
+                # async path: zero device→host syncs per watermark; result
+                # handles drain at the end (the emit contract is columnar)
+                out = op.process_watermark_async(next_wm)
+                if out[3] is not None:
+                    pending.append((out[0].shape[0], out[3]))
+            else:
+                results = op.process_watermark(next_wm)
+                n_emitted += sum(1 for r in results if r.has_value())
+            stats.emit_latencies_ms.append(
+                (time.perf_counter() - t_wm) * 1e3)
+            next_wm += cfg.watermark_period_ms
+    # drain: one final watermark past the stream end + bundled result fetch
+    t_wm = time.perf_counter()
+    if engine == "TpuEngine":
+        out = op.process_watermark_async(next_wm)
+        if out[0] is not None and out[3] is not None \
+                and not isinstance(out[0], str):
+            pending.append((out[0].shape[0], out[3]))
+        fetched = jax.device_get([c for _, c in pending])
+        for (T, _), cnt in zip(pending, fetched):
+            n_emitted += int((cnt[:T] > 0).sum())
+        op.check_overflow()
+    else:
+        results = op.process_watermark(next_wm)
+        n_emitted += sum(1 for r in results if r.has_value())
+    stats.emit_latencies_ms.append((time.perf_counter() - t_wm) * 1e3)
+    wall = time.perf_counter() - t0
+
+    stats.tuples = n_tuples
+    stats.seconds = wall
+    return BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=stats.mean_throughput,
+        p99_emit_ms=stats.p99_emit_latency_ms(),
+        n_windows_emitted=n_emitted, n_tuples=n_tuples, wall_s=wall)

@@ -1,0 +1,19 @@
+"""Host connectors (reference L3 parity, SURVEY.md §2.4): thin adapters from
+host stream sources to the windowing operators. Six adapters mirror the
+reference's six engine connectors: iterable / asyncio / torchdata are live;
+kafka / beam / spark are import-gated on their host libraries."""
+
+from .base import (
+    AscendingWatermarks,
+    GlobalScottyWindowOperator,
+    KeyedScottyWindowOperator,
+    PeriodicWatermarks,
+    WatermarkPolicy,
+)
+from .iterable import collect_global, collect_keyed, run_global, run_keyed
+
+__all__ = [
+    "AscendingWatermarks", "GlobalScottyWindowOperator",
+    "KeyedScottyWindowOperator", "PeriodicWatermarks", "WatermarkPolicy",
+    "collect_global", "collect_keyed", "run_global", "run_keyed",
+]

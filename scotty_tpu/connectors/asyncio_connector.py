@@ -1,0 +1,38 @@
+"""Asyncio connector: windowed aggregation over async streams.
+
+The streaming-Python analogue of the reference's push-based engine
+connectors (Samza StreamTask / Kafka Processor callbacks, SURVEY.md §2.4):
+an async task consumes ``(key, value, ts)`` items from an ``asyncio.Queue``
+or async iterator and emits window results to a callback as watermarks fire.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Awaitable, Callable, Optional, Tuple
+
+from .base import KeyedScottyWindowOperator
+
+
+async def run_keyed_async(
+        source: AsyncIterator[Tuple],
+        operator: KeyedScottyWindowOperator,
+        emit: Callable[[Tuple], Optional[Awaitable]],
+) -> None:
+    """Consume (key, value, ts) from an async iterator; call ``emit`` for
+    every (key, AggregateWindow) result. ``emit`` may be sync or async."""
+    async for key, value, ts in source:
+        for item in operator.process_element(key, value, int(ts)):
+            r = emit(item)
+            if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
+                await r
+
+
+async def queue_source(queue: "asyncio.Queue", sentinel=None):
+    """Adapt an asyncio.Queue into an async iterator (terminates on
+    ``sentinel``)."""
+    while True:
+        item = await queue.get()
+        if item is sentinel:
+            return
+        yield item

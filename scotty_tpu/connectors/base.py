@@ -1,0 +1,230 @@
+"""Connector base layer: keyed/global operators + watermark policies.
+
+Re-design of the reference's six L3 connector modules (SURVEY.md §2.4) —
+each of which is one ~85-115 LoC class adapting a host engine callback to
+``SlicingWindowOperator.processElement/processWatermark`` while keeping a
+``HashMap<Key, SlicingWindowOperator>`` (e.g.
+flink-connector/.../KeyedScottyWindowOperator.java:21,56-66). Differences
+between the reference connectors are exactly (a) the host callback API and
+(b) the watermark source; this module factors (b) into pluggable
+``WatermarkPolicy`` objects and provides the shared keyed/global cores, so
+each host adapter (``iterable`` / ``asyncio`` / ``torchdata`` / ``beam`` /
+``kafka`` / ``spark``) is as thin as the reference's.
+
+Backends: ``host`` = one reference-semantics operator per key (arbitrary key
+and value types, full window support — the reference model); ``device`` =
+`scotty_tpu.parallel.KeyedTpuWindowOperator` (keys hashed onto shard lanes of
+one batched TPU program).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
+
+from ..core.aggregates import AggregateFunction
+from ..core.operator import AggregateWindow
+from ..core.windows import Window
+
+
+class WatermarkPolicy:
+    """Decides when (and at what ts) to advance the watermark.
+
+    ``observe(ts) -> Optional[int]``: called per tuple with its event ts;
+    returns a watermark ts when one should fire, else None.
+    """
+
+    def observe(self, ts: int) -> Optional[int]:
+        raise NotImplementedError
+
+
+class AscendingWatermarks(WatermarkPolicy):
+    """Flink-style: the watermark follows the max event ts (optionally minus
+    a bounded delay) and fires whenever it advances
+    (flink-connector KeyedScottyWindowOperator.java:72-86 — real engine
+    watermark, fallback to element ts)."""
+
+    def __init__(self, delay: int = 0):
+        self.delay = delay
+        self.current = -1
+
+    def observe(self, ts: int) -> Optional[int]:
+        wm = ts - self.delay
+        if wm > self.current:
+            self.current = wm
+            return wm
+        return None
+
+
+class PeriodicWatermarks(WatermarkPolicy):
+    """Event-time tick: fire when the stream has advanced ``period`` ms past
+    the last watermark — the storm/spark/beam/samza/kafka connector pattern
+    (storm-connector KeyedScottyWindowOperator.java:40,74-87 period 1000 ms;
+    spark/samza/kafka 100 ms; beam 1000 ms)."""
+
+    def __init__(self, period: int = 1000):
+        self.period = period
+        self.last = -1
+
+    def observe(self, ts: int) -> Optional[int]:
+        if self.last == -1:
+            self.last = ts
+            return None
+        if ts > self.last + self.period:
+            self.last = ts
+            return ts
+        return None
+
+
+class KeyedScottyWindowOperator:
+    """Keyed windowing core shared by every host adapter.
+
+    Host backend mirrors the reference exactly: lazily create one
+    reference-semantics operator per key; on watermark, advance EVERY key's
+    operator and emit its non-empty windows
+    (flink-connector KeyedScottyWindowOperator.java:41-49,56-66,72-86).
+    """
+
+    def __init__(self, windows: Optional[List[Window]] = None,
+                 aggregations: Optional[List[AggregateFunction]] = None,
+                 allowed_lateness: int = 1,
+                 watermark_policy: Optional[WatermarkPolicy] = None,
+                 backend: str = "host",
+                 n_key_shards: int = 64,
+                 engine_config=None):
+        self.windows: List[Window] = list(windows or [])
+        self.aggregations: List[AggregateFunction] = list(aggregations or [])
+        # reference default allowedLateness = 1 ms
+        # (flink KeyedScottyWindowOperator.java:26)
+        self.allowed_lateness = allowed_lateness
+        self.policy = watermark_policy or AscendingWatermarks()
+        self.backend = backend
+        self.n_key_shards = n_key_shards
+        self.engine_config = engine_config
+        self._host_ops: Dict[Hashable, Any] = {}
+        self._device_op = None
+
+    # -- builder API (README.md:31-42 chaining) ----------------------------
+    def add_window(self, window: Window) -> "KeyedScottyWindowOperator":
+        self.windows.append(window)
+        return self
+
+    def add_aggregation(self, fn: AggregateFunction) -> "KeyedScottyWindowOperator":
+        self.aggregations.append(fn)
+        return self
+
+    def with_allowed_lateness(self, lateness: int) -> "KeyedScottyWindowOperator":
+        self.allowed_lateness = lateness
+        return self
+
+    # -- processing --------------------------------------------------------
+    def _op_for_key(self, key: Hashable):
+        op = self._host_ops.get(key)
+        if op is None:
+            from ..simulator import SlicingWindowOperator
+
+            op = SlicingWindowOperator()
+            for w in self.windows:
+                op.add_window_assigner(w)
+            for a in self.aggregations:
+                op.add_aggregation(a)
+            op.set_max_lateness(self.allowed_lateness)
+            self._host_ops[key] = op
+        return op
+
+    def _device(self):
+        if self._device_op is None:
+            from ..parallel import KeyedTpuWindowOperator
+
+            self._device_op = KeyedTpuWindowOperator(
+                n_keys=self.n_key_shards,
+                config=self.engine_config)
+            for w in self.windows:
+                self._device_op.add_window_assigner(w)
+            for a in self.aggregations:
+                self._device_op.add_aggregation(a)
+            self._device_op.set_max_lateness(self.allowed_lateness)
+        return self._device_op
+
+    def process_element(self, key: Hashable, value: Any, ts: int
+                        ) -> List[Tuple[Hashable, AggregateWindow]]:
+        """Feed one tuple; returns window results if this tuple's ts advanced
+        the watermark (the connector emit path)."""
+        if self.backend == "device":
+            shard = hash(key) % self.n_key_shards
+            self._device().process_element(shard, value, ts)
+        else:
+            self._op_for_key(key).process_element(value, ts)
+        wm = self.policy.observe(ts)
+        if wm is not None:
+            return self.process_watermark(wm)
+        return []
+
+    def process_watermark(self, wm: int) -> List[Tuple[Hashable, AggregateWindow]]:
+        out: List[Tuple[Hashable, AggregateWindow]] = []
+        if self.backend == "device":
+            if self._device_op is not None:
+                out.extend(self._device().process_watermark(wm))
+        else:
+            for key, op in self._host_ops.items():
+                for w in op.process_watermark(wm):
+                    if w.has_value():      # emit contract: non-empty only
+                        out.append((key, w))
+        return out
+
+
+class GlobalScottyWindowOperator:
+    """Non-keyed variant: a single operator instance for the whole stream
+    (flink-connector/.../GlobalScottyWindowOperator.java:16-85)."""
+
+    def __init__(self, windows: Optional[List[Window]] = None,
+                 aggregations: Optional[List[AggregateFunction]] = None,
+                 allowed_lateness: int = 1,
+                 watermark_policy: Optional[WatermarkPolicy] = None,
+                 backend: str = "host",
+                 n_shards: int = 8,
+                 engine_config=None):
+        self.windows = list(windows or [])
+        self.aggregations = list(aggregations or [])
+        self.allowed_lateness = allowed_lateness
+        self.policy = watermark_policy or AscendingWatermarks()
+        self.backend = backend
+        self.n_shards = n_shards
+        self.engine_config = engine_config
+        self._op = None
+
+    def add_window(self, window: Window) -> "GlobalScottyWindowOperator":
+        self.windows.append(window)
+        return self
+
+    def add_aggregation(self, fn: AggregateFunction) -> "GlobalScottyWindowOperator":
+        self.aggregations.append(fn)
+        return self
+
+    def _operator(self):
+        if self._op is None:
+            if self.backend == "device":
+                from ..parallel import GlobalTpuWindowOperator
+
+                self._op = GlobalTpuWindowOperator(
+                    n_shards=self.n_shards, config=self.engine_config)
+            else:
+                from ..simulator import SlicingWindowOperator
+
+                self._op = SlicingWindowOperator()
+            for w in self.windows:
+                self._op.add_window_assigner(w)
+            for a in self.aggregations:
+                self._op.add_aggregation(a)
+            self._op.set_max_lateness(self.allowed_lateness)
+        return self._op
+
+    def process_element(self, value: Any, ts: int) -> List[AggregateWindow]:
+        self._operator().process_element(value, ts)
+        wm = self.policy.observe(ts)
+        if wm is not None:
+            return self.process_watermark(wm)
+        return []
+
+    def process_watermark(self, wm: int) -> List[AggregateWindow]:
+        return [w for w in self._operator().process_watermark(wm)
+                if w.has_value()]

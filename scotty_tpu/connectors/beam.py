@@ -1,0 +1,57 @@
+"""Apache Beam connector (import-gated).
+
+Mirrors the reference beam-connector: a DoFn over ``KV<K, V>`` elements that
+keeps a keyed window operator and emits stringified results on an event-time
+tick (beam-connector/.../KeyedScottyWindowOperator.java:24-94, 1000 ms tick).
+Requires ``apache-beam`` at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .base import KeyedScottyWindowOperator, PeriodicWatermarks
+
+try:
+    import apache_beam as beam
+
+    HAS_BEAM = True
+    _DoFnBase = beam.DoFn
+except ImportError:                      # pragma: no cover
+    HAS_BEAM = False
+    _DoFnBase = object
+
+
+class ScottyWindowDoFn(_DoFnBase):
+    """Beam DoFn: input (key, (value, ts)) → output str(window result)
+    (the reference Beam connector emits toString of windows,
+    beam-connector/.../KeyedScottyWindowOperator.java:79-92)."""
+
+    def __init__(self, windows: Optional[List] = None,
+                 aggregations: Optional[List] = None,
+                 allowed_lateness: int = 1,
+                 watermark_period_ms: int = 1000):
+        if HAS_BEAM:
+            super().__init__()
+        self._windows = windows or []
+        self._aggregations = aggregations or []
+        self._lateness = allowed_lateness
+        self._period = watermark_period_ms
+        self._op = None
+
+    def setup(self):
+        self._op = KeyedScottyWindowOperator(
+            windows=self._windows, aggregations=self._aggregations,
+            allowed_lateness=self._lateness,
+            watermark_policy=PeriodicWatermarks(self._period))
+
+    def process(self, element, timestamp=None):
+        if self._op is None:
+            self.setup()
+        key, payload = element
+        if isinstance(payload, (tuple, list)) and len(payload) == 2:
+            value, ts = payload
+        else:
+            value, ts = payload, int(timestamp.micros // 1000)
+        for k, window in self._op.process_element(key, value, int(ts)):
+            yield f"{k}: {window!r}"

@@ -1,0 +1,46 @@
+"""Iterable connector — the simplest host: any Python iterable of
+``(key, value, ts)`` (keyed) or ``(value, ts)`` (global) tuples.
+
+Plays the role the reference's per-engine demo sources play for manual
+validation (SURVEY.md §2.6 DemoSource); also the building block the asyncio /
+torchdata adapters reduce to.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Tuple
+
+from .base import GlobalScottyWindowOperator, KeyedScottyWindowOperator
+
+
+def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator
+              ) -> Iterator[Tuple]:
+    """Drive a keyed operator from an iterable of (key, value, ts); yields
+    (key, AggregateWindow) results as watermarks fire."""
+    for key, value, ts in source:
+        for item in operator.process_element(key, value, int(ts)):
+            yield item
+
+
+def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator
+               ) -> Iterator:
+    """Drive a global operator from an iterable of (value, ts)."""
+    for value, ts in source:
+        for item in operator.process_element(value, int(ts)):
+            yield item
+
+
+def collect_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
+                  final_watermark: int | None = None) -> List[Tuple]:
+    out = list(run_keyed(source, operator))
+    if final_watermark is not None:
+        out.extend(operator.process_watermark(final_watermark))
+    return out
+
+
+def collect_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
+                   final_watermark: int | None = None) -> List:
+    out = list(run_global(source, operator))
+    if final_watermark is not None:
+        out.extend(operator.process_watermark(final_watermark))
+    return out

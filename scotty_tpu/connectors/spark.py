@@ -1,0 +1,33 @@
+"""Spark connector (import-gated).
+
+Mirrors the reference spark-connector: a flatMap function over a structured
+stream keeping a keyed operator with a 100 ms event-time tick
+(spark-connector/.../KeyedScottyWindowOperator.java:17-85, tick :24,59-72).
+Requires ``pyspark`` at runtime; ``scotty_flat_map`` itself is a plain
+callable usable with ``DataFrame.mapInPandas`` / RDD ``mapPartitions``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from .base import KeyedScottyWindowOperator, PeriodicWatermarks
+
+
+def scotty_flat_map(windows: Optional[List] = None,
+                    aggregations: Optional[List] = None,
+                    allowed_lateness: int = 1,
+                    watermark_period_ms: int = 100):
+    """Returns a partition-mapper: Iterable[(key, value, ts)] →
+    Iterator[(key, start, end, values)] — apply with
+    ``rdd.mapPartitions(scotty_flat_map(...))`` or feed micro-batches
+    directly."""
+    def mapper(partition: Iterable[Tuple]) -> Iterator[Tuple]:
+        op = KeyedScottyWindowOperator(
+            windows=windows or [], aggregations=aggregations or [],
+            allowed_lateness=allowed_lateness,
+            watermark_policy=PeriodicWatermarks(watermark_period_ms))
+        for key, value, ts in partition:
+            for k, w in op.process_element(key, value, int(ts)):
+                yield (k, w.get_start(), w.get_end(), tuple(w.get_agg_values()))
+    return mapper

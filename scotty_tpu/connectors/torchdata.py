@@ -1,0 +1,56 @@
+"""Torch DataLoader / IterableDataset connector.
+
+The PyTorch-ecosystem host adapter (the role the Spark/Beam connectors play
+in the reference, SURVEY.md §2.4): wraps a windowing operator around any
+``torch.utils.data.IterableDataset`` (or plain DataLoader) yielding
+``(key, value, ts)`` and streams out window results.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+from .base import KeyedScottyWindowOperator
+from .iterable import run_keyed
+
+try:
+    import torch
+    from torch.utils.data import IterableDataset
+
+    HAS_TORCH = True
+except ImportError:                      # pragma: no cover
+    HAS_TORCH = False
+    IterableDataset = object
+
+
+if HAS_TORCH:
+
+    class WindowedResultDataset(IterableDataset):
+        """IterableDataset of (key, AggregateWindow) results: compose window
+        aggregation into a torch input pipeline."""
+
+        def __init__(self, source, operator: KeyedScottyWindowOperator,
+                     final_watermark: int | None = None):
+            super().__init__()
+            self.source = source
+            self.operator = operator
+            self.final_watermark = final_watermark
+
+        def __iter__(self) -> Iterator[Tuple]:
+            def tuples():
+                for item in self.source:
+                    if isinstance(item, (tuple, list)) and len(item) == 3:
+                        k, v, t = item
+                    else:                    # tensor row [k, v, t]
+                        k, v, t = item[0], item[1], item[2]
+                    if torch.is_tensor(k):
+                        k = k.item()
+                    if torch.is_tensor(v):
+                        v = v.item()
+                    if torch.is_tensor(t):
+                        t = int(t.item())
+                    yield k, v, int(t)
+
+            yield from run_keyed(tuples(), self.operator)
+            if self.final_watermark is not None:
+                yield from self.operator.process_watermark(self.final_watermark)

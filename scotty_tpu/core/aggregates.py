@@ -61,6 +61,9 @@ class DeviceAggregateSpec:
     lift_dense: Callable[[Any], Any] | None = None
     lift_sparse: Callable[[Any], tuple] | None = None
     dtype: Any = np.float32
+    #: Hashable semantic identity (aggregation type + parameters) — the
+    #: callables above are closures, so kernel caches key on this instead.
+    token: tuple = ()
 
     @property
     def is_sparse(self) -> bool:
@@ -178,6 +181,7 @@ class SumAggregation(AggregateFunction):
             identity=0.0,
             lift_dense=lambda v: v.reshape(-1, 1),
             lower=lambda p, c: p[:, 0],
+            token=("sum",),
         )
 
 
@@ -207,6 +211,7 @@ class CountAggregation(AggregateFunction):
             identity=0.0,
             lift_dense=lambda v: jnp.ones((v.shape[0], 1), dtype=jnp.float32),
             lower=lambda p, c: p[:, 0],
+            token=("count",),
         )
 
 
@@ -229,6 +234,7 @@ class MinAggregation(AggregateFunction):
             identity=float("inf"),
             lift_dense=lambda v: v.reshape(-1, 1),
             lower=lambda p, c: p[:, 0],
+            token=("min",),
         )
 
 
@@ -251,6 +257,7 @@ class MaxAggregation(AggregateFunction):
             identity=-float("inf"),
             lift_dense=lambda v: v.reshape(-1, 1),
             lower=lambda p, c: p[:, 0],
+            token=("max",),
         )
 
 
@@ -281,6 +288,7 @@ class MeanAggregation(AggregateFunction):
             identity=0.0,
             lift_dense=lambda v: jnp.stack([v, jnp.ones_like(v)], axis=-1),
             lower=lambda p, c: p[:, 0] / np.maximum(p[:, 1], 1.0),
+            token=("mean",),
         )
 
 
@@ -415,6 +423,8 @@ class DDSketchQuantileAggregation(AggregateFunction):
             identity=0.0,
             lift_sparse=lift_sparse,
             lower=lower,
+            token=("ddsketch", self.quantile, self.alpha, self.n_buckets,
+                   self.min_value),
         )
 
 
@@ -516,6 +526,7 @@ class HyperLogLogAggregation(AggregateFunction):
             identity=0.0,
             lift_sparse=lift_sparse,
             lower=lower,
+            token=("hll", self.p),
         )
 
 

@@ -1,0 +1,7 @@
+"""TPU device engine: slice ring buffers in HBM, batched segment-combine
+ingest, prefix-sum / sparse-table window queries (SURVEY.md §7)."""
+
+from .config import EngineConfig
+from .operator import TpuWindowOperator, UnsupportedOnDevice
+
+__all__ = ["EngineConfig", "TpuWindowOperator", "UnsupportedOnDevice"]

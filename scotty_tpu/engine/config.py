@@ -1,0 +1,49 @@
+"""Static configuration for the TPU slicing engine.
+
+Everything here is trace-time static: slice-buffer capacity, ingest batch
+size, trigger padding buckets. The reference sizes its slice store dynamically
+(an ArrayList pre-sized 1000, slicing/.../LazyAggregateStore.java:148-157);
+under XLA every shape must be static, so capacities are explicit and the
+operator raises on overflow instead of growing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    #: Max number of live slices per key shard. Slices live for roughly
+    #: ``(max_window_size + max_lateness + watermark_period) / min_edge_period``
+    #: — e.g. the 60 s / 1 ms sliding benchmark needs ~61k ⇒ default 1 << 17.
+    capacity: int = 1 << 17
+
+    #: Device ingest batch size (tuples per kernel launch). The host driver
+    #: packs tuples into batches of this size; the last batch before a
+    #: watermark is padded and masked.
+    batch_size: int = 1 << 15
+
+    #: Triggered-window arrays are padded to the next power-of-two bucket at
+    #: least this large to bound recompilation.
+    min_trigger_pad: int = 256
+
+    #: Hard cap on triggered windows per watermark (query-kernel padding).
+    max_triggers: int = 1 << 17
+
+    #: Capacity of the out-of-order annex (late tuples that open slices whose
+    #: grid range was never materialized). Bounded by the number of distinct
+    #: empty grid ranges that receive late tuples between two watermarks.
+    annex_capacity: int = 1 << 12
+
+    #: Partial-aggregate dtype on device.
+    partial_dtype: str = "float32"
+
+    def trigger_pad(self, n: int) -> int:
+        """Next power-of-two bucket ≥ n (≥ min_trigger_pad)."""
+        p = self.min_trigger_pad
+        while p < n:
+            p <<= 1
+        if p > self.max_triggers and n <= self.max_triggers:
+            p = self.max_triggers
+        return p

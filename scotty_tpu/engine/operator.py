@@ -1,0 +1,495 @@
+"""TPU window operator: the device-engine implementation of WindowOperator.
+
+Host driver around the device kernels in :mod:`.core`: buffers tuples into
+fixed-size batches, launches the ingest kernel, and on each watermark
+enumerates triggered windows in closed form (host-side numpy — the exact
+trigger order of WindowManager.processWatermark, WindowManager.java:41-80),
+answers them all with one device query, and GCs the slice buffer.
+
+Covers context-free, Time-measure window workloads (tumbling / sliding /
+fixed-band, any mix, in-order or out-of-order within ``max_lateness``) with
+device-realizable aggregations. Count-measure, session, and arbitrary-object
+workloads run on the host reference-semantics operator
+(`scotty_tpu.simulator.SlicingWindowOperator`); `scotty_tpu.HybridWindowOperator`
+picks automatically — the same role the eager/lazy decision tree plays in the
+reference (SliceFactory.java:17-22).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.aggregates import AggregateFunction
+from ..core.operator import AggregateWindow, WindowOperator
+from ..core.windows import (
+    ContextFreeWindow,
+    FixedBandWindow,
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+    Window,
+    WindowMeasure,
+)
+from ..state import StateFactory
+from .config import EngineConfig
+
+
+class UnsupportedOnDevice(NotImplementedError):
+    """Raised when a window/aggregation mix has no device realization."""
+
+
+_KERNEL_CACHE: dict = {}
+
+
+def _session_kernels(spec, capacity: int, annex_capacity: int, emit_cap: int):
+    """Jitted pure-session kernels (ingest + sweep), cached like _kernels."""
+    import jax
+    from . import core as ec
+
+    key = ("session", spec.session_gaps,
+           tuple(a.token for a in spec.aggs), capacity, annex_capacity,
+           emit_cap)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        hit = (
+            jax.jit(ec.build_ingest(spec, capacity, annex_capacity),
+                    donate_argnums=0),
+            jax.jit(ec.build_session_sweep(spec, capacity, emit_cap),
+                    donate_argnums=0),
+        )
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
+def _kernels(spec, capacity: int, annex_capacity: int):
+    """Jitted kernels shared across operator instances with the same static
+    spec — compilation is the dominant cost of small runs/tests."""
+    import jax
+    from . import core as ec
+
+    key = (spec.periods, spec.bands, spec.count_periods, spec.session_gaps,
+           tuple(a.token for a in spec.aggs), capacity, annex_capacity)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        hit = (
+            jax.jit(ec.build_ingest(spec, capacity, annex_capacity),
+                    donate_argnums=0),
+            jax.jit(ec.build_query(spec, capacity, annex_capacity)),
+            jax.jit(ec.build_gc(spec, capacity, annex_capacity)),
+            jax.jit(ec.build_count_probe(spec, capacity)),
+            jax.jit(ec.build_annex_merge(spec, capacity, annex_capacity),
+                    donate_argnums=0),
+        )
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
+class TpuWindowOperator(WindowOperator):
+    """Device-engine WindowOperator (SURVEY.md §7 stage 3-5).
+
+    Same public contract as the reference SlicingWindowOperator
+    (slicing/.../SlicingWindowOperator.java:21-69) plus the batched
+    ``process_elements`` entry point that actually feeds the accelerator.
+    """
+
+    def __init__(self, state_factory: Optional[StateFactory] = None,
+                 config: Optional[EngineConfig] = None):
+        self.config = config or EngineConfig()
+        self.windows: List[ContextFreeWindow] = []
+        self.aggregations: List[AggregateFunction] = []
+        self.max_lateness = 1000            # WindowManager.java:24 default
+        self.max_fixed_window_size = 0
+        self._last_watermark = -1
+        self._built = False
+        self._state = None
+        self._pend_vals: list = []
+        self._pend_ts: list = []
+        self._n_pending = 0
+
+    # -- registry ----------------------------------------------------------
+    def add_window_assigner(self, window: Window) -> None:
+        if self._built:
+            raise RuntimeError("add windows before first element "
+                               "(device shapes are static)")
+        if isinstance(window, SessionWindow):
+            # pure-session device path (the eager session case,
+            # SliceFactory.java:17-22): one session window, nothing else.
+            if self.windows:
+                raise UnsupportedOnDevice(
+                    "session windows mixed with other windows need the host "
+                    "operator (flexible-edge repair, SliceManager.java:89-166)")
+            if window.measure != WindowMeasure.Time:
+                raise UnsupportedOnDevice("count-measure sessions: host only")
+            self.windows.append(window)
+            return
+        if self.windows and isinstance(self.windows[0], SessionWindow):
+            raise UnsupportedOnDevice(
+                "session windows mixed with other windows need the host "
+                "operator")
+        if not isinstance(window, (TumblingWindow, SlidingWindow,
+                                   FixedBandWindow)):
+            raise UnsupportedOnDevice(
+                f"{type(window).__name__} has no device path; use "
+                "SlicingWindowOperator or HybridWindowOperator")
+        if (window.measure == WindowMeasure.Count
+                and isinstance(window, FixedBandWindow)):
+            raise UnsupportedOnDevice(
+                "count-measure fixed-band windows have no device path; use "
+                "SlicingWindowOperator")
+        self.windows.append(window)
+        # the reference mixes count sizes into the (ms) GC delay bound —
+        # WindowManager.java:121-127 takes clearDelay() of every
+        # context-free window regardless of measure; mirrored for parity.
+        self.max_fixed_window_size = max(self.max_fixed_window_size,
+                                         window.clear_delay())
+
+    def add_aggregation(self, window_function: AggregateFunction) -> None:
+        if self._built:
+            raise RuntimeError("add aggregations before first element")
+        if window_function.device_spec() is None:
+            raise UnsupportedOnDevice(
+                f"{type(window_function).__name__} has no device realization "
+                "(device_spec() is None); use SlicingWindowOperator")
+        self.aggregations.append(window_function)
+
+    def set_max_lateness(self, max_lateness: int) -> None:
+        self.max_lateness = max_lateness
+
+    # -- build -------------------------------------------------------------
+    def _build(self) -> None:
+        import jax
+        from . import core as ec
+
+        if not self.windows:
+            raise RuntimeError("no windows registered")
+        if not self.aggregations:
+            raise RuntimeError("no aggregations registered")
+        periods = []
+        bands = []
+        count_periods = []
+        session_gaps = []
+        for w in self.windows:
+            if isinstance(w, SessionWindow):
+                session_gaps.append(int(w.gap))
+            elif w.measure == WindowMeasure.Count:
+                count_periods.append(int(w.slide)
+                                     if isinstance(w, SlidingWindow)
+                                     else int(w.size))
+            elif isinstance(w, TumblingWindow):
+                periods.append(int(w.size))
+            elif isinstance(w, SlidingWindow):
+                periods.append(int(w.slide))
+            elif isinstance(w, FixedBandWindow):
+                bands.append((int(w.start), int(w.size)))
+        self._spec = ec.EngineSpec(
+            periods=tuple(sorted(set(periods))),
+            bands=tuple(sorted(set(bands))),
+            count_periods=tuple(sorted(set(count_periods))),
+            aggs=tuple(a.device_spec() for a in self.aggregations),
+            session_gaps=tuple(session_gaps),
+        )
+        C, A = self.config.capacity, self.config.annex_capacity
+        self._state = ec.init_state(self._spec, C, A)
+        self._is_session = self._spec.pure_session
+        if self._is_session:
+            self._ingest, self._session_sweep = _session_kernels(
+                self._spec, C, A, self.config.trigger_pad(1024))
+            self._emit_cap = self.config.trigger_pad(1024)
+        else:
+            (self._ingest, self._query, self._gc, self._count_at,
+             self._merge) = _kernels(self._spec, C, A)
+        self._has_count = bool(count_periods)
+        self._last_count = 0
+        self._host_met = None           # host mirror of max event time
+        self._host_min_ts = None        # host mirror of min event time
+        self._host_count = 0            # host mirror of current_count
+        self._annex_dirty = False       # a late tuple may sit in the annex
+        self._valid_dev = None          # cached all-true lane mask
+        self._built = True
+
+    # -- ingest ------------------------------------------------------------
+    def process_element(self, element: Any, ts: int) -> None:
+        self.process_elements(np.asarray([element], dtype=np.float32),
+                              np.asarray([ts], dtype=np.int64))
+
+    def process_elements(self, elements: Sequence, timestamps: Sequence) -> None:
+        if not self._built:
+            self._build()
+        vals = np.asarray(elements, dtype=np.float32).reshape(-1)
+        tss = np.asarray(timestamps, dtype=np.int64).reshape(-1)
+        if vals.shape != tss.shape:
+            raise ValueError("elements/timestamps length mismatch")
+        self._pend_vals.append(vals)
+        self._pend_ts.append(tss)
+        self._n_pending += vals.shape[0]
+        B = self.config.batch_size
+        while self._n_pending >= B:
+            self._launch_batch(B)
+
+    def _launch_batch(self, take: int) -> None:
+        """Pop `take` tuples from the pending queue, pad to batch_size,
+        ts-sort (late tuples must be grouped for the annex path), launch."""
+        B = self.config.batch_size
+        if len(self._pend_vals) == 1:
+            vals_cat, ts_cat = self._pend_vals[0], self._pend_ts[0]
+        else:
+            vals_cat = np.concatenate(self._pend_vals)
+            ts_cat = np.concatenate(self._pend_ts)
+        batch_v, rest_v = vals_cat[:take], vals_cat[take:]
+        batch_t, rest_t = ts_cat[:take], ts_cat[take:]
+        self._pend_vals = [rest_v] if rest_v.size else []
+        self._pend_ts = [rest_t] if rest_t.size else []
+        self._n_pending -= take
+
+        if take and not bool((batch_t[:-1] <= batch_t[1:]).all()):
+            order = np.argsort(batch_t, kind="stable")
+            batch_v, batch_t = batch_v[order], batch_t[order]
+        if self._has_count or self._is_session:
+            # out-of-order + count measure needs the reference's record
+            # ripple (SliceManager.java:77-85); out-of-order sessions need
+            # context repair (SessionWindow.java:40-84) — host-only.
+            if (self._host_met is not None and take
+                    and batch_t[0] < self._host_met):
+                raise UnsupportedOnDevice(
+                    "out-of-order tuples with count-measure or session "
+                    "windows need the host operator")
+        if take:
+            if (self._host_met is not None
+                    and int(batch_t[0]) < self._host_met):
+                # late tuples may open annex slices → merge before next query
+                self._annex_dirty = True
+            mx = int(batch_t[take - 1]) if take < B else int(batch_t[-1])
+            self._host_met = mx if self._host_met is None \
+                else max(self._host_met, mx)
+            mn = int(batch_t[0])
+            self._host_min_ts = mn if self._host_min_ts is None \
+                else min(self._host_min_ts, mn)
+            self._host_count += take
+        valid = np.ones((B,), dtype=bool)
+        if take < B:
+            pad_t = batch_t[-1] if take else 0
+            batch_t = np.concatenate(
+                [batch_t, np.full((B - take,), pad_t, np.int64)])
+            batch_v = np.concatenate(
+                [batch_v, np.zeros((B - take,), np.float32)])
+            valid[take:] = False
+        self._state = self._ingest(self._state, batch_t, batch_v, valid)
+
+    def _flush(self) -> None:
+        while self._n_pending > 0:
+            self._launch_batch(min(self._n_pending, self.config.batch_size))
+
+    def ingest_device_batch(self, vals, ts, ts_min: int, ts_max: int,
+                            n_valid: Optional[int] = None) -> None:
+        """Zero-copy ingest of device-resident arrays (shape [batch_size],
+        ts ascending and ≥ the stream's max event time). ``ts_min``/``ts_max``
+        are the host-known event-time bounds of the batch (they keep the host
+        clock mirrors exact without a device sync). This is the path for
+        device-side sources — host→device bandwidth never caps throughput."""
+        if not self._built:
+            self._build()
+        B = self.config.batch_size
+        if self._valid_dev is None:
+            import jax
+
+            self._valid_dev = jax.device_put(np.ones((B,), bool))
+        n = B if n_valid is None else n_valid
+        if self._host_met is not None and ts_min < self._host_met:
+            raise ValueError("device batches must be in-order")
+        self._host_met = ts_max if self._host_met is None \
+            else max(self._host_met, ts_max)
+        self._host_min_ts = ts_min if self._host_min_ts is None \
+            else min(self._host_min_ts, ts_min)
+        self._host_count += n
+        self._state = self._ingest(self._state, ts, vals, self._valid_dev)
+
+    # -- watermark ---------------------------------------------------------
+    def process_watermark(self, watermark_ts: int) -> List[AggregateWindow]:
+        ws, we, cnt, lowered = self.process_watermark_arrays(watermark_ts)
+        measures = getattr(self, "_trigger_measures", None)
+        out: List[AggregateWindow] = []
+        for i in range(ws.shape[0]):
+            has = bool(cnt[i] > 0)
+            values = [lw[i] for lw in lowered] if has else []
+            m = (WindowMeasure.Count
+                 if measures is not None and measures.shape[0] > i
+                 and measures[i] else WindowMeasure.Time)
+            out.append(AggregateWindow(m, int(ws[i]), int(we[i]), values, has))
+        return out
+
+    def _host_grid_start(self, ts: int) -> int:
+        """Host mirror of core.grid_start for one scalar — used for the
+        first-watermark clamp without a device roundtrip."""
+        best = 0
+        for p in self._spec.periods:
+            best = max(best, ts - ts % p if ts >= 0 else 0)
+        for (bs, bsz) in self._spec.bands:
+            if ts >= bs + bsz:
+                best = max(best, bs + bsz)
+            elif ts >= bs:
+                best = max(best, bs)
+        return best
+
+    def process_watermark_async(self, watermark_ts: int):
+        """Dispatch the full watermark program with NO device→host sync on
+        the time-measure path (the tunnel makes each sync ~100s of ms — the
+        dominant cost at benchmark rates). Returns
+        ``(ws, we, is_count, cnt_dev, results_dev)`` where the last two are
+        device arrays (padded; first ``len(ws)`` rows are live). Call
+        :meth:`check_overflow` after draining a stream.
+
+        Host-side clock mirrors replace the reference's store inspection:
+        emptiness (WindowManager.java:46-49) is "no tuples ever fed"; the
+        oldest-slice clamp (:51-55) only binds on the FIRST watermark —
+        after any GC, oldest ≤ gc bound < last watermark — and at that point
+        the oldest slice start is exactly grid_start(min ts seen).
+        """
+        if not self._built:
+            self._build()
+        self._flush()
+        st = self._state
+
+        if self._is_session:
+            return self._session_watermark_async(st, watermark_ts)
+
+        last_wm = self._last_watermark
+        first_watermark = last_wm == -1
+        if first_watermark:                  # WindowManager.java:43-45
+            last_wm = max(0, watermark_ts - self.max_lateness)
+
+        empty = np.empty(0, dtype=np.int64)
+        no_result = (empty, empty, np.empty(0, bool), None, None)
+        if self._host_met is None:           # store empty: :46-49
+            self._last_watermark = watermark_ts
+            return no_result
+
+        if first_watermark:
+            oldest = self._host_grid_start(self._host_min_ts)
+            if last_wm < oldest:
+                last_wm = oldest
+
+        if self._annex_dirty:
+            self._state = self._merge(self._state)
+            st = self._state
+            self._annex_dirty = False
+
+        # count-measure trigger bound: watermark ts → count
+        # (WindowManager.java:104-118). The one remaining sync, count
+        # workloads only.
+        cend = None
+        if self._has_count:
+            cend = int(self._count_at(st, np.int64(watermark_ts)))
+
+        trig_s, trig_e, trig_c = [], [], []
+        for w in self.windows:
+            if w.measure == WindowMeasure.Count:
+                s_arr, e_arr = w.trigger_arrays(self._last_count, cend + 1)
+                trig_c.append(np.ones(s_arr.shape[0], bool))
+            else:
+                s_arr, e_arr = w.trigger_arrays(last_wm, watermark_ts)
+                trig_c.append(np.zeros(s_arr.shape[0], bool))
+            trig_s.append(s_arr)
+            trig_e.append(e_arr)
+        ws = np.concatenate(trig_s) if trig_s else empty
+        we = np.concatenate(trig_e) if trig_e else empty
+        is_count = (np.concatenate(trig_c) if trig_c
+                    else np.empty(0, dtype=bool))
+        T = ws.shape[0]
+        if T > self.config.max_triggers:
+            raise RuntimeError(
+                f"{T} triggered windows exceeds max_triggers="
+                f"{self.config.max_triggers}")
+
+        cnt_d = results = None
+        if T:
+            Tp = self.config.trigger_pad(T)
+            ws_p = np.zeros((Tp,), np.int64)
+            we_p = np.zeros((Tp,), np.int64)
+            mask = np.zeros((Tp,), bool)
+            ic_p = np.zeros((Tp,), bool)
+            ws_p[:T], we_p[:T], mask[:T] = ws, we, True
+            ic_p[:T] = is_count
+            cnt_d, results = self._query(st, ws_p, we_p, mask, ic_p)
+
+        if self._has_count:
+            self._last_count = self._host_count   # exact host mirror
+        bound = (watermark_ts - self.max_lateness) - self.max_fixed_window_size
+        self._state = self._gc(st, np.int64(bound))
+        self._last_watermark = watermark_ts
+        self._trigger_measures = is_count
+        return ws, we, is_count, cnt_d, results
+
+    def process_watermark_arrays(self, watermark_ts: int):
+        """Synchronous watermark: returns numpy ``(starts[T], ends[T],
+        counts[T], [per-agg lowered [T]])`` — one bundled device fetch."""
+        import jax
+
+        out = self.process_watermark_async(watermark_ts)
+        if self._is_session:
+            return self._session_fetch(out)
+        ws, we, is_count, cnt_d, results = out
+        T = ws.shape[0]
+        lowered: List[np.ndarray] = []
+        cnt_np = np.empty(0, dtype=np.int64)
+        if T:
+            cnt_h, res_h, ovf = jax.device_get(
+                (cnt_d, results, self._state.overflow))
+            self._raise_if_overflow(ovf)
+            cnt_np = cnt_h[:T]
+            for agg, res in zip(self.aggregations, res_h):
+                spec = agg.device_spec()
+                lowered.append(np.asarray(spec.lower(res[:T], cnt_np)))
+        return ws, we, cnt_np, lowered
+
+    def _raise_if_overflow(self, ovf) -> None:
+        if bool(ovf):
+            raise RuntimeError(
+                "slice buffer overflow: raise EngineConfig.capacity / "
+                "annex_capacity / batch sizing, or advance watermarks more "
+                "often")
+
+    def check_overflow(self) -> None:
+        """One deliberate sync validating the run (async users call this
+        after draining a stream)."""
+        if self._state is not None:
+            self._raise_if_overflow(self._state.overflow)
+
+    def _session_watermark_async(self, st, watermark_ts: int):
+        """Pure-session watermark: one sweep kernel emits complete sessions
+        and compacts the buffer (SessionWindow.java:107-116 semantics)."""
+        new_state, m_d, e_s, e_e, e_c, e_p = self._session_sweep(
+            st, np.int64(watermark_ts))
+        self._state = new_state
+        self._last_watermark = watermark_ts
+        return ("session", m_d, e_s, e_e, e_c, e_p)
+
+    def _session_fetch(self, out):
+        import jax
+
+        _, m_d, e_s, e_e, e_c, e_p = out
+        if m_d is None:
+            empty = np.empty(0, dtype=np.int64)
+            return empty, empty, empty, []
+        m, ws_h, we_h, cnt_h, res_h, ovf = jax.device_get(
+            (m_d, e_s, e_e, e_c, e_p, self._state.overflow))
+        m = int(m)
+        self._raise_if_overflow(ovf)
+        if m > self._emit_cap:
+            raise RuntimeError(
+                f"{m} sessions completed in one watermark exceeds the "
+                f"emission buffer ({self._emit_cap}); raise "
+                "EngineConfig.min_trigger_pad")
+        cnt = cnt_h[:m]
+        lowered = []
+        for agg, res in zip(self.aggregations, res_h):
+            spec = agg.device_spec()
+            lowered.append(np.asarray(spec.lower(res[:m], cnt)))
+        self._trigger_measures = np.zeros((m,), bool)
+        return ws_h[:m], we_h[:m], cnt, lowered
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def n_slices(self) -> int:
+        return int(self._state.n_slices) if self._state is not None else 0

@@ -1,0 +1,110 @@
+"""Hybrid operator: automatic device/host backend selection.
+
+The reference picks its slice storage mode with a decision tree over the
+registered workload (eager vs lazy, SliceFactory.java:17-28). The TPU
+framework has the same shape of decision one level up: workloads whose
+windows/aggregations have a device realization run on the TPU engine
+(`scotty_tpu.engine.TpuWindowOperator`); everything else — count-measure
+windows, session/context-aware windows, host-only holistic aggregates,
+non-numeric elements — runs on the reference-semantics host operator
+(`scotty_tpu.simulator.SlicingWindowOperator`). The decision is made lazily
+at first element, once all windows/aggregations are registered (the same
+point the reference instantiates its slice factory).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from .core.aggregates import AggregateFunction
+from .core.operator import AggregateWindow, WindowOperator
+from .core.windows import (
+    FixedBandWindow,
+    SlidingWindow,
+    TumblingWindow,
+    Window,
+    WindowMeasure,
+)
+from .state import StateFactory
+
+
+class HybridWindowOperator(WindowOperator):
+    """WindowOperator that routes to the TPU engine when possible."""
+
+    def __init__(self, state_factory: Optional[StateFactory] = None,
+                 engine_config=None, force_backend: Optional[str] = None):
+        self.state_factory = state_factory
+        self.engine_config = engine_config
+        self.force_backend = force_backend
+        self.windows: List[Window] = []
+        self.aggregations: List[AggregateFunction] = []
+        self.max_lateness = 1000
+        self._delegate: Optional[WindowOperator] = None
+
+    # -- decision tree (device analogue of SliceFactory.java:17-22) --------
+    def _device_realizable(self) -> bool:
+        for w in self.windows:
+            if not isinstance(w, (TumblingWindow, SlidingWindow,
+                                  FixedBandWindow)):
+                return False
+            if w.measure != WindowMeasure.Time:
+                return False
+        for a in self.aggregations:
+            if a.device_spec() is None:
+                return False
+        return bool(self.windows) and bool(self.aggregations)
+
+    @property
+    def backend(self) -> str:
+        if self._delegate is None:
+            return "undecided"
+        from .engine import TpuWindowOperator
+
+        return ("device" if isinstance(self._delegate, TpuWindowOperator)
+                else "host")
+
+    def _resolve(self) -> WindowOperator:
+        if self._delegate is None:
+            use_device = (self.force_backend == "device"
+                          or (self.force_backend is None
+                              and self._device_realizable()))
+            if use_device:
+                from .engine import TpuWindowOperator
+
+                d = TpuWindowOperator(config=self.engine_config)
+            else:
+                from .simulator import SlicingWindowOperator
+
+                d = SlicingWindowOperator(self.state_factory)
+            for w in self.windows:
+                d.add_window_assigner(w)
+            for a in self.aggregations:
+                d.add_aggregation(a)
+            d.set_max_lateness(self.max_lateness)
+            self._delegate = d
+        return self._delegate
+
+    # -- WindowOperator contract -------------------------------------------
+    def process_element(self, element: Any, ts: int) -> None:
+        self._resolve().process_element(element, ts)
+
+    def process_elements(self, elements, timestamps) -> None:
+        self._resolve().process_elements(elements, timestamps)
+
+    def process_watermark(self, watermark_ts: int) -> List[AggregateWindow]:
+        return self._resolve().process_watermark(watermark_ts)
+
+    def add_window_assigner(self, window: Window) -> None:
+        if self._delegate is not None:
+            self._delegate.add_window_assigner(window)
+        self.windows.append(window)
+
+    def add_aggregation(self, window_function: AggregateFunction) -> None:
+        if self._delegate is not None:
+            self._delegate.add_aggregation(window_function)
+        self.aggregations.append(window_function)
+
+    def set_max_lateness(self, max_lateness: int) -> None:
+        self.max_lateness = max_lateness
+        if self._delegate is not None:
+            self._delegate.set_max_lateness(max_lateness)

@@ -1,0 +1,22 @@
+"""Process-wide JAX configuration for the engine.
+
+Import this module before tracing any engine-adjacent jitted function:
+* ``jax_enable_x64`` — event timestamps are int64 (epoch-ms exceeds int32);
+  partial aggregates remain explicit float32.
+* persistent compilation cache — kernels are static per window/agg mix, so
+  repeat runs (tests, benchmarks) skip XLA compilation entirely.
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+_cache_dir = os.environ.get("SCOTTY_TPU_COMPILE_CACHE",
+                            os.path.expanduser("~/.cache/scotty_tpu_xla"))
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:                      # pragma: no cover - older jax
+    pass

@@ -1,0 +1,25 @@
+"""Parallel execution: keys as a batch dimension, multi-chip scaling via
+``jax.sharding.Mesh`` (SURVEY.md §2.8, §5)."""
+
+from .keyed import KeyedTpuWindowOperator
+from .global_op import GlobalTpuWindowOperator
+
+
+def make_mesh(axis: str = "keys", n_devices: int | None = None):
+    """A 1-D device mesh over all (or the first ``n_devices``) local devices.
+
+    Keys are embarrassingly parallel (reference model: independent operator
+    per key), so a 1-D mesh is the natural topology; per-key windows need no
+    collectives and global windows reduce over this axis.
+    """
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+__all__ = ["KeyedTpuWindowOperator", "GlobalTpuWindowOperator", "make_mesh"]

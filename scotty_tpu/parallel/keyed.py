@@ -1,0 +1,273 @@
+"""Keyed operator: keys as a leading batch dimension of one device program.
+
+The reference scales by key partitioning delegated to the host engine — each
+key gets an independent JVM operator object in a HashMap
+(flink-connector/.../KeyedScottyWindowOperator.java:21,56-66; SURVEY.md §2.8).
+The TPU-native equivalent: the per-key slice buffers are ONE batched array
+``[K, ...]`` served by vmapped kernels, and multi-chip scaling shards the key
+axis over a ``jax.sharding.Mesh`` — per-key windows need no cross-key
+communication (embarrassingly parallel, exactly the reference's model), so
+the sharded program runs collective-free over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.aggregates import AggregateFunction
+from ..core.operator import AggregateWindow
+from ..core.windows import (
+    FixedBandWindow,
+    SlidingWindow,
+    TumblingWindow,
+    Window,
+    WindowMeasure,
+)
+from ..engine.config import EngineConfig
+from ..engine.operator import UnsupportedOnDevice
+
+_KERNEL_CACHE: dict = {}
+
+
+class KeyedTpuWindowOperator:
+    """One device program serving ``n_keys`` independent keyed operators.
+
+    API mirrors the reference connectors' KeyedScottyWindowOperator: register
+    windows + aggregations, feed ``(key, value, ts)`` tuples, advance a
+    watermark to collect per-key window results.
+
+    ``mesh``/``axis``: optional ``jax.sharding.Mesh`` whose ``axis`` shards
+    the key dimension across devices (``n_keys`` must be divisible by the
+    axis size).
+    """
+
+    def __init__(self, n_keys: int, config: Optional[EngineConfig] = None,
+                 mesh=None, axis: str = "keys"):
+        self.n_keys = int(n_keys)
+        self.config = config or EngineConfig()
+        self.mesh = mesh
+        self.axis = axis
+        self.windows: List[Window] = []
+        self.aggregations: List[AggregateFunction] = []
+        self.max_lateness = 1000
+        self.max_fixed_window_size = 0
+        self._last_watermark = -1
+        self._built = False
+        self._state = None
+        self._pend: list = []            # list of (keys, vals, ts) np arrays
+        self._n_pending = 0
+
+    # -- registry (same contract as TpuWindowOperator) ---------------------
+    def add_window_assigner(self, window: Window) -> None:
+        if self._built:
+            raise RuntimeError("add windows before first element")
+        if not isinstance(window, (TumblingWindow, SlidingWindow,
+                                   FixedBandWindow)) \
+                or window.measure != WindowMeasure.Time:
+            raise UnsupportedOnDevice(
+                f"{window} has no keyed device path; use per-key host "
+                "operators via connectors.KeyedScottyWindowOperator")
+        self.windows.append(window)
+        self.max_fixed_window_size = max(self.max_fixed_window_size,
+                                         window.clear_delay())
+
+    def add_aggregation(self, fn: AggregateFunction) -> None:
+        if self._built:
+            raise RuntimeError("add aggregations before first element")
+        if fn.device_spec() is None:
+            raise UnsupportedOnDevice(
+                f"{type(fn).__name__} has no device realization")
+        self.aggregations.append(fn)
+
+    def set_max_lateness(self, max_lateness: int) -> None:
+        self.max_lateness = max_lateness
+
+    # -- build -------------------------------------------------------------
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..engine import core as ec
+
+        periods, bands = [], []
+        for w in self.windows:
+            if isinstance(w, TumblingWindow):
+                periods.append(int(w.size))
+            elif isinstance(w, SlidingWindow):
+                periods.append(int(w.slide))
+            elif isinstance(w, FixedBandWindow):
+                bands.append((int(w.start), int(w.size)))
+        self._spec = ec.EngineSpec(
+            periods=tuple(sorted(set(periods))),
+            bands=tuple(sorted(set(bands))),
+            count_periods=(),
+            aggs=tuple(a.device_spec() for a in self.aggregations),
+        )
+        C, A = self.config.capacity, self.config.annex_capacity
+        key = (self._spec.periods, self._spec.bands,
+               tuple(a.token for a in self._spec.aggs), C, A, self.n_keys,
+               id(self.mesh), self.axis)
+        hit = _KERNEL_CACHE.get(key)
+        if hit is None:
+            ingest1 = ec.build_ingest(self._spec, C, A)
+            query1 = ec.build_query(self._spec, C, A)
+            gc1 = ec.build_gc(self._spec, C, A)
+            # sharding note: the state is device_put with
+            # NamedSharding(mesh, P(axis)) below; jit propagates it through
+            # the vmapped kernels, and since every op is per-key, XLA
+            # partitions the whole program over the key axis with no
+            # collectives (SURVEY.md §5 "distributed communication backend").
+            merge1 = ec.build_annex_merge(self._spec, C, A)
+            hit = (
+                jax.jit(jax.vmap(ingest1)),
+                jax.jit(jax.vmap(query1, in_axes=(0, None, None, None, None))),
+                jax.jit(jax.vmap(gc1, in_axes=(0, None))),
+                jax.jit(jax.vmap(merge1)),
+            )
+            _KERNEL_CACHE[key] = hit
+        self._ingest, self._query, self._gc, self._merge = hit
+        self._host_met = None
+        self._annex_dirty = False
+
+        one = ec.init_state(self._spec, C, A)
+        self._state = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.n_keys,) + x.shape), one)
+        if self.mesh is not None:
+            shard = NamedSharding(self.mesh, P(self.axis))
+            self._state = jax.device_put(self._state, shard)
+        self._built = True
+
+    # -- ingest ------------------------------------------------------------
+    def process_keyed_elements(self, keys: Sequence, values: Sequence,
+                               timestamps: Sequence) -> None:
+        """Batched keyed ingest: ``keys`` are integer shard ids in
+        ``[0, n_keys)`` (host hash-partitioning, the analogue of the host
+        engine's ``keyBy``)."""
+        if not self._built:
+            self._build()
+        k = np.asarray(keys, dtype=np.int32).reshape(-1)
+        v = np.asarray(values, dtype=np.float32).reshape(-1)
+        t = np.asarray(timestamps, dtype=np.int64).reshape(-1)
+        self._pend.append((k, v, t))
+        self._n_pending += k.shape[0]
+        # flush when the densest key bucket could exceed a device batch
+        if self._n_pending >= self.config.batch_size * max(1, self.n_keys // 4):
+            self._flush()
+
+    def process_element(self, key: int, value, ts: int) -> None:
+        self.process_keyed_elements([key], [value], [ts])
+
+    def _flush(self) -> None:
+        if not self._n_pending:
+            return
+        B = self.config.batch_size
+        k = np.concatenate([p[0] for p in self._pend])
+        v = np.concatenate([p[1] for p in self._pend])
+        t = np.concatenate([p[2] for p in self._pend])
+        self._pend, self._n_pending = [], 0
+
+        # stable partition by key, then ts-sort within key
+        if t.size:
+            if self._host_met is not None and int(t.min()) < self._host_met:
+                # a late tuple may open an annex slice on some shard → merge
+                # before the next query
+                self._annex_dirty = True
+            mx = int(t.max())
+            self._host_met = mx if self._host_met is None \
+                else max(self._host_met, mx)
+        order = np.lexsort((t, k))
+        k, v, t = k[order], v[order], t[order]
+        counts = np.bincount(k, minlength=self.n_keys)
+        max_per_key = int(counts.max()) if counts.size else 0
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        while max_per_key > 0:
+            take = min(max_per_key, B)
+            ts_b = np.zeros((self.n_keys, B), np.int64)
+            vals_b = np.zeros((self.n_keys, B), np.float32)
+            valid_b = np.zeros((self.n_keys, B), bool)
+            for kk in range(self.n_keys):
+                lo, hi = offsets[kk], offsets[kk + 1]
+                n = min(take, hi - lo)
+                if n > 0:
+                    ts_b[kk, :n] = t[lo:lo + n]
+                    vals_b[kk, :n] = v[lo:lo + n]
+                    valid_b[kk, :n] = True
+                    # pad lanes repeat the last ts → no spurious slices
+                    ts_b[kk, n:] = t[lo + n - 1]
+                    offsets[kk] = lo + n
+                elif hi > lo or lo > 0:
+                    pass
+            # keys with no tuples: all-invalid lanes (ts 0 is harmless)
+            self._state = self._ingest(self._state, ts_b, vals_b, valid_b)
+            max_per_key -= take
+
+    # -- watermark ---------------------------------------------------------
+    def process_watermark_arrays(self, watermark_ts: int):
+        """Returns (window_starts[T], window_ends[T], counts[K, T],
+        lowered per agg [K, T]) — all keys answered by one device query,
+        mirroring the connectors' all-keys watermark loop
+        (flink-connector KeyedScottyWindowOperator.java:72-86)."""
+        if not self._built:
+            self._build()
+        self._flush()
+        if self._annex_dirty:
+            self._state = self._merge(self._state)
+            self._annex_dirty = False
+        st = self._state
+        if bool(np.any(np.asarray(st.overflow))):
+            raise RuntimeError("slice buffer overflow on some key shard")
+
+        last_wm = self._last_watermark
+        if last_wm == -1:
+            last_wm = max(0, watermark_ts - self.max_lateness)
+
+        trig_s, trig_e = [], []
+        for w in self.windows:
+            s_arr, e_arr = w.trigger_arrays(last_wm, watermark_ts)
+            trig_s.append(s_arr)
+            trig_e.append(e_arr)
+        empty = np.empty(0, dtype=np.int64)
+        ws = np.concatenate(trig_s) if trig_s else empty
+        we = np.concatenate(trig_e) if trig_e else empty
+        T = ws.shape[0]
+
+        cnt_np = np.zeros((self.n_keys, 0), np.int64)
+        lowered: List[np.ndarray] = []
+        if T:
+            Tp = self.config.trigger_pad(T)
+            ws_p = np.zeros((Tp,), np.int64)
+            we_p = np.zeros((Tp,), np.int64)
+            mask = np.zeros((Tp,), bool)
+            ws_p[:T], we_p[:T], mask[:T] = ws, we, True
+            cnt_d, results = self._query(st, ws_p, we_p, mask,
+                                         np.zeros((Tp,), bool))
+            cnt_np = np.asarray(cnt_d)[:, :T]
+            for agg, res in zip(self.aggregations, results):
+                spec = agg.device_spec()
+                r = np.asarray(res)[:, :T, :]          # [K, T, w]
+                flat = spec.lower(r.reshape(-1, r.shape[-1]),
+                                  cnt_np.reshape(-1))
+                lowered.append(np.asarray(flat).reshape(self.n_keys, T))
+
+        bound = (watermark_ts - self.max_lateness) - self.max_fixed_window_size
+        self._state = self._gc(st, np.int64(bound))
+        self._last_watermark = watermark_ts
+        return ws, we, cnt_np, lowered
+
+    def process_watermark(self, watermark_ts: int):
+        """Object results: list of (key, AggregateWindow), non-empty windows
+        only — the emit contract of the reference connectors (they collect
+        only hasValue results, flink KeyedScottyWindowOperator.java:79-82)."""
+        ws, we, cnt, lowered = self.process_watermark_arrays(watermark_ts)
+        out = []
+        for kk in range(self.n_keys):
+            for i in range(ws.shape[0]):
+                if cnt[kk, i] > 0:
+                    values = [lw[kk, i] for lw in lowered]
+                    out.append((kk, AggregateWindow(
+                        WindowMeasure.Time, int(ws[i]), int(we[i]), values,
+                        True)))
+        return out

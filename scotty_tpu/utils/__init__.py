@@ -1,0 +1,16 @@
+"""Aux subsystems (SURVEY.md §5): checkpoint/resume, metrics, profiling."""
+
+from .checkpoint import (
+    restore_engine_operator,
+    restore_host_operator,
+    save_engine_operator,
+    save_host_operator,
+)
+from .metrics import REGISTRY, MetricsRegistry, ThroughputLogger
+from .profiling import analyze_log, annotate, trace
+
+__all__ = [
+    "REGISTRY", "MetricsRegistry", "ThroughputLogger", "analyze_log",
+    "annotate", "trace", "restore_engine_operator", "restore_host_operator",
+    "save_engine_operator", "save_host_operator",
+]

@@ -1,0 +1,113 @@
+"""Metrics registry + throughput logging.
+
+SURVEY.md §5: the reference's only observability is the benchmark-side
+ThroughputLogger / ThroughputStatistics pair (benchmark/.../ThroughputLogger.java:24-49,
+ThroughputStatistics.java:3-44) and slf4j that the engine never uses — the
+engine core stays silent. Same split here: a small structured registry the
+harness/connectors write into; the engine itself logs nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class Counter:
+    value: float = 0.0
+
+    def inc(self, delta: float = 1.0) -> None:
+        self.value += delta
+
+
+@dataclass
+class Gauge:
+    value: float = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+@dataclass
+class Histogram:
+    samples: List[float] = field(default_factory=list)
+
+    def observe(self, v: float) -> None:
+        self.samples.append(v)
+
+    def percentile(self, p: float) -> float:
+        if not self.samples:
+            return 0.0
+        import numpy as np
+
+        return float(np.percentile(self.samples, p))
+
+
+class MetricsRegistry:
+    """Structured metrics: tuples/s, windows emitted/s, slice count, device
+    bytes — the TPU-side counters SURVEY.md §5 calls for."""
+
+    def __init__(self):
+        self.counters: Dict[str, Counter] = defaultdict(Counter)
+        self.gauges: Dict[str, Gauge] = defaultdict(Gauge)
+        self.histograms: Dict[str, Histogram] = defaultdict(Histogram)
+        self._t0 = time.perf_counter()
+
+    def counter(self, name: str) -> Counter:
+        return self.counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        return self.gauges[name]
+
+    def histogram(self, name: str) -> Histogram:
+        return self.histograms[name]
+
+    def snapshot(self) -> dict:
+        elapsed = time.perf_counter() - self._t0
+        out = {"elapsed_s": elapsed}
+        for n, c in self.counters.items():
+            out[n] = c.value
+            out[f"{n}_per_s"] = c.value / elapsed if elapsed else 0.0
+        for n, g in self.gauges.items():
+            out[n] = g.value
+        for n, h in self.histograms.items():
+            out[f"{n}_p50"] = h.percentile(50)
+            out[f"{n}_p99"] = h.percentile(99)
+        return out
+
+    def dump_json(self) -> str:
+        return json.dumps(self.snapshot(), default=float)
+
+
+#: Process-wide default registry (the reference's ThroughputStatistics is a
+#: process singleton too — ThroughputStatistics.java:13-17).
+REGISTRY = MetricsRegistry()
+
+
+class ThroughputLogger:
+    """Per-N-elements throughput sampler (ThroughputLogger.java:24-49):
+    call ``observe(n_tuples)`` per batch; logs elements/s at each interval."""
+
+    def __init__(self, log_every: int = 1_000_000, name: str = "ingest",
+                 registry: MetricsRegistry = REGISTRY, sink=None):
+        self.log_every = log_every
+        self.name = name
+        self.registry = registry
+        self.sink = sink or (lambda s: None)
+        self._since_log = 0
+        self._t_last = time.perf_counter()
+
+    def observe(self, n_tuples: int) -> None:
+        self.registry.counter(f"{self.name}_tuples").inc(n_tuples)
+        self._since_log += n_tuples
+        if self._since_log >= self.log_every:
+            now = time.perf_counter()
+            rate = self._since_log / (now - self._t_last)
+            self.sink(f"That's {rate:,.0f} elements/second/chip")
+            self.registry.gauge(f"{self.name}_rate").set(rate)
+            self._since_log = 0
+            self._t_last = now

@@ -1,0 +1,53 @@
+"""Profiler hooks: jax.profiler traces around engine phases (SURVEY.md §5 —
+replaces the reference's log-scraping AnalyzeTool flow with real device
+traces)."""
+
+from __future__ import annotations
+
+import contextlib
+import re
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a jax.profiler trace (viewable in TensorBoard / Perfetto)
+    around a benchmark run; no-op when log_dir is None."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-span (jax.profiler.TraceAnnotation) for phase attribution:
+    ingest / query / gc."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+_RATE_RE = re.compile(r"That's ([\d,]+) elements/second/chip")
+
+
+def analyze_log(text: str) -> dict:
+    """AnalyzeTool parity (benchmark/.../AnalyzeTool.java:12-63): scrape
+    throughput samples from harness logs, return summary statistics."""
+    import numpy as np
+
+    rates = [float(m.group(1).replace(",", ""))
+             for m in _RATE_RE.finditer(text)]
+    if not rates:
+        return {"n": 0}
+    arr = np.asarray(rates)
+    return {"n": len(rates), "mean": float(arr.mean()),
+            "min": float(arr.min()), "max": float(arr.max()),
+            "std": float(arr.std())}

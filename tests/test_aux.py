@@ -1,0 +1,219 @@
+"""Aux subsystem tests: hybrid backend selection, checkpoint/resume,
+metrics, profiling log analysis, benchmark DSL (SURVEY.md §5, §2.5)."""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    CountAggregation,
+    QuantileAggregation,
+    SessionWindow,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.hybrid import HybridWindowOperator
+
+Time = WindowMeasure.Time
+Count = WindowMeasure.Count
+
+
+# ---------------------------------------------------------------------------
+# hybrid decision tree (device analogue of SliceFactoryTest, SURVEY.md §4.2)
+# ---------------------------------------------------------------------------
+
+
+def _decide(windows, aggs):
+    op = HybridWindowOperator()
+    for w in windows:
+        op.add_window_assigner(w)
+    for a in aggs:
+        op.add_aggregation(a)
+    return op._device_realizable()
+
+
+def test_hybrid_picks_device_for_context_free_time():
+    assert _decide([TumblingWindow(Time, 10)], [SumAggregation()])
+    assert _decide([SlidingWindow(Time, 20, 5), TumblingWindow(Time, 10)],
+                   [SumAggregation(), CountAggregation()])
+
+
+def test_hybrid_picks_host_for_sessions():
+    assert not _decide([SessionWindow(Time, 10)], [SumAggregation()])
+
+
+def test_hybrid_picks_host_for_count_measure():
+    assert not _decide([TumblingWindow(Count, 10)], [SumAggregation()])
+
+
+def test_hybrid_picks_host_for_host_only_aggregate():
+    assert not _decide([TumblingWindow(Time, 10)], [QuantileAggregation(0.5)])
+
+
+def test_hybrid_runs_host_path_end_to_end():
+    op = HybridWindowOperator()
+    op.add_window_assigner(SessionWindow(Time, 5))
+    op.add_aggregation(SumAggregation())
+    op.process_element(1, 0)
+    op.process_element(2, 2)
+    op.process_element(5, 50)
+    assert op.backend == "host"
+    res = op.process_watermark(100)
+    wins = [(w.get_start(), w.get_end(), w.get_agg_values()[0])
+            for w in res if w.has_value()]
+    assert (0, 7, 3) in wins
+
+
+def test_hybrid_runs_device_path_end_to_end():
+    from scotty_tpu.engine import EngineConfig
+
+    op = HybridWindowOperator(engine_config=EngineConfig(
+        capacity=512, batch_size=32, annex_capacity=64, min_trigger_pad=32))
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    for v, t in [(1, 1), (2, 5), (3, 12), (4, 25)]:
+        op.process_element(v, t)
+    assert op.backend == "device"
+    res = op.process_watermark(30)
+    wins = [(w.get_start(), w.get_end(), w.get_agg_values()[0])
+            for w in res if w.has_value()]
+    assert (0, 10, 3.0) in wins
+    assert (10, 20, 3.0) in wins
+    assert (20, 30, 4.0) in wins
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume
+# ---------------------------------------------------------------------------
+
+
+def test_engine_checkpoint_roundtrip(tmp_path):
+    from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+    from scotty_tpu.utils import (restore_engine_operator,
+                                  save_engine_operator)
+
+    cfg = EngineConfig(capacity=512, batch_size=32, annex_capacity=64,
+                       min_trigger_pad=32)
+
+    def mk():
+        op = TpuWindowOperator(config=cfg)
+        op.add_window_assigner(TumblingWindow(Time, 10))
+        op.add_aggregation(SumAggregation())
+        return op
+
+    a = mk()
+    a.process_elements([1, 2, 3], [1, 5, 12])
+    a.process_watermark(11)
+    save_engine_operator(a, str(tmp_path / "ckpt"))
+
+    b = mk()
+    restore_engine_operator(b, str(tmp_path / "ckpt"))
+    # continue identically on both
+    for op in (a, b):
+        op.process_elements([4, 5], [15, 22])
+    ra = a.process_watermark(30)
+    rb = b.process_watermark(30)
+    assert [(w.get_start(), w.get_end(), tuple(w.get_agg_values()))
+            for w in ra] == \
+        [(w.get_start(), w.get_end(), tuple(w.get_agg_values())) for w in rb]
+
+
+def test_host_checkpoint_roundtrip(tmp_path):
+    from scotty_tpu import SlicingWindowOperator
+    from scotty_tpu.utils import restore_host_operator, save_host_operator
+
+    op = SlicingWindowOperator()
+    op.add_window_assigner(SessionWindow(Time, 5))
+    op.add_aggregation(SumAggregation())
+    op.process_element(1, 0)
+    op.process_element(2, 2)
+    save_host_operator(op, str(tmp_path / "host"))
+
+    op2 = restore_host_operator(str(tmp_path / "host"))
+    op2.process_element(5, 50)
+    res = op2.process_watermark(100)
+    wins = [(w.get_start(), w.get_end(), w.get_agg_values()[0])
+            for w in res if w.has_value()]
+    assert (0, 7, 3) in wins
+
+
+# ---------------------------------------------------------------------------
+# metrics + profiling
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry():
+    from scotty_tpu.utils import MetricsRegistry, ThroughputLogger
+
+    reg = MetricsRegistry()
+    reg.counter("tuples").inc(100)
+    reg.gauge("slices").set(42)
+    reg.histogram("latency_ms").observe(1.0)
+    reg.histogram("latency_ms").observe(9.0)
+    snap = reg.snapshot()
+    assert snap["tuples"] == 100
+    assert snap["slices"] == 42
+    assert snap["latency_ms_p99"] >= 1.0
+
+    lines = []
+    tl = ThroughputLogger(log_every=10, registry=reg, sink=lines.append)
+    tl.observe(5)
+    tl.observe(6)
+    assert any("elements/second" in s for s in lines)
+
+
+def test_analyze_log():
+    from scotty_tpu.utils import analyze_log
+
+    text = ("x\nThat's 1,000 elements/second/chip\n"
+            "That's 3,000 elements/second/chip\n")
+    out = analyze_log(text)
+    assert out["n"] == 2
+    assert out["mean"] == 2000.0
+
+
+# ---------------------------------------------------------------------------
+# benchmark DSL (BenchmarkRunner.java:96-171 parity)
+# ---------------------------------------------------------------------------
+
+
+def test_window_spec_dsl():
+    from scotty_tpu.bench import parse_window_spec
+
+    [w] = parse_window_spec("Tumbling(1000)")
+    assert isinstance(w, TumblingWindow) and w.size == 1000
+    [w] = parse_window_spec("Sliding(60000,1000)")
+    assert isinstance(w, SlidingWindow) and (w.size, w.slide) == (60000, 1000)
+    [w] = parse_window_spec("Session(500)")
+    assert isinstance(w, SessionWindow) and w.gap == 500
+    [w] = parse_window_spec("CountTumbling(1000)")
+    assert w.measure == Count
+    ws = parse_window_spec("randomTumbling(10,1000,20000)")
+    assert len(ws) == 10
+    assert all(1000 <= w.size < 20000 for w in ws)
+    ws2 = parse_window_spec("randomTumbling(10,1000,20000)")
+    assert ws == ws2                      # fixed seed, reproducible
+
+
+def test_bench_generate_batches():
+    from scotty_tpu.bench import BenchmarkConfig, generate_batches
+
+    cfg = BenchmarkConfig(throughput=1000, runtime_s=2, batch_size=256)
+    batches = generate_batches(cfg)
+    assert sum(len(v) for v, _ in batches) >= 1000
+    for _, ts in batches:
+        assert np.all(np.diff(ts) >= 0)
+
+
+def test_bench_small_run_device_vs_simulator():
+    from scotty_tpu.bench import BenchmarkConfig, run_benchmark
+
+    cfg = BenchmarkConfig(throughput=2000, runtime_s=2, batch_size=128,
+                          capacity=1 << 12, watermark_period_ms=500)
+    r_dev = run_benchmark(cfg, "Tumbling(100)", "sum", engine="TpuEngine",
+                          warmup_batches=1)
+    r_sim = run_benchmark(cfg, "Tumbling(100)", "sum", engine="Simulator")
+    assert r_dev.n_tuples == r_sim.n_tuples
+    # same stream, same windows → same emitted-window count
+    assert r_dev.n_windows_emitted == r_sim.n_windows_emitted

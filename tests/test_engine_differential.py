@@ -1,0 +1,293 @@
+"""Differential tests: TPU engine vs the reference-semantics simulator.
+
+SURVEY.md §4's transferable strategy item (d): the host simulator (exact
+reference behavior, validated by the transliterated reference suite) is the
+oracle; the device engine must produce identical window results — same
+triggered windows in the same order, same has_value flags, same aggregate
+values — on scripted and randomized streams.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    CountAggregation,
+    FixedBandWindow,
+    MaxAggregation,
+    MeanAggregation,
+    MinAggregation,
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+
+Time = WindowMeasure.Time
+
+SMALL = EngineConfig(capacity=1 << 12, batch_size=64, annex_capacity=256,
+                     min_trigger_pad=32)
+
+
+def run_both(windows, agg_factories, stream, watermarks, lateness=1000,
+             config=SMALL):
+    """Drive simulator + engine with the same scripted stream; compare
+    results at every watermark."""
+    sim = SlicingWindowOperator()
+    eng = TpuWindowOperator(config=config)
+    for op in (sim, eng):
+        for w in windows:
+            op.add_window_assigner(w)
+        for mk in agg_factories:
+            op.add_aggregation(mk())
+        op.set_max_lateness(lateness)
+
+    # `watermarks` is a list of (after_index, wm_ts): each watermark fires
+    # after the stream tuple at that index has been processed.
+    pos = 0
+    for after_idx, wm in watermarks:
+        while pos <= after_idx and pos < len(stream):
+            v, ts = stream[pos]
+            sim.process_element(v, ts)
+            eng.process_element(v, ts)
+            pos += 1
+        r_sim = sim.process_watermark(wm)
+        r_eng = eng.process_watermark(wm)
+        compare(r_sim, r_eng, wm)
+    return sim, eng
+
+
+def compare(r_sim, r_eng, wm):
+    assert len(r_sim) == len(r_eng), (
+        f"@wm={wm}: simulator emitted {len(r_sim)} windows, engine "
+        f"{len(r_eng)}:\n sim={r_sim}\n eng={r_eng}")
+    for i, (a, b) in enumerate(zip(r_sim, r_eng)):
+        assert a.get_start() == b.get_start(), (i, wm, a, b)
+        assert a.get_end() == b.get_end(), (i, wm, a, b)
+        assert a.has_value() == b.has_value(), (i, wm, a, b)
+        if a.has_value():
+            va, vb = a.get_agg_values(), b.get_agg_values()
+            assert len(va) == len(vb), (i, wm, a, b)
+            for x, y in zip(va, vb):
+                assert float(x) == pytest.approx(float(y), rel=1e-5), (
+                    i, wm, a, b)
+
+
+def test_tumbling_sum_inorder():
+    stream = [(1, 1), (2, 19), (3, 23), (4, 31), (5, 49), (6, 50)]
+    run_both([TumblingWindow(Time, 10)], [SumAggregation], stream,
+             [(2, 22), (5, 55)])
+
+
+def test_tumbling_multiwindow_multiagg():
+    stream = [(i % 7 + 1, i * 3) for i in range(40)]
+    run_both(
+        [TumblingWindow(Time, 10), TumblingWindow(Time, 25)],
+        [SumAggregation, MinAggregation, MaxAggregation, CountAggregation,
+         MeanAggregation],
+        stream, [(9, 30), (19, 60), (39, 121)])
+
+
+def test_sliding_sum():
+    stream = [(1, 0), (2, 5), (3, 12), (4, 18), (5, 25), (6, 34), (7, 41)]
+    run_both([SlidingWindow(Time, 10, 5)], [SumAggregation], stream,
+             [(3, 20), (6, 40), (6, 50)])
+
+
+def test_sliding_plus_tumbling():
+    stream = [(i + 1, i * 4 + (i % 3)) for i in range(30)]
+    run_both(
+        [SlidingWindow(Time, 20, 5), TumblingWindow(Time, 15)],
+        [SumAggregation, MaxAggregation],
+        stream, [(9, 40), (19, 80), (29, 130)])
+
+
+def test_fixed_band():
+    stream = [(1, 2), (2, 5), (3, 11), (4, 18), (5, 22), (6, 30)]
+    run_both([FixedBandWindow(Time, 5, 10)], [SumAggregation], stream,
+             [(3, 16), (5, 31)])
+
+
+def test_band_plus_sliding():
+    stream = [(i + 1, i * 2) for i in range(25)]
+    run_both(
+        [FixedBandWindow(Time, 10, 20), SlidingWindow(Time, 10, 2)],
+        [SumAggregation, MinAggregation],
+        stream, [(12, 26), (24, 50)])
+
+
+def test_empty_gaps_between_tuples():
+    # tuples skip whole window ranges: empty windows must still be emitted
+    # (has_value False) and slice gaps must not corrupt range queries.
+    stream = [(1, 1), (2, 3), (3, 55), (4, 57), (5, 140)]
+    run_both([TumblingWindow(Time, 10)], [SumAggregation, MeanAggregation],
+             stream, [(1, 10), (3, 60), (4, 150)])
+
+
+def test_out_of_order_within_lateness():
+    # late tuples fold into existing slices (no session windows → no repair)
+    stream = [(1, 10), (2, 20), (3, 31), (4, 15), (5, 42), (6, 8), (7, 51)]
+    run_both([TumblingWindow(Time, 10)], [SumAggregation, MaxAggregation],
+             stream, [(6, 55)], lateness=1000)
+
+
+def test_out_of_order_into_empty_range_annex():
+    # a late tuple lands in a grid range that was never materialized → annex
+    stream = [(1, 5), (2, 60), (3, 25), (4, 61), (5, 35), (6, 70)]
+    run_both([TumblingWindow(Time, 10)], [SumAggregation, CountAggregation],
+             stream, [(5, 80)], lateness=1000)
+
+
+def test_out_of_order_across_watermarks():
+    stream = [(1, 5), (2, 30), (3, 12), (4, 45), (5, 33), (6, 95), (7, 58),
+              (8, 99)]
+    run_both([SlidingWindow(Time, 20, 10)], [SumAggregation],
+             stream, [(2, 25), (4, 40), (7, 100)], lateness=1000)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_inorder(seed):
+    rng = np.random.default_rng(seed)
+    ts = np.cumsum(rng.integers(0, 7, size=300))
+    vals = rng.integers(1, 100, size=300)
+    stream = [(int(v), int(t)) for v, t in zip(vals, ts)]
+    wm_points = sorted(rng.choice(np.arange(20, 280), size=5, replace=False))
+    watermarks = [(int(p), int(ts[p]) + int(rng.integers(0, 5)))
+                  for p in wm_points]
+    # strictly increasing watermark ts
+    watermarks = [(p, w) for j, (p, w) in enumerate(watermarks)
+                  if all(w > w2 for _, w2 in watermarks[:j])]
+    run_both(
+        [TumblingWindow(Time, 13), SlidingWindow(Time, 40, 8),
+         TumblingWindow(Time, 50)],
+        [SumAggregation, MinAggregation, MaxAggregation, MeanAggregation],
+        stream, watermarks)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_randomized_out_of_order(seed):
+    rng = np.random.default_rng(seed)
+    base = np.cumsum(rng.integers(0, 6, size=300))
+    jitter = rng.integers(0, 40, size=300)
+    ts = np.maximum(base - jitter, 0)          # ~bounded disorder
+    vals = rng.integers(1, 100, size=300)
+    stream = [(int(v), int(t)) for v, t in zip(vals, ts)]
+    wm_points = sorted(rng.choice(np.arange(50, 280), size=4, replace=False))
+    watermarks = []
+    for p in wm_points:
+        w = int(np.max(ts[:p + 1])) + 1
+        if not watermarks or w > watermarks[-1][1]:
+            watermarks.append((int(p), w))
+    run_both(
+        [TumblingWindow(Time, 11), SlidingWindow(Time, 30, 10)],
+        [SumAggregation, CountAggregation, MaxAggregation],
+        stream, watermarks, lateness=10_000)
+
+
+def test_batched_ingest_equals_scalar():
+    # process_elements([...]) must equal element-at-a-time ingestion
+    rng = np.random.default_rng(7)
+    ts = np.cumsum(rng.integers(0, 5, size=200)).astype(np.int64)
+    vals = rng.integers(1, 50, size=200).astype(np.float32)
+
+    def mk():
+        op = TpuWindowOperator(config=SMALL)
+        op.add_window_assigner(TumblingWindow(Time, 10))
+        op.add_aggregation(SumAggregation())
+        return op
+
+    a, b = mk(), mk()
+    for v, t in zip(vals, ts):
+        a.process_element(float(v), int(t))
+    b.process_elements(vals, ts)
+    wm = int(ts[-1]) + 1
+    compare(a.process_watermark(wm), b.process_watermark(wm), wm)
+
+
+# ---------------------------------------------------------------------------
+# count-measure device path
+# ---------------------------------------------------------------------------
+
+
+def test_count_tumbling_inorder():
+    # reference scenario (TumblingWindowOperatorTest count cases, in-order)
+    stream = [(1, 1), (1, 19), (1, 29), (2, 39), (2, 49), (2, 50), (1, 51)]
+    run_both([TumblingWindow(WindowMeasure.Count, 3)], [SumAggregation],
+             stream, [(6, 55)])
+
+
+def test_count_two_windows_inorder():
+    stream = [(1, 1), (1, 19), (1, 29), (2, 39), (1, 41), (2, 45), (2, 50),
+              (1, 51), (3, 52)]
+    run_both([TumblingWindow(WindowMeasure.Count, 3),
+              TumblingWindow(WindowMeasure.Count, 5)],
+             [SumAggregation], stream, [(8, 55)])
+
+
+def test_count_mixed_with_time_inorder():
+    stream = [(i + 1, i * 7) for i in range(30)]
+    run_both([TumblingWindow(WindowMeasure.Count, 4),
+              TumblingWindow(Time, 50)],
+             [SumAggregation, MaxAggregation], stream,
+             [(9, 65), (19, 135), (29, 205)])
+
+
+def test_count_multi_watermark():
+    stream = [(1, 1), (1, 19), (1, 29), (2, 39), (1, 41), (2, 44)]
+    run_both([TumblingWindow(WindowMeasure.Count, 3)], [SumAggregation],
+             stream, [(3, 40), (5, 55)])
+
+
+def test_count_out_of_order_raises_on_device():
+    from scotty_tpu.engine import TpuWindowOperator, UnsupportedOnDevice
+
+    op = TpuWindowOperator(config=SMALL)
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
+    op.add_aggregation(SumAggregation())
+    op.process_elements([1, 2], [10, 20])
+    op.process_watermark(25)             # flushes; max event time now 20
+    with pytest.raises(UnsupportedOnDevice):
+        op.process_elements([3], [5])    # late across flushed batches
+        op.process_watermark(30)
+
+
+# ---------------------------------------------------------------------------
+# pure-session device path
+# ---------------------------------------------------------------------------
+
+
+def test_session_inorder():
+    from scotty_tpu import SessionWindow
+
+    stream = [(1, 0), (2, 3), (3, 20), (4, 22), (5, 60), (6, 61), (7, 63)]
+    run_both([SessionWindow(Time, 10)], [SumAggregation], stream,
+             [(3, 40), (6, 100)])
+
+
+def test_session_inorder_multi_agg():
+    from scotty_tpu import SessionWindow
+
+    rng = np.random.default_rng(9)
+    ts, t = [], 0
+    for i in range(120):
+        t += int(rng.integers(0, 4)) if i % 20 else 50   # periodic gaps
+        ts.append(t)
+    vals = rng.integers(1, 30, size=120)
+    stream = [(int(v), int(tt)) for v, tt in zip(vals, ts)]
+    run_both([SessionWindow(Time, 12)],
+             [SumAggregation, MinAggregation, MaxAggregation, MeanAggregation],
+             stream, [(59, ts[59] + 1), (119, ts[119] + 100)])
+
+
+def test_session_still_open_not_emitted():
+    from scotty_tpu import SessionWindow
+
+    stream = [(1, 0), (2, 5), (3, 8)]
+    # watermark inside gap: session [0, 8+10) not complete at wm 10
+    sim, eng = run_both([SessionWindow(Time, 10)], [SumAggregation], stream,
+                        [(2, 10)])
+    # completes later
+    r_sim = sim.process_watermark(30)
+    r_eng = eng.process_watermark(30)
+    compare(r_sim, r_eng, 30)

@@ -1,0 +1,125 @@
+"""Keyed + global operator tests on a virtual 8-device CPU mesh
+(SURVEY.md §4e — the reference never tests multi-node; we do)."""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    MaxAggregation,
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.parallel import (
+    GlobalTpuWindowOperator,
+    KeyedTpuWindowOperator,
+    make_mesh,
+)
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=1 << 10, batch_size=32, annex_capacity=128,
+                   min_trigger_pad=32)
+
+
+def keyed_reference(n_keys, windows, agg_factories, keys, vals, ts, wm,
+                    lateness=1000):
+    """Oracle: one host simulator per key (the reference connector model)."""
+    sims = {}
+    for k in range(n_keys):
+        op = SlicingWindowOperator()
+        for w in windows:
+            op.add_window_assigner(w)
+        for mk in agg_factories:
+            op.add_aggregation(mk())
+        op.set_max_lateness(lateness)
+        sims[k] = op
+    for k, v, t in zip(keys, vals, ts):
+        sims[int(k)].process_element(float(v), int(t))
+    out = {}
+    for k in range(n_keys):
+        out[k] = [w for w in sims[k].process_watermark(wm) if w.has_value()]
+    return out
+
+
+def test_keyed_matches_per_key_simulators():
+    rng = np.random.default_rng(11)
+    n_keys = 4
+    N = 400
+    keys = rng.integers(0, n_keys, size=N)
+    ts = np.sort(rng.integers(0, 300, size=N))
+    vals = rng.integers(1, 50, size=N)
+    windows = [TumblingWindow(Time, 20), SlidingWindow(Time, 50, 10)]
+
+    op = KeyedTpuWindowOperator(n_keys=n_keys, config=CFG)
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(SumAggregation())
+    op.add_aggregation(MaxAggregation())
+    op.process_keyed_elements(keys, vals, ts)
+    wm = int(ts[-1]) + 1
+    got = op.process_watermark(wm)
+
+    want = keyed_reference(n_keys, windows, [SumAggregation, MaxAggregation],
+                           keys, vals, ts, wm)
+    got_by_key: dict = {k: [] for k in range(n_keys)}
+    for k, w in got:
+        got_by_key[k].append(w)
+    for k in range(n_keys):
+        assert len(got_by_key[k]) == len(want[k]), (k, got_by_key[k], want[k])
+        for a, b in zip(want[k], got_by_key[k]):
+            assert a.get_start() == b.get_start()
+            assert a.get_end() == b.get_end()
+            for x, y in zip(a.get_agg_values(), b.get_agg_values()):
+                assert float(x) == pytest.approx(float(y), rel=1e-5)
+
+
+def test_keyed_on_mesh():
+    mesh = make_mesh("keys")
+    n_keys = 8 * 2                       # 2 key shards per device
+    op = KeyedTpuWindowOperator(n_keys=n_keys, config=CFG, mesh=mesh)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+
+    rng = np.random.default_rng(5)
+    N = 256
+    keys = rng.integers(0, n_keys, size=N)
+    ts = np.sort(rng.integers(0, 100, size=N))
+    vals = np.ones(N)
+    op.process_keyed_elements(keys, vals, ts)
+    got = op.process_watermark(101)
+    # total count across all keys/windows == N (tumbling partitions time)
+    total = sum(w.get_agg_values()[0] for _, w in got)
+    assert total == pytest.approx(N)
+
+
+def test_global_operator_matches_single_simulator():
+    rng = np.random.default_rng(3)
+    N = 300
+    ts = np.sort(rng.integers(0, 200, size=N))
+    vals = rng.integers(1, 30, size=N)
+
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(TumblingWindow(Time, 25))
+    sim.add_aggregation(SumAggregation())
+    for v, t in zip(vals, ts):
+        sim.process_element(int(v), int(t))
+    wm = int(ts[-1]) + 1
+    want = sim.process_watermark(wm)
+
+    op = GlobalTpuWindowOperator(n_shards=8, config=CFG, mesh=make_mesh("shards"))
+    op.add_window_assigner(TumblingWindow(Time, 25))
+    op.add_aggregation(SumAggregation())
+    op.process_elements(vals, ts)
+    got = op.process_watermark(wm)
+
+    assert len(want) == len(got)
+    for a, b in zip(want, got):
+        assert a.get_start() == b.get_start()
+        assert a.get_end() == b.get_end()
+        assert a.has_value() == b.has_value()
+        if a.has_value():
+            assert float(a.get_agg_values()[0]) == pytest.approx(
+                float(b.get_agg_values()[0]), rel=1e-5)
