@@ -176,9 +176,10 @@ class SlidingWindow(ContextFreeWindow):
 
     def trigger_arrays(self, last_watermark: int, current_watermark: int):
         last_start = self.window_start_with_offset(current_watermark, self.slide)
-        # descending starts s: s + size > last_wm, s >= 0, s + size <= wm + 1
-        n_total = (last_start - (last_watermark - self.size)) // self.slide
-        n_total = max(0, n_total)
+        # descending starts s = last_start - k*slide with s + size > last_wm
+        # (strict) → k < (last_start - last_wm + size)/slide → ceil-div count.
+        d = last_start - (last_watermark - self.size)
+        n_total = max(0, -(-d // self.slide))
         starts = last_start - self.slide * np.arange(n_total, dtype=np.int64)
         keep = (starts >= 0) & (starts + self.size <= current_watermark + 1)
         starts = starts[keep]

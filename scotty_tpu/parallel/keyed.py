@@ -182,27 +182,36 @@ class KeyedTpuWindowOperator:
         k, v, t = k[order], v[order], t[order]
         counts = np.bincount(k, minlength=self.n_keys)
         max_per_key = int(counts.max()) if counts.size else 0
-        offsets = np.concatenate([[0], np.cumsum(counts)])
-        while max_per_key > 0:
-            take = min(max_per_key, B)
-            ts_b = np.zeros((self.n_keys, B), np.int64)
-            vals_b = np.zeros((self.n_keys, B), np.float32)
-            valid_b = np.zeros((self.n_keys, B), bool)
-            for kk in range(self.n_keys):
-                lo, hi = offsets[kk], offsets[kk + 1]
-                n = min(take, hi - lo)
-                if n > 0:
-                    ts_b[kk, :n] = t[lo:lo + n]
-                    vals_b[kk, :n] = v[lo:lo + n]
-                    valid_b[kk, :n] = True
-                    # pad lanes repeat the last ts → no spurious slices
-                    ts_b[kk, n:] = t[lo + n - 1]
-                    offsets[kk] = lo + n
-                elif hi > lo or lo > 0:
-                    pass
-            # keys with no tuples: all-invalid lanes (ts 0 is harmless)
-            self._state = self._ingest(self._state, ts_b, vals_b, valid_b)
-            max_per_key -= take
+        if max_per_key == 0:
+            return
+        # Vectorized packing: tuple j of key k lands in round pos//B,
+        # lane pos%B, where pos is its rank within its key. One scatter
+        # builds every round's [K, B] batch — no per-key Python loop
+        # (the reference's per-key HashMap walk has no business on the
+        # host side of a batched device program).
+        starts = np.zeros(self.n_keys, np.int64)
+        starts[1:] = np.cumsum(counts)[:-1]
+        pos = np.arange(t.size, dtype=np.int64) - starts[k]
+        rnd = pos // B
+        lane = pos % B
+        n_rounds = (max_per_key + B - 1) // B
+        ts_b = np.zeros((n_rounds, self.n_keys, B), np.int64)
+        vals_b = np.zeros((n_rounds, self.n_keys, B), np.float32)
+        valid_b = np.zeros((n_rounds, self.n_keys, B), bool)
+        ts_b[rnd, k, lane] = t
+        vals_b[rnd, k, lane] = v
+        valid_b[rnd, k, lane] = True
+        # pad lanes repeat the row's last valid ts → no spurious slices
+        # (valid lanes are a contiguous prefix of each row; all-invalid
+        # rows stay ts 0, which the ingest kernel ignores).
+        row_n = valid_b.sum(axis=2)                       # [R, K]
+        last_ts = np.take_along_axis(
+            ts_b, np.maximum(row_n - 1, 0)[..., None], axis=2)
+        pad = ~valid_b & (row_n > 0)[..., None]
+        ts_b = np.where(pad, last_ts, ts_b)
+        for r in range(n_rounds):
+            self._state = self._ingest(self._state, ts_b[r], vals_b[r],
+                                       valid_b[r])
 
     # -- watermark ---------------------------------------------------------
     def process_watermark_arrays(self, watermark_ts: int):

@@ -385,10 +385,16 @@ class StreamSlicer:
         return edge
 
     def _calculate_next_fixed_edge(self, te: int) -> int:
-        # StreamSlicer.java:103-116 — note the Long.MAX_VALUE seed and Java
-        # overflow semantics on the very first call (see _wrap64).
-        current_min_edge = LONG_MAX if self.min_next_edge_ts == LONG_MIN else self.min_next_edge_ts
-        t_c = max(te - self.window_manager.get_max_lateness(), current_min_edge)
+        # StreamSlicer.java:103-116.  Deliberate deviation from the reference:
+        # Java seeds the first call with Long.MAX_VALUE and relies on overflow
+        # to produce a garbage negative edge that the caller's loop then
+        # recomputes — but for any window grid dividing 2^63 (every power of
+        # two) the wrap lands exactly on Long.MIN_VALUE, which collides with
+        # the "uninitialized" sentinel and spins determine_slices forever (a
+        # latent reference bug).  We seed directly from te - maxLateness,
+        # which is the value Java's second iteration converges to anyway.
+        t_c = max(te - self.window_manager.get_max_lateness(),
+                  self.min_next_edge_ts)
         edge = LONG_MAX
         for w in self.window_manager.get_context_free_windows():
             if w.measure == WindowMeasure.Time:
