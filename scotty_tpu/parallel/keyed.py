@@ -195,23 +195,26 @@ class KeyedTpuWindowOperator:
         rnd = pos // B
         lane = pos % B
         n_rounds = (max_per_key + B - 1) // B
-        ts_b = np.zeros((n_rounds, self.n_keys, B), np.int64)
-        vals_b = np.zeros((n_rounds, self.n_keys, B), np.float32)
-        valid_b = np.zeros((n_rounds, self.n_keys, B), bool)
-        ts_b[rnd, k, lane] = t
-        vals_b[rnd, k, lane] = v
-        valid_b[rnd, k, lane] = True
-        # pad lanes repeat the row's last valid ts → no spurious slices
-        # (valid lanes are a contiguous prefix of each row; all-invalid
-        # rows stay ts 0, which the ingest kernel ignores).
-        row_n = valid_b.sum(axis=2)                       # [R, K]
-        last_ts = np.take_along_axis(
-            ts_b, np.maximum(row_n - 1, 0)[..., None], axis=2)
-        pad = ~valid_b & (row_n > 0)[..., None]
-        ts_b = np.where(pad, last_ts, ts_b)
         for r in range(n_rounds):
-            self._state = self._ingest(self._state, ts_b[r], vals_b[r],
-                                       valid_b[r])
+            # one [K, B] trio per round (not all rounds at once — a
+            # hot-key-skewed flush would otherwise allocate
+            # O(n_keys * max_per_key) host memory)
+            m = rnd == r
+            ts_b = np.zeros((self.n_keys, B), np.int64)
+            vals_b = np.zeros((self.n_keys, B), np.float32)
+            valid_b = np.zeros((self.n_keys, B), bool)
+            ts_b[k[m], lane[m]] = t[m]
+            vals_b[k[m], lane[m]] = v[m]
+            valid_b[k[m], lane[m]] = True
+            # pad lanes repeat the row's last valid ts → no spurious slices
+            # (valid lanes are a contiguous prefix of each row; all-invalid
+            # rows stay ts 0, which the ingest kernel ignores).
+            row_n = valid_b.sum(axis=1)                    # [K]
+            last_ts = ts_b[np.arange(self.n_keys),
+                           np.maximum(row_n - 1, 0)]
+            pad = ~valid_b & (row_n > 0)[:, None]
+            ts_b = np.where(pad, last_ts[:, None], ts_b)
+            self._state = self._ingest(self._state, ts_b, vals_b, valid_b)
 
     # -- watermark ---------------------------------------------------------
     def process_watermark_arrays(self, watermark_ts: int):

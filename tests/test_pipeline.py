@@ -1,0 +1,153 @@
+"""Differential tests: the fused AlignedStreamPipeline vs the host oracle.
+
+The aligned pipeline is the benchmark execution mode (bench.py): the paced
+generator emits tuples grouped by slice row and ingest is a dense row
+reduction. These tests materialize the pipeline's own generated stream
+(``materialize_interval`` replays the device RNG bit-exactly), feed it to the
+reference-semantics simulator, and require identical window results at every
+watermark — the same oracle strategy as test_engine_differential.py.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    MaxAggregation,
+    MeanAggregation,
+    MinAggregation,
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+Time = WindowMeasure.Time
+
+CFG = EngineConfig(capacity=1 << 12, annex_capacity=8, min_trigger_pad=32)
+
+
+def run_diff(windows, agg_factories, throughput, wm_period, n_intervals,
+             seed=0, oracle="sim"):
+    """oracle='sim': reference-semantics simulator (exact parity — valid when
+    every window size is a multiple of its slide, so reference slices never
+    straddle a window end). oracle='exact': brute-force per-window recompute
+    from the raw tuples — used for size%slide!=0 specs, where the reference
+    SILENTLY DROPS the straddling slice's in-window tuples
+    (AggregateWindowState.java:25-31 t_last containment over the coarse slide
+    grid); the aligned pipeline deliberately deviates by slicing on
+    gcd(sizes, slides) so every window aggregate is exact."""
+    p = AlignedStreamPipeline(
+        windows, [mk() for mk in agg_factories], config=CFG,
+        throughput=throughput, wm_period_ms=wm_period, seed=seed,
+        gc_every=10 ** 9)
+    sim = SlicingWindowOperator()
+    for w in windows:
+        sim.add_window_assigner(w)
+    for mk in agg_factories:
+        sim.add_aggregation(mk())
+    sim.set_max_lateness(1000)
+    aggs = [mk() for mk in agg_factories]
+    all_vals = []
+    all_ts = []
+
+    p.reset()
+    for i in range(n_intervals):
+        out = p.run(1)[0]
+        vals, ts = p.materialize_interval(i)
+        wm = (i + 1) * wm_period
+        if oracle == "sim":
+            order = np.argsort(ts, kind="stable")
+            for v, t in zip(vals[order], ts[order]):
+                sim.process_element(float(v), int(t))
+            r_sim = [w for w in sim.process_watermark(wm) if w.has_value()]
+            oracle_map = {}
+            for w in r_sim:
+                oracle_map.setdefault((w.get_start(), w.get_end()),
+                                      w.get_agg_values())
+        else:
+            all_vals.append(vals)
+            all_ts.append(ts)
+            cat_v = np.concatenate(all_vals)
+            cat_t = np.concatenate(all_ts)
+            oracle_map = {}
+            for w in windows:
+                s_arr, e_arr = w.trigger_arrays(i * wm_period, wm)
+                for s, e in zip(s_arr, e_arr):
+                    m = (cat_t >= s) & (cat_t < e)
+                    if m.any():
+                        sel = cat_v[m].astype(np.float64)
+                        row = []
+                        for a in aggs:
+                            part = a.lift(float(sel[0]))
+                            for v in sel[1:]:
+                                part = a.combine(part, a.lift(float(v)))
+                            row.append(a.lower(part))
+                        oracle_map.setdefault((int(s), int(e)), row)
+        rows = p.lowered_results(out)
+
+        pipe_map = {(s, e): v for (s, e, c, v) in rows}
+        assert set(pipe_map) == set(oracle_map), (
+            f"interval {i} @wm={wm}: window-set mismatch "
+            f"{set(oracle_map) ^ set(pipe_map)}")
+        for k2 in oracle_map:
+            for a, b in zip(oracle_map[k2], pipe_map[k2]):
+                assert float(a) == pytest.approx(float(b), rel=2e-4), (
+                    i, k2, oracle_map[k2], pipe_map[k2])
+    p.check_overflow()
+    return p
+
+
+def test_aligned_sliding_tumbling_mix():
+    run_diff([SlidingWindow(Time, 60, 20), TumblingWindow(Time, 50)],
+             [SumAggregation, MaxAggregation],
+             throughput=3000, wm_period=100, n_intervals=6)
+
+
+def test_aligned_size_not_multiple_of_slide():
+    # Sliding(25,10): window ends are ≡ 5 (mod 10) — the straddling-slice
+    # containment hole of coarse grids; the aligned grid = gcd(25,10) = 5
+    # puts every end on a slice edge.
+    p = run_diff([SlidingWindow(Time, 25, 10)],
+                 [SumAggregation, MinAggregation],
+                 throughput=4000, wm_period=100, n_intervals=5,
+                 oracle="exact")
+    assert p.grid == 5
+
+
+def test_aligned_1ms_grid_boundary_windows():
+    # slide 1: every watermark has a boundary window with end == wm + 1
+    # (the reference's <= wm+1 sliding guard, incl. its re-emission quirk);
+    # differential equality proves the trigger grid reproduces it.
+    run_diff([SlidingWindow(Time, 60, 1)], [SumAggregation],
+             throughput=2000, wm_period=20, n_intervals=8)
+
+
+def test_aligned_mean_width2():
+    run_diff([TumblingWindow(Time, 40)], [MeanAggregation, SumAggregation],
+             throughput=2500, wm_period=80, n_intervals=5)
+
+
+def test_aligned_gc_preserves_results():
+    # gc_every=2 forces GC mid-run; results must stay identical
+    windows = [SlidingWindow(Time, 60, 20)]
+    p = AlignedStreamPipeline(windows, [SumAggregation()], config=CFG,
+                              throughput=3000, wm_period_ms=100, gc_every=2,
+                              max_lateness=100)
+    q = AlignedStreamPipeline(windows, [SumAggregation()], config=CFG,
+                              throughput=3000, wm_period_ms=100,
+                              gc_every=10 ** 9, max_lateness=100)
+    p.reset()
+    q.reset()
+    for i in range(8):
+        rp = p.lowered_results(p.run(1)[0])
+        rq = q.lowered_results(q.run(1)[0])
+        assert [(s, e, c) for s, e, c, _ in rp] == \
+               [(s, e, c) for s, e, c, _ in rq], (i, rp, rq)
+        for (_, _, _, va), (_, _, _, vb) in zip(rp, rq):
+            for a, b in zip(va, vb):
+                # prefix sums re-associate after the GC roll → f32 rounding
+                assert float(a) == pytest.approx(float(b), rel=1e-5)
+    p.check_overflow()
