@@ -17,7 +17,7 @@ def main():
 
     dev = TpuWindowOperator()
     dev.add_window_assigner(TumblingWindow(WindowMeasure.Time, 1000))
-    dev.add_aggregation(DDSketchQuantileAggregation(0.5, alpha=0.01))
+    dev.add_aggregation(DDSketchQuantileAggregation(0.5))
 
     stream = list(value_stream(n=20_000, ms_per_tuple=0.5))
     for v, t in stream:

@@ -9,9 +9,10 @@ from .harness import (
     parse_window_spec,
     run_benchmark,
 )
+from .runner import load_config, main, run_cell, run_config
 
 __all__ = [
     "BenchmarkConfig", "BenchResult", "ThroughputStatistics",
-    "generate_batches", "make_aggregation", "parse_window_spec",
-    "run_benchmark",
+    "generate_batches", "load_config", "main", "make_aggregation",
+    "parse_window_spec", "run_benchmark", "run_cell", "run_config",
 ]

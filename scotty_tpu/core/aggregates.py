@@ -344,8 +344,12 @@ class DDSketchQuantileAggregation(AggregateFunction):
     by ``alpha``.
     """
 
-    def __init__(self, quantile: float, alpha: float = 0.01, n_buckets: int = 512,
-                 min_value: float = 1e-9):
+    def __init__(self, quantile: float, alpha: float = 0.02, n_buckets: int = 512,
+                 min_value: float = 1e-3):
+        # Defaults cover (1e-3, ~7e5) at 2 % relative error: the dynamic
+        # range is gamma^(n_buckets-2) ≈ e^{(n-2)·2α}, so the previous
+        # α=0.01/min=1e-9 defaults topped out at ~3e-5 and silently clamped
+        # every realistic value into the last bucket.
         self.quantile = quantile
         self.alpha = alpha
         self.n_buckets = n_buckets

@@ -86,10 +86,19 @@ class EngineSpec:
     count_periods: tuple[int, ...]
     aggs: tuple[DeviceAggregateSpec, ...]
     session_gaps: tuple[int, ...] = ()
+    #: (period, offset) residue grids: window END edges of sliding windows
+    #: whose size is not a multiple of their slide land at
+    #: k*slide + (size % slide) — off the slide grid. Adding these edges to
+    #: the slice grid keeps every window boundary on a slice edge, so range
+    #: queries are EXACT. Deliberate deviation from the reference, which
+    #: slices on the slide grid only and silently DROPS the straddling
+    #: slice's in-window tuples (AggregateWindowState.java:25-31 t_last
+    #: containment) — see VERDICT r1 item 6.
+    offset_periods: tuple[tuple[int, int], ...] = ()
 
     @property
     def has_time_grid(self) -> bool:
-        return bool(self.periods or self.bands)
+        return bool(self.periods or self.bands or self.offset_periods)
 
     @property
     def pure_session(self) -> bool:
@@ -113,6 +122,9 @@ def grid_start(spec: EngineSpec, ts: jnp.ndarray) -> jnp.ndarray:
             p = jnp.asarray(pall[i:i + 128])
             cands.append(jnp.max(ts[:, None] - jnp.mod(ts[:, None], p[None, :]),
                                  axis=1))
+    for (p, r) in spec.offset_periods:
+        # largest point ≤ ts congruent to r (mod p), clamped to ≥ 0
+        cands.append(jnp.maximum(ts - jnp.mod(ts - r, p), 0))
     for (bs, bsz) in spec.bands:
         c = jnp.where(ts >= bs + bsz, jnp.int64(bs + bsz),
                       jnp.where(ts >= bs, jnp.int64(bs), jnp.int64(0)))
@@ -133,6 +145,9 @@ def next_edge(spec: EngineSpec, s: jnp.ndarray) -> jnp.ndarray:
             p = jnp.asarray(pall[i:i + 128])
             cands.append(jnp.min(s[:, None] - jnp.mod(s[:, None], p[None, :])
                                  + p[None, :], axis=1))
+    for (p, r) in spec.offset_periods:
+        # smallest point > s congruent to r (mod p)
+        cands.append(s + p - jnp.mod(s - r, p))
     for (bs, bsz) in spec.bands:
         for pt in (bs, bs + bsz):
             c = jnp.where(s < pt, jnp.int64(pt), I64_MAX)

@@ -70,7 +70,8 @@ def _kernels(spec, capacity: int, annex_capacity: int):
     from . import core as ec
 
     key = (spec.periods, spec.bands, spec.count_periods, spec.session_gaps,
-           tuple(a.token for a in spec.aggs), capacity, annex_capacity)
+           spec.offset_periods, tuple(a.token for a in spec.aggs), capacity,
+           annex_capacity)
     hit = _KERNEL_CACHE.get(key)
     if hit is None:
         hit = (
@@ -170,6 +171,7 @@ class TpuWindowOperator(WindowOperator):
         bands = []
         count_periods = []
         session_gaps = []
+        offset_periods = []
         for w in self.windows:
             if isinstance(w, SessionWindow):
                 session_gaps.append(int(w.gap))
@@ -181,6 +183,11 @@ class TpuWindowOperator(WindowOperator):
                 periods.append(int(w.size))
             elif isinstance(w, SlidingWindow):
                 periods.append(int(w.slide))
+                if w.size % w.slide:
+                    # window ends off the slide grid: add their residue grid
+                    # so range queries stay exact (EngineSpec.offset_periods)
+                    offset_periods.append((int(w.slide),
+                                           int(w.size % w.slide)))
             elif isinstance(w, FixedBandWindow):
                 bands.append((int(w.start), int(w.size)))
         self._spec = ec.EngineSpec(
@@ -189,6 +196,7 @@ class TpuWindowOperator(WindowOperator):
             count_periods=tuple(sorted(set(count_periods))),
             aggs=tuple(a.device_spec() for a in self.aggregations),
             session_gaps=tuple(session_gaps),
+            offset_periods=tuple(sorted(set(offset_periods))),
         )
         C, A = self.config.capacity, self.config.annex_capacity
         self._state = ec.init_state(self._spec, C, A)
@@ -325,6 +333,8 @@ class TpuWindowOperator(WindowOperator):
         best = 0
         for p in self._spec.periods:
             best = max(best, ts - ts % p if ts >= 0 else 0)
+        for (p, r) in self._spec.offset_periods:
+            best = max(best, ts - (ts - r) % p)
         for (bs, bsz) in self._spec.bands:
             if ts >= bs + bsz:
                 best = max(best, bs + bsz)

@@ -277,6 +277,18 @@ class AlignedStreamPipeline:
     multiple of the grid g; throughput*g/1000 ≥ 1 tuple per slice.
     """
 
+    @staticmethod
+    def slice_grid(windows, wm_period_ms: int) -> int:
+        """The uniform slice grid: gcd of every window's slide and size AND
+        the watermark period — every window edge and every watermark lands
+        on a slice boundary."""
+        members = [wm_period_ms]
+        for w in windows:
+            members.append(int(w.size))
+            if isinstance(w, SlidingWindow):
+                members.append(int(w.slide))
+        return _gcd_all(members)
+
     def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
                  config: Optional[EngineConfig] = None,
                  throughput: int = 200_000_000, wm_period_ms: int = 1000,
@@ -295,7 +307,6 @@ class AlignedStreamPipeline:
         self.gc_every = gc_every
         self.seed = seed
 
-        grid_members = []
         max_fixed = 0
         for w in self.windows:
             if w.measure != WindowMeasure.Time or not isinstance(
@@ -304,18 +315,14 @@ class AlignedStreamPipeline:
                     "aligned pipeline: Time tumbling/sliding only; use "
                     "StreamPipeline")
             max_fixed = max(max_fixed, w.clear_delay())
-            grid_members.append(int(w.size))
-            if isinstance(w, SlidingWindow):
-                grid_members.append(int(w.slide))
+        max_width = 1
         for a in self.aggregations:
             spec = a.device_spec()
-            if spec is None or spec.lift_dense is None:
+            if spec is None:
                 raise NotImplementedError(
-                    "aligned pipeline: dense-lift aggregations only")
-        g = _gcd_all(grid_members)
-        if wm_period_ms % g:
-            raise ValueError(f"wm_period_ms {wm_period_ms} not a multiple of "
-                             f"slice grid {g}")
+                    "aligned pipeline: device-realizable aggregations only")
+            max_width = max(max_width, spec.width)
+        g = self.slice_grid(self.windows, wm_period_ms)
         if throughput * g % 1000:
             raise ValueError(
                 f"throughput {throughput} is not an integer number of tuples "
@@ -330,9 +337,11 @@ class AlignedStreamPipeline:
         self.tuples_per_interval = S * R
 
         # rows per generation chunk: largest divisor of S within the budget
+        # (the budget counts lifted elements, so wide sketch partials shrink
+        # the chunk rather than exploding the [d*R, width] lift temporary)
         d = 1
         for cand in range(1, S + 1):
-            if S % cand == 0 and cand * R <= max_chunk_elems:
+            if S % cand == 0 and cand * R * max_width <= max_chunk_elems:
                 d = cand
         self.rows_per_chunk = d
         n_chunks = S // d
@@ -366,7 +375,19 @@ class AlignedStreamPipeline:
                 flat = vals.reshape(-1)
                 parts = []
                 for aspec in spec.aggs:
-                    lifted = aspec.lift_dense(flat).reshape(d, R, -1)
+                    if aspec.is_sparse:
+                        # sketches: each tuple touches one of `width` columns
+                        # — densify via a one-hot compare (combine identity
+                        # elsewhere); the row reduction then folds the whole
+                        # chunk's histogram/registers at once.
+                        col, v = aspec.lift_sparse(flat)
+                        lifted = jnp.where(
+                            col[:, None] == jnp.arange(aspec.width)[None, :],
+                            v[:, None], jnp.asarray(aspec.identity,
+                                                    v.dtype))
+                    else:
+                        lifted = aspec.lift_dense(flat)
+                    lifted = lifted.reshape(d, R, -1)
                     parts.append(red[aspec.kind](lifted, axis=1))   # [d, w]
                 return None, (tuple(parts), jnp.min(offs, axis=1),
                               jnp.max(offs, axis=1))

@@ -92,12 +92,15 @@ class KeyedTpuWindowOperator:
 
         from ..engine import core as ec
 
-        periods, bands = [], []
+        periods, bands, offset_periods = [], [], []
         for w in self.windows:
             if isinstance(w, TumblingWindow):
                 periods.append(int(w.size))
             elif isinstance(w, SlidingWindow):
                 periods.append(int(w.slide))
+                if w.size % w.slide:
+                    offset_periods.append((int(w.slide),
+                                           int(w.size % w.slide)))
             elif isinstance(w, FixedBandWindow):
                 bands.append((int(w.start), int(w.size)))
         self._spec = ec.EngineSpec(
@@ -105,9 +108,10 @@ class KeyedTpuWindowOperator:
             bands=tuple(sorted(set(bands))),
             count_periods=(),
             aggs=tuple(a.device_spec() for a in self.aggregations),
+            offset_periods=tuple(sorted(set(offset_periods))),
         )
         C, A = self.config.capacity, self.config.annex_capacity
-        key = (self._spec.periods, self._spec.bands,
+        key = (self._spec.periods, self._spec.bands, self._spec.offset_periods,
                tuple(a.token for a in self._spec.aggs), C, A, self.n_keys,
                id(self.mesh), self.axis)
         hit = _KERNEL_CACHE.get(key)

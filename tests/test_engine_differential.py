@@ -206,6 +206,83 @@ def test_batched_ingest_equals_scalar():
 
 
 # ---------------------------------------------------------------------------
+# size % slide != 0: exact-semantics deviation
+# ---------------------------------------------------------------------------
+# The reference slices on the slide grid only and its t_last containment
+# DROPS the straddling slice's in-window tuples when a window end falls off
+# the grid (AggregateWindowState.java:25-31). The engine instead adds the
+# window-end residue grids to the slice grid (EngineSpec.offset_periods) and
+# returns EXACT window aggregates — so these specs are checked against a
+# brute-force per-window oracle instead of the reference simulator.
+
+
+def run_exact(windows, agg_factories, stream, watermarks, lateness=1000):
+    eng = TpuWindowOperator(config=SMALL)
+    for w in windows:
+        eng.add_window_assigner(w)
+    for mk in agg_factories:
+        eng.add_aggregation(mk())
+    eng.set_max_lateness(lateness)
+    kinds = [type(mk()).__name__ for mk in agg_factories]
+
+    pos = 0
+    n_checked = 0
+    for after_idx, wm in watermarks:
+        while pos <= after_idx and pos < len(stream):
+            v, ts = stream[pos]
+            eng.process_element(v, ts)
+            pos += 1
+        seen_v = np.asarray([v for v, _ in stream[:pos]], dtype=np.float64)
+        seen_t = np.asarray([t for _, t in stream[:pos]], dtype=np.int64)
+        for w in eng.process_watermark(wm):
+            m = (seen_t >= w.get_start()) & (seen_t < w.get_end())
+            assert w.has_value() == bool(m.any()), (wm, w)
+            if not w.has_value():
+                continue
+            n_checked += 1
+            sel = seen_v[m]
+            for kind, got in zip(kinds, w.get_agg_values()):
+                exp = {"SumAggregation": sel.sum, "MinAggregation": sel.min,
+                       "MaxAggregation": sel.max,
+                       "CountAggregation": lambda: len(sel),
+                       "MeanAggregation": sel.mean}[kind]()
+                assert float(got) == pytest.approx(float(exp), rel=1e-5), (
+                    wm, w, kind, exp)
+    assert n_checked > 0
+
+
+def test_sliding_size_not_multiple_of_slide_exact():
+    stream = [(i % 9 + 1, i * 3 + (i % 2)) for i in range(60)]
+    run_exact([SlidingWindow(Time, 25, 10)],
+              [SumAggregation, MinAggregation, CountAggregation],
+              stream, [(19, 66), (39, 131), (59, 200)])
+
+
+def test_sliding_nondivisible_out_of_order():
+    rng = np.random.default_rng(11)
+    base = np.cumsum(rng.integers(0, 5, size=150))
+    ts = np.maximum(base - rng.integers(0, 25, size=150), 0)
+    vals = rng.integers(1, 50, size=150)
+    stream = [(int(v), int(t)) for v, t in zip(vals, ts)]
+    wms = []
+    for p in (49, 99, 149):
+        w = int(np.max(ts[:p + 1])) + 1
+        if not wms or w > wms[-1][1]:
+            wms.append((p, w))
+    run_exact([SlidingWindow(Time, 25, 10)],
+              [SumAggregation, MaxAggregation],
+              stream, wms, lateness=10_000)
+
+
+def test_mixed_nondivisible_grids_exact():
+    stream = [(i % 5 + 1, i * 2 + (i % 3)) for i in range(80)]
+    run_exact([SlidingWindow(Time, 25, 10), TumblingWindow(Time, 7),
+               SlidingWindow(Time, 9, 4)],
+              [SumAggregation, MeanAggregation],
+              stream, [(39, 85), (79, 170)])
+
+
+# ---------------------------------------------------------------------------
 # count-measure device path
 # ---------------------------------------------------------------------------
 
