@@ -28,7 +28,11 @@ from .. import jax_config  # noqa: F401
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import SlidingWindow, TumblingWindow, WindowMeasure
-from ..engine.pipeline import _gcd_all, build_trigger_grid, lower_interval
+from ..engine.pipeline import (
+    AlignedStreamPipeline,
+    build_trigger_grid,
+    lower_interval,
+)
 
 
 class BucketWindowPipeline:
@@ -47,7 +51,6 @@ class BucketWindowPipeline:
         self.wm_period_ms = wm_period_ms
         self.seed = seed
 
-        grid_members = []
         max_span = 0
         for w in self.windows:
             if w.measure != WindowMeasure.Time or not isinstance(
@@ -55,9 +58,6 @@ class BucketWindowPipeline:
                 raise NotImplementedError(
                     "bucket baseline: Time tumbling/sliding only")
             max_span = max(max_span, w.clear_delay())
-            grid_members.append(int(w.size))
-            if isinstance(w, SlidingWindow):
-                grid_members.append(int(w.slide))
         self.aspecs = []
         for a in self.aggregations:
             spec = a.device_spec()
@@ -66,9 +66,10 @@ class BucketWindowPipeline:
                     "bucket baseline: dense aggregations only")
             self.aspecs.append(spec)
 
-        g = _gcd_all(grid_members)
-        if wm_period_ms % g:
-            raise ValueError("wm_period_ms not a multiple of the grid")
+        # same grid rule as the slicing pipeline (wm period folded into the
+        # gcd, so wm_period_ms % g == 0 by construction — arbitrary window
+        # sizes like randomTumbling's are handled, not rejected)
+        g = AlignedStreamPipeline.slice_grid(self.windows, wm_period_ms)
         if throughput * g % 1000:
             raise ValueError("throughput not an integer per-slice rate")
         R = throughput * g // 1000
@@ -97,7 +98,10 @@ class BucketWindowPipeline:
         make_triggers, self.T = build_trigger_grid(self.windows, wm_period_ms)
         P = wm_period_ms
 
-        def step(ring_ts, ring_vals, key, interval_idx):
+        def gen_and_write(ring_ts, ring_vals, key, interval_idx):
+            """Generate one interval's tuples (byte-identical RNG stream to
+            AlignedStreamPipeline) and write them into the ring — the shared
+            body of step() and fill()."""
             base = interval_idx * P
 
             def gbody(_, c):
@@ -118,7 +122,12 @@ class BucketWindowPipeline:
                 ring_ts, ts, (slot.astype(jnp.int32),))
             ring_vals = jax.lax.dynamic_update_slice(
                 ring_vals, vals, (slot.astype(jnp.int32),))
+            return ring_ts, ring_vals
 
+        def step(ring_ts, ring_vals, key, interval_idx):
+            base = interval_idx * P
+            ring_ts, ring_vals = gen_and_write(ring_ts, ring_vals, key,
+                                               interval_idx)
             ws, we, tmask = make_triggers(base, base + P)
             Tn = ws.shape[0]
 
@@ -155,32 +164,10 @@ class BucketWindowPipeline:
                          for sp, a in zip(self.aspecs, accs))
             return ring_ts, ring_vals, (ws, we, cnt, accs)
 
-        def fill(ring_ts, ring_vals, key, interval_idx):
-            """Ring write only — pre-roll the window span without paying the
-            O(#triggers × ring) query of a full step."""
-            base = interval_idx * P
-
-            def gbody(_, c):
-                kg = jax.random.fold_in(key, c)
-                u = jax.random.uniform(kg, (2, d, R), dtype=jnp.float32)
-                return None, (u[0] * value_scale, u[1])
-
-            _, (vals2d, offs2d) = jax.lax.scan(gbody, None,
-                                               jnp.arange(n_chunks))
-            vals = vals2d.reshape(-1)
-            row_starts = base + g * jnp.arange(S, dtype=jnp.int64)
-            off = jnp.clip(jnp.floor(offs2d.reshape(S, R) * jnp.float32(g)),
-                           0, g - 1)
-            ts = (row_starts[:, None] + off.astype(jnp.int64)).reshape(-1)
-            slot = (interval_idx % intervals_needed) * n_new
-            ring_ts = jax.lax.dynamic_update_slice(
-                ring_ts, ts, (slot.astype(jnp.int32),))
-            ring_vals = jax.lax.dynamic_update_slice(
-                ring_vals, vals, (slot.astype(jnp.int32),))
-            return ring_ts, ring_vals
-
         self._step = jax.jit(step, donate_argnums=(0, 1))
-        self._fill = jax.jit(fill, donate_argnums=(0, 1))
+        # fill: ring write only — pre-roll the window span without paying
+        # the O(#triggers × ring) query of a full step
+        self._fill = jax.jit(gen_and_write, donate_argnums=(0, 1))
         self._Npad = Npad
         self._root = None
         self._ring = None

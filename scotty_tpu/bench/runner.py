@@ -110,15 +110,12 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         if cfg.out_of_order_pct == 0:
             try:
                 from ..engine import EngineConfig
-                from ..engine.pipeline import AlignedStreamPipeline, _gcd_all
-                from ..core.windows import SlidingWindow, TumblingWindow
+                from ..engine.pipeline import AlignedStreamPipeline
 
-                members = []
-                for w in windows:
-                    members.append(int(w.size))
-                    if isinstance(w, SlidingWindow):
-                        members.append(int(w.slide))
-                tp = _round_throughput(cfg.throughput, _gcd_all(members))
+                tp = _round_throughput(
+                    cfg.throughput,
+                    AlignedStreamPipeline.slice_grid(
+                        windows, cfg.watermark_period_ms))
                 p = AlignedStreamPipeline(
                     windows, [make_aggregation(agg_name)],
                     config=EngineConfig(capacity=cfg.capacity,
@@ -137,19 +134,13 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
 
     if engine == "Buckets":
         from .buckets import BucketWindowPipeline
+        from ..engine.pipeline import AlignedStreamPipeline
 
         tp = getattr(cfg, "buckets_throughput", None) or max(
             1000, cfg.throughput // 200)
-        members = []
-        from ..core.windows import SlidingWindow
-
-        for w in windows:
-            members.append(int(w.size))
-            if isinstance(w, SlidingWindow):
-                members.append(int(w.slide))
-        from ..engine.pipeline import _gcd_all
-
-        tp = _round_throughput(tp, _gcd_all(members))
+        tp = _round_throughput(
+            tp, AlignedStreamPipeline.slice_grid(windows,
+                                                 cfg.watermark_period_ms))
         p = BucketWindowPipeline(
             windows, [make_aggregation(agg_name)], throughput=tp,
             wm_period_ms=cfg.watermark_period_ms, seed=cfg.seed)
@@ -169,7 +160,17 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
         for engine in cfg.configurations:
             for agg_name in cfg.agg_functions:
                 t0 = time.perf_counter()
-                res = run_cell(cfg, window_spec, agg_name, engine)
+                try:
+                    res = run_cell(cfg, window_spec, agg_name, engine)
+                except Exception as e:        # one bad cell must not void
+                    rows.append({              # the already-computed ones
+                        "name": cfg.name, "windows": window_spec,
+                        "aggregation": agg_name, "engine": engine,
+                        "error": f"{type(e).__name__}: {e}",
+                        "cell_wall_s": round(time.perf_counter() - t0, 2)})
+                    echo(f"  {window_spec:28s} {engine:10s} {agg_name:8s} "
+                         f"ERROR {type(e).__name__}: {e}")
+                    continue
                 cell = dict(res.to_dict(), engine=engine,
                             cell_wall_s=round(time.perf_counter() - t0, 2))
                 rows.append(cell)

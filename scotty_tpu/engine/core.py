@@ -270,12 +270,21 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
         # The reference's in-order predicate: te >= maxEventTime
         # (StreamSlicer.java:139-141). The host driver ts-sorts each batch,
         # so late tuples form a prefix relative to the stream's max event
-        # time at batch entry. A late tuple whose slice start still equals
-        # the open slice's start folds through the in-order path unchanged.
+        # time at batch entry. A late tuple with ts >= open_start folds into
+        # the OPEN slice (the reference's covering-slice insert,
+        # SliceManager.java:64-76) — comparing on ts, not grid_start(ts),
+        # matters after a dynamic window addition where the open slice is
+        # coarser than the current union grid (grid_start(ts) can exceed
+        # open_start while ts sits inside the open slice's span; opening a
+        # new slice there would interleave slice spans and break the
+        # t_last sort order the query's containment bound relies on).
         if assume_inorder:
             late = jnp.zeros_like(valid)
+            pin = jnp.zeros_like(valid)
         else:
-            late = valid & (ts < state.max_event_time) & (s < open_start)
+            behind = valid & (ts < state.max_event_time)
+            late = behind & (ts < open_start)
+            pin = behind & ~late
 
         # ---- count-measure edges (StreamSlicer.java:37-44,88-101) --------
         # Arrival index of each tuple (count before insertion); a count edge
@@ -348,7 +357,10 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
                 + jnp.sum(valid.astype(jnp.int64)),
                 overflow=overflow,
             )
-        io_s = jnp.where(late, open_start, s)      # late lanes pinned to open
+        # late AND pinned lanes anchored to the open slice: late lanes so
+        # they never trigger a spurious edge (they're io_valid-masked),
+        # pinned lanes because they genuinely insert there
+        io_s = jnp.where(late | pin, open_start, s)
         io_s = jnp.where(count_flag & ~late, jnp.maximum(io_s, prev_ts), io_s)
         prev = jnp.concatenate([open_start[None], io_s[:-1]])
         newflag = ((io_s > prev) | (count_flag & ~late)) & valid
@@ -359,8 +371,11 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
         io_valid = valid & ~late
         one = jnp.where(io_valid, jnp.int64(1), jnp.int64(0))
         starts = state.starts.at[pos].min(jnp.where(valid, io_s, I64_MAX))
+        # pinned lanes don't define a new slice: keep the open slice's
+        # closing edge as recorded at creation (post-dynamic-addition it is
+        # coarser than next_edge under the current union grid)
         ends = state.ends.at[pos].min(
-            jnp.where(valid, next_edge(spec, io_s), I64_MAX))
+            jnp.where(valid & ~pin & ~late, next_edge(spec, io_s), I64_MAX))
         counts = state.counts.at[pos].add(one)
         t_last = state.t_last.at[pos].max(jnp.where(io_valid, ts, I64_MIN))
         t_first = state.t_first.at[pos].min(jnp.where(io_valid, ts, I64_MAX))
@@ -394,12 +409,19 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
             )
 
         # ---- late path ---------------------------------------------------
-        # Covering main-buffer slice: the one whose start == grid_start(ts).
-        # If absent (its grid range was empty), the tuple goes to the annex.
+        # Covering main-buffer slice: the last slice with start <= ts whose
+        # recorded closing edge still reaches past ts (ts < ends[lo]) — the
+        # engine equivalent of findSliceIndexByTimestamp
+        # (LazyAggregateStore.java:29-37). Under a static spec this equals
+        # "a slice with start == grid_start(ts) exists"; after a dynamic
+        # window addition it also covers pre-addition coarse slices, which
+        # the reference likewise keeps folding late tuples into. If no
+        # covering slice exists (the grid range was never materialized),
+        # the tuple goes to the annex under the current union grid.
         new_state_partials = partials
-        lo = jnp.searchsorted(starts, s, side="right") - 1
-        lo = jnp.clip(lo, 0, C - 1)
-        covered = late & (starts[lo] == s)
+        lo_raw = jnp.searchsorted(starts, ts, side="right") - 1
+        lo = jnp.clip(lo_raw, 0, C - 1)
+        covered = late & (lo_raw >= 0) & (starts[lo] <= ts) & (ts < ends[lo])
         cov_pos = jnp.where(covered, lo, C - 1)          # C-1 lane is masked
         cov_one = jnp.where(covered, jnp.int64(1), jnp.int64(0))
         counts = counts.at[cov_pos].add(cov_one)
@@ -476,7 +498,19 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int):
     def query(state: SliceBufferState, ws: jnp.ndarray, we: jnp.ndarray,
               tmask: jnp.ndarray, is_count: jnp.ndarray):
         lo_t = jnp.searchsorted(state.starts, ws, side="left")
-        hi_t = jnp.searchsorted(state.starts, we, side="left")
+        # Upper containment bound per the reference: a slice is covered iff
+        # window.end > slice.tLast (AggregateWindowState.java:25-31).
+        # When every window edge is a slice-grid point this equals
+        # ``starts < we`` (records never cross next_edge), but after a
+        # DYNAMIC window addition pre-addition slices are coarser than the
+        # new union grid and may straddle new window boundaries — t_last
+        # containment then excludes them exactly like the reference does.
+        # t_last is nondecreasing over live rows (t_last[i] < starts[i+1]
+        # <= t_last[i+1]); pad rows are masked to LONG_MAX to keep the
+        # array sorted for searchsorted.
+        live_t_last = jnp.where(jnp.arange(C) < state.n_slices,
+                                state.t_last, I64_MAX)
+        hi_t = jnp.searchsorted(live_t_last, we, side="left")
         # Count containment (AggregateWindowState.java:25-31 Count branch):
         # window [ws, we] covers slices with c_start >= ws and
         # c_last = c_start + counts <= we; both arrays are nondecreasing
@@ -487,6 +521,9 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int):
         hi_c = jnp.searchsorted(cs_end, we, side="right")
         lo = jnp.where(is_count, jnp.minimum(lo_c, hi_c), lo_t)
         hi = jnp.where(is_count, hi_c, hi_t)
+        # a coarse pre-addition slice spanning the whole window gives
+        # hi < lo (start < ws and t_last >= we): the window covers nothing
+        hi = jnp.maximum(hi, lo)
         length = hi - lo
 
         cnt_prefix = jnp.concatenate(
@@ -569,7 +606,11 @@ def build_annex_merge(spec: EngineSpec, capacity: int, annex_capacity: int):
             cat_ends[order])
         cat_tf = jnp.concatenate([st.t_first, st.ax_starts])
         uniq_tf = jnp.full((C,), I64_MAX, jnp.int64).at[seg].min(cat_tf[order])
-        cat_tl = jnp.concatenate([st.t_last, st.ax_starts])
+        # pad annex rows hold I64_MAX starts; mask them to I64_MIN or the
+        # max-scatter below would poison the last real slice's t_last
+        cat_tl = jnp.concatenate(
+            [st.t_last, jnp.where(st.ax_starts < I64_MAX, st.ax_starts,
+                                  I64_MIN)])
         uniq_tl = jnp.full((C,), I64_MIN, jnp.int64).at[seg].max(cat_tl[order])
         cat_cnt = jnp.concatenate([st.counts, st.ax_counts])
         uniq_cnt = jnp.zeros((C,), jnp.int64).at[seg].add(cat_cnt[order])

@@ -112,8 +112,8 @@ class TpuWindowOperator(WindowOperator):
     # -- registry ----------------------------------------------------------
     def add_window_assigner(self, window: Window) -> None:
         if self._built:
-            raise RuntimeError("add windows before first element "
-                               "(device shapes are static)")
+            self._add_window_dynamic(window)
+            return
         if isinstance(window, SessionWindow):
             # pure-session device path (the eager session case,
             # SliceFactory.java:17-22): one session window, nothing else.
@@ -146,6 +146,49 @@ class TpuWindowOperator(WindowOperator):
         self.max_fixed_window_size = max(self.max_fixed_window_size,
                                          window.clear_delay())
 
+    def _add_window_dynamic(self, window: Window) -> None:
+        """Register a window mid-stream (TumblingWindowOperatorTest.java:96-145,
+        SlidingWindowOperatorTest dynamic cases).
+
+        The slice-buffer arrays are spec-independent, so the existing state
+        carries over untouched; only the kernels (which close over the union
+        grid) are rebuilt. Pre-addition slices stay on the coarser old grid —
+        the query's t_last containment (AggregateWindowState.java:25-31)
+        handles windows of the new assigner that straddle them, exactly like
+        the reference. Pending host-buffered tuples are flushed through the
+        OLD kernels first: the new grid applies from this call on.
+
+        Deliberate deviation: the union grid takes effect IMMEDIATELY at
+        this call. The reference caches its next slice edge
+        (StreamSlicer.java min_next_edge_ts) and keeps filling the current
+        coarse slice until that stale pre-addition edge is crossed — tuples
+        arriving in [addition_ts, stale_edge) silently vanish from every
+        window of the new assigner that ends before the stale edge. Here
+        they are sliced on the new grid at once, so new-assigner windows
+        see them; results are identical from the first old-grid edge after
+        the addition onward.
+        """
+        if self._is_session or isinstance(window, SessionWindow):
+            raise UnsupportedOnDevice(
+                "dynamic addition with session windows needs the host "
+                "operator")
+        if not isinstance(window, (TumblingWindow, SlidingWindow,
+                                   FixedBandWindow)):
+            raise UnsupportedOnDevice(
+                f"{type(window).__name__} has no device path")
+        if window.measure == WindowMeasure.Count:
+            raise UnsupportedOnDevice(
+                "dynamic count-measure window addition needs the host "
+                "operator (count slicing would need a record replay)")
+        self._flush()                      # old grid for already-fed tuples
+        self.windows.append(window)
+        self.max_fixed_window_size = max(self.max_fixed_window_size,
+                                         window.clear_delay())
+        self._spec = self._compute_spec()
+        C, A = self.config.capacity, self.config.annex_capacity
+        (self._ingest, self._query, self._gc, self._count_at,
+         self._merge) = _kernels(self._spec, C, A)
+
     def add_aggregation(self, window_function: AggregateFunction) -> None:
         if self._built:
             raise RuntimeError("add aggregations before first element")
@@ -159,14 +202,9 @@ class TpuWindowOperator(WindowOperator):
         self.max_lateness = max_lateness
 
     # -- build -------------------------------------------------------------
-    def _build(self) -> None:
-        import jax
+    def _compute_spec(self):
         from . import core as ec
 
-        if not self.windows:
-            raise RuntimeError("no windows registered")
-        if not self.aggregations:
-            raise RuntimeError("no aggregations registered")
         periods = []
         bands = []
         count_periods = []
@@ -190,7 +228,7 @@ class TpuWindowOperator(WindowOperator):
                                            int(w.size % w.slide)))
             elif isinstance(w, FixedBandWindow):
                 bands.append((int(w.start), int(w.size)))
-        self._spec = ec.EngineSpec(
+        return ec.EngineSpec(
             periods=tuple(sorted(set(periods))),
             bands=tuple(sorted(set(bands))),
             count_periods=tuple(sorted(set(count_periods))),
@@ -198,6 +236,16 @@ class TpuWindowOperator(WindowOperator):
             session_gaps=tuple(session_gaps),
             offset_periods=tuple(sorted(set(offset_periods))),
         )
+
+    def _build(self) -> None:
+        import jax
+        from . import core as ec
+
+        if not self.windows:
+            raise RuntimeError("no windows registered")
+        if not self.aggregations:
+            raise RuntimeError("no aggregations registered")
+        self._spec = self._compute_spec()
         C, A = self.config.capacity, self.config.annex_capacity
         self._state = ec.init_state(self._spec, C, A)
         self._is_session = self._spec.pure_session
@@ -208,10 +256,14 @@ class TpuWindowOperator(WindowOperator):
         else:
             (self._ingest, self._query, self._gc, self._count_at,
              self._merge) = _kernels(self._spec, C, A)
-        self._has_count = bool(count_periods)
+        self._has_count = bool(self._spec.count_periods)
         self._last_count = 0
         self._host_met = None           # host mirror of max event time
         self._host_min_ts = None        # host mirror of min event time
+        self._host_oldest = None        # host mirror of oldest slice start
+                                        # (evaluated with the spec current at
+                                        # ingest time — dynamic additions
+                                        # must not re-grid old slices)
         self._host_count = 0            # host mirror of current_count
         self._annex_dirty = False       # a late tuple may sit in the annex
         self._valid_dev = None          # cached all-true lane mask
@@ -274,6 +326,9 @@ class TpuWindowOperator(WindowOperator):
             mn = int(batch_t[0])
             self._host_min_ts = mn if self._host_min_ts is None \
                 else min(self._host_min_ts, mn)
+            og = self._host_grid_start(mn)
+            self._host_oldest = og if self._host_oldest is None \
+                else min(self._host_oldest, og)
             self._host_count += take
         valid = np.ones((B,), dtype=bool)
         if take < B:
@@ -310,6 +365,9 @@ class TpuWindowOperator(WindowOperator):
             else max(self._host_met, ts_max)
         self._host_min_ts = ts_min if self._host_min_ts is None \
             else min(self._host_min_ts, ts_min)
+        og = self._host_grid_start(ts_min)
+        self._host_oldest = og if self._host_oldest is None \
+            else min(self._host_oldest, og)
         self._host_count += n
         self._state = self._ingest(self._state, ts, vals, self._valid_dev)
 
@@ -376,9 +434,8 @@ class TpuWindowOperator(WindowOperator):
             return no_result
 
         if first_watermark:
-            oldest = self._host_grid_start(self._host_min_ts)
-            if last_wm < oldest:
-                last_wm = oldest
+            if last_wm < self._host_oldest:
+                last_wm = self._host_oldest
 
         if self._annex_dirty:
             self._state = self._merge(self._state)

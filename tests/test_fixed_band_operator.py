@@ -5,20 +5,22 @@ import pytest
 
 from scotty_tpu import (
     FixedBandWindow,
-    ReduceAggregateFunction,
-    SlicingWindowOperator,
+    SumAggregation,
     WindowMeasure,
 )
+from conftest import make_operator
 from window_assert import assert_window
 
 
-@pytest.fixture
-def op():
-    return SlicingWindowOperator()
+@pytest.fixture(params=["host", "engine"])
+def op(request):
+    return make_operator(request.param)
 
 
 def sum_fn():
-    return ReduceAggregateFunction(lambda a, b: a + b)
+    # same host semantics as ReduceAggregateFunction(a+b), plus a device
+    # realization — the goldens drive both operators (conftest.make_operator)
+    return SumAggregation()
 
 
 def test_in_order(op):

@@ -4,21 +4,24 @@ slicing/src/test/.../windowTest/SlidingWindowOperatorTest.java."""
 import pytest
 
 from scotty_tpu import (
-    ReduceAggregateFunction,
-    SlicingWindowOperator,
     SlidingWindow,
+    SumAggregation,
     TumblingWindow,
     WindowMeasure,
 )
 
+from conftest import make_operator
 
-@pytest.fixture
-def op():
-    return SlicingWindowOperator()
+
+@pytest.fixture(params=["host", "engine"])
+def op(request):
+    return make_operator(request.param)
 
 
 def sum_fn():
-    return ReduceAggregateFunction(lambda a, b: a + b)
+    # same host semantics as ReduceAggregateFunction(a+b), plus a device
+    # realization — the goldens drive both operators (conftest.make_operator)
+    return SumAggregation()
 
 
 def test_in_order(op):

@@ -7,19 +7,24 @@ import pytest
 
 from scotty_tpu import (
     ReduceAggregateFunction,
-    SlicingWindowOperator,
+    SumAggregation,
     TumblingWindow,
     WindowMeasure,
 )
 
+from conftest import make_operator
 
-@pytest.fixture
-def op():
-    return SlicingWindowOperator()
+
+@pytest.fixture(params=["host", "engine"])
+def op(request):
+    return make_operator(request.param)
 
 
 def sum_fn():
-    return ReduceAggregateFunction(lambda a, b: a + b)
+    # SumAggregation: identical host semantics to the reference's
+    # ReduceAggregateFunction(a+b) (lift/lower identity, combine +) AND a
+    # device realization — so the same goldens drive both operators.
+    return SumAggregation()
 
 
 def test_in_order(op):
