@@ -1,0 +1,212 @@
+"""Per-phase microbenchmarks — the JMH analogue.
+
+The reference pins per-element operator cost with JMH
+(benchmark/.../microbenchmark/SlicingWindowOperatorBenchmark.java:37-52,
+AggregationStoreBenchmark.java); here the phases worth isolating are device
+kernels and the host glue around them, so perf work on the full pipeline
+stops being blind (VERDICT r1 item 9):
+
+* ``ingest_scatter``    — general batched ingest kernel (scatter-combine)
+* ``ingest_aligned``    — slice-aligned generate+reduce+append step
+  (AlignedStreamPipeline's fused interval, amortized per tuple)
+* ``query``             — range-query kernel at benchmark trigger counts
+* ``annex_merge``       — out-of-order annex fold (device sort path)
+* ``gc``                — slice-buffer roll
+* ``host_pack``         — keyed host packing (lexsort + [K, B] scatter),
+  no device work
+
+Run: ``python -m scotty_tpu.bench.micro [--out bench_results/micro.json]``.
+Each phase reports mean/min ms per dispatch and derived tuples/s where
+meaningful. Shapes default to the headline-benchmark scale; ``--small``
+switches to CPU-test shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def _time_phase(fn: Callable[[], None], sync: Callable[[], None],
+                iters: int, warmup: int = 2) -> dict:
+    for _ in range(warmup):
+        fn()
+    sync()
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        sync()
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return {
+        "mean_ms": float(np.mean(samples)),
+        "min_ms": float(np.min(samples)),
+        "p95_ms": float(np.percentile(samples, 95)),
+        "iters": iters,
+    }
+
+
+def run_micro(small: bool = False, iters: int = 5, seed: int = 0) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.aggregates import SumAggregation
+    from ..core.windows import SlidingWindow, WindowMeasure
+    from ..engine import core as ec
+    from ..engine.config import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+
+    if small:                      # CPU-test shapes
+        C, A, B, Tq = 1 << 10, 64, 1 << 10, 128
+        throughput, wm_period = 200_000, 1000
+        window = SlidingWindow(WindowMeasure.Time, 60_000, 1000)
+    else:                          # headline-benchmark shapes
+        C, A, B, Tq = 1 << 17, 1 << 12, 1 << 18, 1 << 16
+        throughput, wm_period = 200_000_000, 1000
+        window = SlidingWindow(WindowMeasure.Time, 60_000, 1)
+
+    spec = ec.EngineSpec(periods=(1,) if not small else (1000,), bands=(),
+                         count_periods=(),
+                         aggs=(SumAggregation().device_spec(),))
+    rng = np.random.default_rng(seed)
+    results: dict = {"shapes": {"capacity": C, "annex": A, "batch": B,
+                                "triggers": Tq, "small": small}}
+
+    # ---- ingest (general scatter path) -----------------------------------
+    ingest = jax.jit(ec.build_ingest(spec, C, A), donate_argnums=0)
+    grid = spec.periods[0]
+    ts0 = np.sort(rng.integers(0, B * 2, size=B)).astype(np.int64)
+    vals = rng.random(B).astype(np.float32)
+    valid = np.ones((B,), bool)
+    holder = {"st": ec.init_state(spec, C, A), "i": 0}
+
+    def do_ingest():
+        # fresh ts range each call so the buffer doesn't overflow the cap
+        off = holder["i"] * 2 * B
+        holder["i"] += 1
+        holder["st"] = ingest(holder["st"], ts0 + off, vals, valid)
+
+    def sync():
+        jax.block_until_ready(holder["st"].n_slices)
+
+    r = _time_phase(do_ingest, sync, iters)
+    r["tuples_per_s"] = B / (r["min_ms"] / 1e3)
+    results["ingest_scatter"] = r
+
+    # ---- gc (amortizes the buffer back down) ------------------------------
+    gc = jax.jit(ec.build_gc(spec, C, A), donate_argnums=0)
+
+    def do_gc():
+        holder["st"] = gc(holder["st"], np.int64(holder["i"] * 2 * B))
+
+    results["gc"] = _time_phase(do_gc, sync, iters)
+
+    # ---- query ------------------------------------------------------------
+    query = jax.jit(ec.build_query(spec, C, A))
+    # refill a few batches so the buffer has content
+    for _ in range(3):
+        do_ingest()
+    ws = (np.arange(Tq, dtype=np.int64) % (B // 2)) * grid
+    we = ws + grid * 16
+    mask = np.ones((Tq,), bool)
+    ic = np.zeros((Tq,), bool)
+    out_holder = {}
+
+    def do_query():
+        out_holder["out"] = query(holder["st"], ws, we, mask, ic)
+
+    def sync_q():
+        jax.block_until_ready(out_holder["out"])
+
+    r = _time_phase(do_query, sync_q, iters)
+    r["windows_per_s"] = Tq / (r["min_ms"] / 1e3)
+    results["query"] = r
+
+    # ---- annex merge ------------------------------------------------------
+    merge = jax.jit(ec.build_annex_merge(spec, C, A), donate_argnums=0)
+
+    def do_merge():
+        holder["st"] = merge(holder["st"])
+
+    results["annex_merge"] = _time_phase(do_merge, sync, iters)
+
+    # ---- aligned fused interval ------------------------------------------
+    p = AlignedStreamPipeline(
+        [window], [SumAggregation()],
+        config=EngineConfig(capacity=C, annex_capacity=8, min_trigger_pad=32),
+        throughput=throughput, wm_period_ms=wm_period, gc_every=8, seed=seed)
+    p.reset()
+    p.run(2, collect=False)        # compile + warm
+    p.sync()
+
+    def do_aligned():
+        p.run(1, collect=False)
+
+    r = _time_phase(do_aligned, lambda: p.sync(), iters)
+    r["tuples_per_s"] = p.tuples_per_interval / (r["min_ms"] / 1e3)
+    results["ingest_aligned"] = r
+    p.check_overflow()
+
+    # ---- host packing (no device work) ------------------------------------
+    K = 64
+    Np = B
+    keys = rng.integers(0, K, size=Np).astype(np.int32)
+    kts = np.sort(rng.integers(0, 1 << 20, size=Np)).astype(np.int64)
+    kvals = rng.random(Np).astype(np.float32)
+
+    def do_pack():
+        order = np.lexsort((kts, keys))
+        k2, v2, t2 = keys[order], kvals[order], kts[order]
+        counts = np.bincount(k2, minlength=K)
+        starts = np.zeros(K, np.int64)
+        starts[1:] = np.cumsum(counts)[:-1]
+        pos = np.arange(t2.size, dtype=np.int64) - starts[k2]
+        Bk = 1 << 10
+        rnd, lane = pos // Bk, pos % Bk
+        m = rnd == 0
+        ts_b = np.zeros((K, Bk), np.int64)
+        ts_b[k2[m], lane[m]] = t2[m]
+        return ts_b
+
+    r = _time_phase(do_pack, lambda: None, iters)
+    r["tuples_per_s"] = Np / (r["min_ms"] / 1e3)
+    results["host_pack"] = r
+
+    results["platform"] = jax.devices()[0].platform
+    return results
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(prog="python -m scotty_tpu.bench.micro")
+    ap.add_argument("--out", default="bench_results/micro.json")
+    ap.add_argument("--small", action="store_true",
+                    help="CPU-test shapes instead of benchmark shapes")
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    res = run_micro(small=args.small, iters=args.iters)
+    for phase, r in res.items():
+        if not isinstance(r, dict) or "mean_ms" not in r:
+            continue
+        extra = ""
+        if "tuples_per_s" in r:
+            extra = f"  {r['tuples_per_s']:16,.0f} tuples/s"
+        elif "windows_per_s" in r:
+            extra = f"  {r['windows_per_s']:16,.0f} windows/s"
+        print(f"{phase:16s} mean={r['mean_ms']:9.3f} ms  "
+              f"min={r['min_ms']:9.3f} ms{extra}")
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(res, f, indent=1)
+    print(f"-> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
