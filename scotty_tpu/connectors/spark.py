@@ -1,10 +1,26 @@
 """Spark connector (import-gated).
 
-Mirrors the reference spark-connector: a flatMap function over a structured
-stream keeping a keyed operator with a 100 ms event-time tick
-(spark-connector/.../KeyedScottyWindowOperator.java:17-85, tick :24,59-72).
-Requires ``pyspark`` at runtime; ``scotty_flat_map`` itself is a plain
-callable usable with ``DataFrame.mapInPandas`` / RDD ``mapPartitions``.
+Mirrors the reference spark-connector — a ``FlatMapFunction`` over a
+structured stream keeping a keyed operator with a 100 ms event-time tick
+(spark-connector/.../KeyedScottyWindowOperator.java:17-85, tick :24,59-72) —
+rebuilt for Spark's current API surface:
+
+* :func:`scotty_map_in_pandas` — a pandas-batch mapper for
+  ``DataFrame.mapInPandas``: per-partition keyed operator fed whole Arrow
+  batches (columns ``key``, ``value``, ``ts``), emitting window-result rows
+  (``key``, ``window_start``, ``window_end``, ``agg_0..agg_{n-1}``). This is
+  the structured-streaming path and works on micro-batch boundaries exactly
+  like the reference's flatMap-with-tick.
+* :func:`result_schema` — the matching ``pyspark.sql.types.StructType``
+  (needs pyspark).
+* :func:`attach` — one-call wiring: ``attach(df, windows, aggs)`` returns
+  the transformed DataFrame (needs pyspark).
+* :func:`scotty_flat_map` — plain-iterator variant for RDD
+  ``mapPartitions`` / DStream ``flatMap`` parity with the reference.
+
+Only :func:`result_schema` / :func:`attach` import pyspark; the mappers are
+plain callables so the connector logic is testable (and usable on any
+Arrow/pandas micro-batch source) without a Spark installation.
 """
 
 from __future__ import annotations
@@ -14,6 +30,14 @@ from typing import Iterable, Iterator, List, Optional, Tuple
 from .base import KeyedScottyWindowOperator, PeriodicWatermarks
 
 
+def _make_operator(windows, aggregations, allowed_lateness,
+                   watermark_period_ms):
+    return KeyedScottyWindowOperator(
+        windows=windows or [], aggregations=aggregations or [],
+        allowed_lateness=allowed_lateness,
+        watermark_policy=PeriodicWatermarks(watermark_period_ms))
+
+
 def scotty_flat_map(windows: Optional[List] = None,
                     aggregations: Optional[List] = None,
                     allowed_lateness: int = 1,
@@ -21,13 +45,91 @@ def scotty_flat_map(windows: Optional[List] = None,
     """Returns a partition-mapper: Iterable[(key, value, ts)] →
     Iterator[(key, start, end, values)] — apply with
     ``rdd.mapPartitions(scotty_flat_map(...))`` or feed micro-batches
-    directly."""
+    directly (the reference's FlatMapFunction shape,
+    spark-connector/.../KeyedScottyWindowOperator.java:38-57)."""
     def mapper(partition: Iterable[Tuple]) -> Iterator[Tuple]:
-        op = KeyedScottyWindowOperator(
-            windows=windows or [], aggregations=aggregations or [],
-            allowed_lateness=allowed_lateness,
-            watermark_policy=PeriodicWatermarks(watermark_period_ms))
+        op = _make_operator(windows, aggregations, allowed_lateness,
+                            watermark_period_ms)
         for key, value, ts in partition:
             for k, w in op.process_element(key, value, int(ts)):
-                yield (k, w.get_start(), w.get_end(), tuple(w.get_agg_values()))
+                yield (k, w.get_start(), w.get_end(),
+                       tuple(w.get_agg_values()))
     return mapper
+
+
+def scotty_map_in_pandas(windows: Optional[List] = None,
+                         aggregations: Optional[List] = None,
+                         allowed_lateness: int = 1,
+                         watermark_period_ms: int = 100,
+                         key_col: str = "key", value_col: str = "value",
+                         ts_col: str = "ts"):
+    """Pandas-batch mapper for ``DataFrame.mapInPandas``.
+
+    Input batches need columns (``key``, ``value``, ``ts``); output rows are
+    (``key``, ``window_start``, ``window_end``, ``agg_0``…``agg_{n-1}``),
+    one per non-empty emitted window — schema from :func:`result_schema`.
+    The operator lives for the partition (one per task), so watermarks tick
+    across batches of the same partition, matching the reference's
+    per-instance operator + event-time tick."""
+    n_aggs = len(aggregations or [])
+
+    def mapper(batches: Iterator) -> Iterator:
+        import pandas as pd
+
+        op = _make_operator(windows, aggregations, allowed_lateness,
+                            watermark_period_ms)
+
+        def to_frame(results) -> Optional[pd.DataFrame]:
+            if not results:
+                return None
+            rows = []
+            for k, w in results:
+                vals = w.get_agg_values()
+                rows.append((k, w.get_start(), w.get_end(),
+                             *[float(vals[i]) for i in range(n_aggs)]))
+            cols = ([key_col, "window_start", "window_end"]
+                    + [f"agg_{i}" for i in range(n_aggs)])
+            return pd.DataFrame(rows, columns=cols)
+
+        for batch in batches:
+            out = []
+            for key, value, ts in zip(batch[key_col].to_numpy(),
+                                      batch[value_col].to_numpy(),
+                                      batch[ts_col].to_numpy()):
+                out.extend(op.process_element(key, value, int(ts)))
+            frame = to_frame(out)
+            if frame is not None:
+                yield frame
+
+    return mapper
+
+
+def result_schema(aggregations: List, key_type=None):
+    """``StructType`` matching :func:`scotty_map_in_pandas` output.
+    Requires pyspark."""
+    try:
+        from pyspark.sql import types as T
+    except ImportError as e:                 # pragma: no cover
+        raise ImportError(
+            "result_schema/attach need pyspark; use scotty_map_in_pandas "
+            "directly for non-Spark pandas micro-batch sources") from e
+    fields = [
+        T.StructField("key", key_type or T.StringType(), False),
+        T.StructField("window_start", T.LongType(), False),
+        T.StructField("window_end", T.LongType(), False),
+    ]
+    for i in range(len(aggregations)):
+        fields.append(T.StructField(f"agg_{i}", T.DoubleType(), True))
+    return T.StructType(fields)
+
+
+def attach(df, windows: List, aggregations: List,
+           allowed_lateness: int = 1, watermark_period_ms: int = 100,
+           key_type=None):
+    """Wire a Scotty keyed window operator onto a Spark DataFrame with
+    columns (key, value, ts): returns ``df.mapInPandas(...)`` with the
+    right schema. Requires pyspark."""
+    schema = result_schema(aggregations, key_type=key_type)
+    return df.mapInPandas(
+        scotty_map_in_pandas(windows, aggregations, allowed_lateness,
+                             watermark_period_ms), schema)

@@ -179,3 +179,41 @@ def test_beam_dofn_without_beam_installed():
                     ("k", (4, 30))]:
         out.extend(fn.process(element))
     assert any("0-10" in s or "0, 10" in s or "WindowResult" in s for s in out)
+
+
+def test_spark_map_in_pandas_matches_host_operator():
+    """The mapInPandas-shaped mapper (structured-streaming path) emits the
+    same windows as driving the host operator directly."""
+    import pandas as pd
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.connectors.spark import scotty_map_in_pandas
+
+    windows = [TumblingWindow(WindowMeasure.Time, 10)]
+    aggs = [SumAggregation()]
+    data = [("a", 1, 1), ("a", 2, 5), ("b", 7, 8), ("a", 3, 12),
+            ("a", 4, 25), ("b", 1, 26), ("a", 5, 40)]
+    df = pd.DataFrame(data, columns=["key", "value", "ts"])
+    # allowed_lateness must span the first window or the first watermark's
+    # clamp drops it (the reference connector's 1 ms default does exactly
+    # that — KeyedScottyWindowOperator.java:26)
+    mapper = scotty_map_in_pandas(windows, aggs, allowed_lateness=100,
+                                  watermark_period_ms=10)
+
+    out = pd.concat(list(mapper(iter([df]))), ignore_index=True)
+    # windows [0,10): a=3, b=7 fire once the stream passes ts>=20 etc.
+    got = {(r.key, r.window_start, r.window_end): r.agg_0
+           for r in out.itertuples()}
+    assert got[("a", 0, 10)] == 3.0
+    assert got[("b", 0, 10)] == 7.0
+    assert got[("a", 10, 20)] == 3.0
+
+
+def test_spark_attach_requires_pyspark():
+    import pytest as _pytest
+
+    from scotty_tpu import SumAggregation
+    from scotty_tpu.connectors.spark import result_schema
+
+    with _pytest.raises(ImportError, match="pyspark"):
+        result_schema([SumAggregation()])
