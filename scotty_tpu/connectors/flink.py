@@ -1,0 +1,166 @@
+"""Flink connector (import-gated).
+
+Mirrors the reference flink-connector — the flagship adapter: a
+``KeyedProcessFunction`` holding one window operator per key, processing
+watermarks from the Flink timer service with an element-ts fallback, plus a
+non-keyed ``ProcessFunction`` variant
+(flink-connector/.../KeyedScottyWindowOperator.java:17-103,
+GlobalScottyWindowOperator.java:16-85; builder chaining README.md:31-42).
+
+Requires ``apache-flink`` (pyflink) at runtime; without it the classes
+still construct and the same logic is drivable directly through
+``process_record(key, value, ts, current_watermark=...)`` — which is also
+exactly how the tests exercise the watermark-fallback behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from .base import KeyedScottyWindowOperator as _Core
+from .base import WatermarkPolicy
+
+try:
+    from pyflink.datastream.functions import (
+        KeyedProcessFunction as _KeyedBase,
+        ProcessFunction as _GlobalBase,
+    )
+
+    HAS_PYFLINK = True
+except ImportError:                      # pragma: no cover
+    HAS_PYFLINK = False
+    _KeyedBase = object
+    _GlobalBase = object
+
+
+class _EngineWatermarks(WatermarkPolicy):
+    """The flink connector's watermark strategy: use the engine's
+    currentWatermark when it advances, falling back to the element ts when
+    the engine reports none (KeyedScottyWindowOperator.java:72-86)."""
+
+    def __init__(self):
+        self.current = -1
+
+    def observe_with_engine(self, ts: int,
+                            engine_wm: Optional[int]) -> Optional[int]:
+        wm = engine_wm if engine_wm is not None and engine_wm > 0 else ts
+        if wm > self.current:
+            self.current = wm
+            return wm
+        return None
+
+    def observe(self, ts: int) -> Optional[int]:
+        return self.observe_with_engine(ts, None)
+
+
+class KeyedScottyWindowOperator(_KeyedBase):
+    """pyflink ``KeyedProcessFunction``: ``(value, ts)`` elements under a
+    ``key_by``, emitting ``(key, start, end, values)`` tuples downstream.
+
+    Usage with pyflink::
+
+        op = (KeyedScottyWindowOperator()
+                .add_window(TumblingWindow(WindowMeasure.Time, 1000))
+                .add_aggregation(SumAggregation())
+                .allowed_lateness(100))
+        stream.key_by(lambda e: e[0]).process(op)
+    """
+
+    def __init__(self, windows: Optional[List] = None,
+                 aggregations: Optional[List] = None,
+                 allowed_lateness: int = 1):
+        if HAS_PYFLINK:
+            super().__init__()
+        self._windows = list(windows or [])
+        self._aggregations = list(aggregations or [])
+        self._lateness = allowed_lateness
+        self._core: Optional[_Core] = None
+        self._policy = _EngineWatermarks()
+
+    # builder chaining (README.md:31-42)
+    def add_window(self, window) -> "KeyedScottyWindowOperator":
+        self._windows.append(window)
+        return self
+
+    def add_aggregation(self, fn) -> "KeyedScottyWindowOperator":
+        self._aggregations.append(fn)
+        return self
+
+    def allowed_lateness(self, lateness: int) -> "KeyedScottyWindowOperator":
+        self._lateness = lateness
+        return self
+
+    def _ensure_core(self) -> _Core:
+        if self._core is None:
+            self._core = _Core(
+                windows=self._windows, aggregations=self._aggregations,
+                allowed_lateness=self._lateness,
+                watermark_policy=self._policy)
+        return self._core
+
+    def process_record(self, key: Any, value: Any, ts: int,
+                       current_watermark: Optional[int] = None
+                       ) -> List[Tuple]:
+        """Engine-independent core: feed one keyed record with the engine's
+        current watermark (or None); returns emitted
+        ``(key, start, end, values)`` rows."""
+        core = self._ensure_core()
+        if core.backend == "device":
+            shard = hash(key) % core.n_key_shards
+            core._device().process_element(shard, value, ts)
+        else:
+            core._op_for_key(key).process_element(value, ts)
+        wm = self._policy.observe_with_engine(ts, current_watermark)
+        out = []
+        if wm is not None:
+            for k, w in core.process_watermark(wm):
+                out.append((k, w.get_start(), w.get_end(),
+                            tuple(w.get_agg_values())))
+        return out
+
+    # pyflink callback
+    def process_element(self, value, ctx):  # pragma: no cover - needs flink
+        key = ctx.get_current_key()
+        ts = ctx.timestamp()
+        if ts is None:
+            v, ts = value
+        else:
+            v = value
+        engine_wm = ctx.timer_service().current_watermark()
+        for row in self.process_record(key, v, int(ts), int(engine_wm)):
+            yield row
+
+
+class GlobalScottyWindowOperator(_GlobalBase):
+    """Non-keyed pyflink ``ProcessFunction``: one operator for the whole
+    stream (flink-connector/.../GlobalScottyWindowOperator.java:16-85)."""
+
+    def __init__(self, windows: Optional[List] = None,
+                 aggregations: Optional[List] = None,
+                 allowed_lateness: int = 1):
+        if HAS_PYFLINK:
+            super().__init__()
+        self._keyed = KeyedScottyWindowOperator(
+            windows=windows, aggregations=aggregations,
+            allowed_lateness=allowed_lateness)
+
+    def add_window(self, window) -> "GlobalScottyWindowOperator":
+        self._keyed.add_window(window)
+        return self
+
+    def add_aggregation(self, fn) -> "GlobalScottyWindowOperator":
+        self._keyed.add_aggregation(fn)
+        return self
+
+    def process_record(self, value: Any, ts: int,
+                       current_watermark: Optional[int] = None) -> List[Tuple]:
+        return [(s, e, vals) for _, s, e, vals in
+                self._keyed.process_record(0, value, ts, current_watermark)]
+
+    def process_element(self, value, ctx):  # pragma: no cover - needs flink
+        ts = ctx.timestamp()
+        if ts is None:
+            value, ts = value
+        engine_wm = ctx.timer_service().current_watermark()
+        for row in self.process_record(value, int(ts), int(engine_wm)):
+            yield row

@@ -217,3 +217,38 @@ def test_spark_attach_requires_pyspark():
 
     with _pytest.raises(ImportError, match="pyspark"):
         result_schema([SumAggregation()])
+
+
+def test_flink_adapter_engine_watermarks():
+    """The flink adapter uses the engine watermark when it advances and
+    falls back to element ts otherwise
+    (flink-connector KeyedScottyWindowOperator.java:72-86)."""
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.connectors.flink import KeyedScottyWindowOperator
+
+    op = (KeyedScottyWindowOperator()
+          .add_window(TumblingWindow(WindowMeasure.Time, 10))
+          .add_aggregation(SumAggregation())
+          .allowed_lateness(100))
+
+    assert op.process_record("a", 1, 1, current_watermark=None) == []
+    assert op.process_record("a", 2, 5, current_watermark=0) == []
+    # engine watermark advances past the first window: [0,10) emits
+    rows = op.process_record("a", 3, 12, current_watermark=11)
+    assert ("a", 0, 10, (3,)) in rows
+    # element-ts fallback (no engine watermark): ts 25 fires [10,20)
+    rows = op.process_record("a", 4, 25, current_watermark=0)
+    assert any(r[1] == 10 and r[2] == 20 and r[3] == (3,) for r in rows)
+
+
+def test_flink_global_adapter():
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.connectors.flink import GlobalScottyWindowOperator
+
+    op = (GlobalScottyWindowOperator(allowed_lateness=100)
+          .add_window(TumblingWindow(WindowMeasure.Time, 10)))
+    op.add_aggregation(SumAggregation())
+    op.process_record(1, 1)
+    op.process_record(2, 5)
+    rows = op.process_record(3, 15)
+    assert rows == [(0, 10, (3,))]
