@@ -16,12 +16,14 @@ from .windows import (
     FixedBandWindow,
     WindowContext,
     ActiveWindow,
+    TupleContext,
     AddModification,
     DeleteModification,
     ShiftModification,
 )
 from .aggregates import (
     AggregateFunction,
+    CommutativeAggregateFunction,
     ReduceAggregateFunction,
     InvertibleReduceAggregateFunction,
     DeviceAggregateSpec,
@@ -42,9 +44,9 @@ __all__ = [
     "Window", "WindowMeasure", "TIME", "COUNT",
     "ContextFreeWindow", "ForwardContextAware", "ForwardContextFree",
     "TumblingWindow", "SlidingWindow", "SessionWindow", "FixedBandWindow",
-    "WindowContext", "ActiveWindow",
+    "WindowContext", "ActiveWindow", "TupleContext",
     "AddModification", "DeleteModification", "ShiftModification",
-    "AggregateFunction", "ReduceAggregateFunction",
+    "AggregateFunction", "CommutativeAggregateFunction", "ReduceAggregateFunction",
     "InvertibleReduceAggregateFunction", "DeviceAggregateSpec",
     "SumAggregation", "CountAggregation", "MinAggregation", "MaxAggregation",
     "MeanAggregation", "QuantileAggregation", "DDSketchQuantileAggregation",

@@ -117,6 +117,20 @@ class AggregateFunction:
         """Fixed-width device realization, or None if host-only."""
         return None
 
+    #: True → ``combine`` is commutative as well as associative
+    #: (CommutativeAggregateFunction.java:3 marker — declared but never
+    #: consulted by the reference slicing code; kept for API parity, and
+    #: genuinely meaningful here: the global operator's round-robin
+    #: sharding reorders tuples, which is only sound for commutative
+    #: combines).
+    commutative: bool = False
+
+
+class CommutativeAggregateFunction(AggregateFunction):
+    """Marker base matching CommutativeAggregateFunction.java:3."""
+
+    commutative = True
+
 
 class ReduceAggregateFunction(AggregateFunction):
     """In == Partial == Final; lift/lower are identity

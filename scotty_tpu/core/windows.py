@@ -73,6 +73,16 @@ class Window:
         return self.measure
 
 
+class TupleContext:
+    """Iterator contract over a window's stored tuples
+    (core/.../windowType/TupleContext.java:3-9 — declared but unused by the
+    reference slicing code; kept for API parity). Implementations expose
+    ``iter_tuples() -> iterator of (ts, record)``."""
+
+    def iter_tuples(self):
+        raise NotImplementedError
+
+
 class ContextFreeWindow(Window):
     """Windows whose edges are computable from a timestamp alone
     (core/.../windowType/ContextFreeWindow.java:6-13)."""
