@@ -53,8 +53,15 @@ def parse_window_spec(spec: str, seed: int = 0) -> List[Window]:
     ``FixedBand(start,size)``, ``CountTumbling(size)``,
     ``randomTumbling(n,min,max)``, ``RandomSession(n,min,max)``,
     ``randomCount(n,min,max)`` — random variants use a fixed seed like the
-    reference (BenchmarkRunner.java:96-171).
+    reference (BenchmarkRunner.java:96-171). Specs joined with ``+`` build
+    a multi-window workload cell (e.g. ``Session(1000)+Sliding(60000,1000)``
+    — the BASELINE config-5 mix).
     """
+    if "+" in spec:
+        out: List[Window] = []
+        for part in spec.split("+"):
+            out.extend(parse_window_spec(part.strip(), seed=seed))
+        return out
     m = _SPEC_RE.match(spec)
     if not m:
         raise ValueError(f"bad window spec: {spec!r}")
@@ -127,6 +134,11 @@ class BenchmarkConfig:
     out_of_order_pct: float = 0.0
     max_lateness: int = 1000
     seed: int = 42
+    #: {"count": N, "minGapMs": a, "maxGapMs": b} — N silent spans at random
+    #: event-time positions (the reference's session gaps,
+    #: LoadGeneratorSource.java:60-76, generated BenchmarkRunner.java:174-192).
+    #: Without them a constant-rate stream is one session that never closes.
+    session_config: Optional[dict] = None
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -146,6 +158,7 @@ class BenchmarkConfig:
             out_of_order_pct=raw.get("outOfOrderPct", 0.0),
             max_lateness=raw.get("maxLateness", 1000),
             seed=raw.get("seed", 42),
+            session_config=raw.get("sessionConfig"),
         )
 
 
@@ -157,18 +170,35 @@ class BenchmarkConfig:
 def generate_batches(cfg: BenchmarkConfig):
     """Pre-generate the whole stream as numpy batches: values f32, event-time
     ms i64 (ascending, with optional bounded disorder), watermark points every
-    ``watermark_period_ms`` of event time."""
+    ``watermark_period_ms`` of event time. ``cfg.session_config`` inserts
+    silent event-time spans (session gaps) by stretching timestamps past
+    randomly placed gap positions — the reference generator's pause
+    mechanism (LoadGeneratorSource.java:60-76)."""
     rng = np.random.default_rng(cfg.seed)
     n_total = cfg.throughput * cfg.runtime_s
     B = cfg.batch_size
     n_batches = max(1, n_total // B)
     span_ms = cfg.runtime_s * 1000
+    gap_starts = gap_cum = None
+    if cfg.session_config:
+        sc = cfg.session_config
+        n_gaps = int(sc.get("count", 8))
+        gmin = int(sc.get("minGapMs", 1000))
+        gmax = int(sc.get("maxGapMs", 5000))
+        gap_starts = np.sort(rng.integers(0, span_ms, size=n_gaps))
+        gap_lens = rng.integers(gmin, max(gmin + 1, gmax), size=n_gaps)
+        gap_cum = np.cumsum(gap_lens)
     batches = []
     per_batch_span = span_ms / n_batches
     for i in range(n_batches):
         lo = i * per_batch_span
         ts = np.sort(rng.integers(int(lo), int(lo + per_batch_span),
                                   size=B)).astype(np.int64)
+        if gap_starts is not None:
+            # every tuple past gap k shifts by the total length of gaps
+            # 1..k → silent spans appear exactly at the gap positions
+            idx = np.searchsorted(gap_starts, ts, side="right")
+            ts = ts + np.where(idx > 0, gap_cum[np.maximum(idx - 1, 0)], 0)
         if cfg.out_of_order_pct > 0:
             late = rng.random(B) < cfg.out_of_order_pct
             ts = np.where(
@@ -275,7 +305,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     import jax
 
     windows = parse_window_spec(window_spec, seed=cfg.seed)
-    device_source = engine == "TpuEngine" and cfg.out_of_order_pct == 0
+    device_source = (engine == "TpuEngine" and cfg.out_of_order_pct == 0
+                     and not cfg.session_config)
     if device_source:
         gen = make_device_source(cfg)
         batches = None
@@ -291,6 +322,14 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         from ..simulator import SlicingWindowOperator
 
         op = SlicingWindowOperator()
+    elif engine == "Hybrid":
+        # automatic backend routing (session / count / holistic mixes run
+        # on the host; device-realizable workloads on the engine) — the
+        # BASELINE config-5 path. Measured with the generic sync loop.
+        from ..hybrid import HybridWindowOperator
+
+        op = HybridWindowOperator(
+            assume_inorder=cfg.out_of_order_pct == 0)
     else:
         raise ValueError(f"unknown engine {engine!r}")
 
@@ -372,7 +411,7 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                 next_wm += cfg.watermark_period_ms
         batches = []
     for vals, ts in batches:
-        if engine == "TpuEngine":
+        if engine in ("TpuEngine", "Hybrid"):
             op.process_elements(vals, ts)
         else:
             for v, t in zip(vals, ts):

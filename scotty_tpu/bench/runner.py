@@ -146,8 +146,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             wm_period_ms=cfg.watermark_period_ms, seed=cfg.seed)
         return _run_pipeline_cell(p, cfg, window_spec, agg_name, "buckets")
 
-    if engine == "Simulator":
-        return run_benchmark(cfg, window_spec, agg_name, engine="Simulator")
+    if engine in ("Simulator", "Hybrid"):
+        return run_benchmark(cfg, window_spec, agg_name, engine=engine)
 
     raise ValueError(f"unknown engine {engine!r}")
 

@@ -466,15 +466,21 @@ class HyperLogLogAggregation(AggregateFunction):
 
     @staticmethod
     def _hash64(x: np.ndarray) -> np.ndarray:
-        """splitmix64 finalizer — deterministic 64-bit avalanche hash."""
-        z = np.asarray(x, dtype=np.uint64)
-        z = (z + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
-        z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
-        z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & np.uint64(0xFFFFFFFFFFFFFFFF)
-        return z ^ (z >> np.uint64(31))
+        """splitmix64 finalizer — deterministic 64-bit avalanche hash.
+        uint64 wraparound is the algorithm; silence numpy's overflow
+        warning for it."""
+        with np.errstate(over="ignore"):
+            z = np.asarray(x, dtype=np.uint64)
+            z = (z + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+            z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+            z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+            return z ^ (z >> np.uint64(31))
 
     def _register_and_rho(self, value):
-        h = int(self._hash64(np.uint64(np.int64(hash(value)) & 0xFFFFFFFFFFFFFFFF)))
+        # mask in Python-int space BEFORE the uint64 cast: hash() can be
+        # negative and np.int64 & 0xFFFF... overflows (the mask doesn't fit
+        # a signed 64-bit)
+        h = int(self._hash64(np.uint64(hash(value) & 0xFFFFFFFFFFFFFFFF)))
         reg = h & (self.m - 1)
         rest = h >> self.p
         # rho = leading position of first 1 bit in the remaining 64-p bits

@@ -32,10 +32,17 @@ class HybridWindowOperator(WindowOperator):
     """WindowOperator that routes to the TPU engine when possible."""
 
     def __init__(self, state_factory: Optional[StateFactory] = None,
-                 engine_config=None, force_backend: Optional[str] = None):
+                 engine_config=None, force_backend: Optional[str] = None,
+                 assume_inorder: bool = False):
         self.state_factory = state_factory
         self.engine_config = engine_config
         self.force_backend = force_backend
+        #: the caller declares the stream in-order: workloads whose device
+        #: path exists only for in-order streams (pure-session, count
+        #: measure) route to the engine instead of the host — without the
+        #: declaration they stay on the host, because the engine rejects a
+        #: late tuple for those mixes only once data is already in HBM.
+        self.assume_inorder = assume_inorder
         self.windows: List[Window] = []
         self.aggregations: List[AggregateFunction] = []
         self.max_lateness = 1000
@@ -43,12 +50,27 @@ class HybridWindowOperator(WindowOperator):
 
     # -- decision tree (device analogue of SliceFactory.java:17-22) --------
     def _device_realizable(self) -> bool:
-        for w in self.windows:
-            if not isinstance(w, (TumblingWindow, SlidingWindow,
-                                  FixedBandWindow)):
+        from .core.windows import SessionWindow
+
+        session_gaps = {int(w.gap) for w in self.windows
+                        if isinstance(w, SessionWindow)}
+        if session_gaps:
+            # the device session path is the eager pure-session case
+            # (SliceFactory.java:17-22): ONE session window, Time measure,
+            # and an in-order stream declared by the caller
+            if not self.assume_inorder or len(self.windows) != 1 \
+                    or self.windows[0].measure != WindowMeasure.Time:
                 return False
-            if w.measure != WindowMeasure.Time:
-                return False
+        else:
+            for w in self.windows:
+                if not isinstance(w, (TumblingWindow, SlidingWindow,
+                                      FixedBandWindow)):
+                    return False
+                if w.measure != WindowMeasure.Time and not self.assume_inorder:
+                    return False            # OOO + count measure: host only
+                if (w.measure == WindowMeasure.Count
+                        and isinstance(w, FixedBandWindow)):
+                    return False
         for a in self.aggregations:
             if a.device_spec() is None:
                 return False

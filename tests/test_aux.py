@@ -217,3 +217,45 @@ def test_bench_small_run_device_vs_simulator():
     assert r_dev.n_tuples == r_sim.n_tuples
     # same stream, same windows → same emitted-window count
     assert r_dev.n_windows_emitted == r_sim.n_windows_emitted
+
+
+def test_hybrid_routes_pure_session_to_device_when_inorder():
+    """With an in-order declaration, the pure-session workload runs on the
+    engine's device session path (the eager session case,
+    SliceFactory.java:17-22); without it, conservatively on the host."""
+    from scotty_tpu.engine import EngineConfig
+
+    cfg = EngineConfig(capacity=512, batch_size=32, annex_capacity=64,
+                       min_trigger_pad=32)
+    dev = HybridWindowOperator(engine_config=cfg, assume_inorder=True)
+    host = HybridWindowOperator(engine_config=cfg)
+    for op in (dev, host):
+        op.add_window_assigner(SessionWindow(Time, 5))
+        op.add_aggregation(SumAggregation())
+        for v, t in [(1, 0), (2, 2), (5, 50), (3, 53)]:
+            op.process_element(v, t)
+    assert dev.backend == "device"
+    assert host.backend == "host"
+    rd = [(w.get_start(), w.get_end(), float(w.get_agg_values()[0]))
+          for w in dev.process_watermark(100) if w.has_value()]
+    rh = [(w.get_start(), w.get_end(), float(w.get_agg_values()[0]))
+          for w in host.process_watermark(100) if w.has_value()]
+    assert rd == rh == [(0, 7, 3.0), (50, 58, 8.0)]
+
+
+def test_session_gap_generator_closes_sessions():
+    """sessionConfig inserts silent event-time spans so session windows can
+    actually complete (LoadGeneratorSource.java:60-76)."""
+    import numpy as np
+
+    from scotty_tpu.bench.harness import BenchmarkConfig, generate_batches
+
+    cfg = BenchmarkConfig(throughput=20_000, runtime_s=4, batch_size=4096,
+                          session_config={"count": 4, "minGapMs": 1500,
+                                          "maxGapMs": 3000})
+    ts = np.sort(np.concatenate([b[1] for b in generate_batches(cfg)]))
+    assert int(np.diff(ts).max()) >= 1500          # a real silent span
+    # without sessionConfig the stream is gap-free at this rate
+    cfg2 = BenchmarkConfig(throughput=20_000, runtime_s=4, batch_size=4096)
+    ts2 = np.sort(np.concatenate([b[1] for b in generate_batches(cfg2)]))
+    assert int(np.diff(ts2).max()) < 1000
