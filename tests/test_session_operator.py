@@ -4,22 +4,25 @@ slicing/src/test/.../windowTest/SessionWindowOperatorTest.java."""
 import pytest
 
 from scotty_tpu import (
-    ReduceAggregateFunction,
     SessionWindow,
-    SlicingWindowOperator,
+    SumAggregation,
     TumblingWindow,
     WindowMeasure,
 )
+from conftest import make_operator
 from window_assert import assert_contains, assert_window
 
 
-@pytest.fixture
-def op():
-    return SlicingWindowOperator()
+@pytest.fixture(params=["host", "engine"])
+def op(request):
+    # engine = the pure-session device path for the in-order single-gap
+    # cases; everything else (out-of-order repair, session+tumbling mixes,
+    # multi-session) skips to host-only via conftest.SkipUnsupported
+    return make_operator(request.param)
 
 
 def sum_fn():
-    return ReduceAggregateFunction(lambda a, b: a + b)
+    return SumAggregation()
 
 
 def test_in_order(op):
