@@ -28,6 +28,33 @@ def _state_to_host(state) -> dict:
     }
 
 
+def _host_clocks(op) -> dict:
+    """The TpuWindowOperator's host-side clock mirrors: without them a
+    restored operator thinks its store is empty (``_host_met is None``
+    short-circuits process_watermark) and mis-clamps the first watermark."""
+    return {
+        "host_met": op._host_met,
+        "host_min_ts": op._host_min_ts,
+        "host_oldest": getattr(op, "_host_oldest", None),
+        "host_count": op._host_count,
+        "last_count": op._last_count,
+        "annex_dirty": op._annex_dirty,
+    }
+
+
+def _restore_meta(op, meta: dict) -> None:
+    op._last_watermark = meta["last_watermark"]
+    op.max_lateness = meta["max_lateness"]
+    op.max_fixed_window_size = meta["max_fixed_window_size"]
+    if "host_met" in meta:              # snapshots from ≥ this revision
+        op._host_met = meta["host_met"]
+        op._host_min_ts = meta["host_min_ts"]
+        op._host_oldest = meta["host_oldest"]
+        op._host_count = meta["host_count"]
+        op._last_count = meta["last_count"]
+        op._annex_dirty = meta["annex_dirty"]
+
+
 def save_engine_operator(op, path: str) -> None:
     """Snapshot a TpuWindowOperator (device state + host clocks). The
     windows/aggregations/config are re-registered on restore by the caller
@@ -47,6 +74,7 @@ def save_engine_operator(op, path: str) -> None:
         "max_lateness": op.max_lateness,
         "max_fixed_window_size": op.max_fixed_window_size,
         "n_leaves": len(leaves),
+        **_host_clocks(op),
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f)
@@ -68,9 +96,7 @@ def restore_engine_operator(op, path: str) -> None:
     cast = [np.asarray(l, dtype=np.asarray(t).dtype)
             for l, t in zip(leaves, template)]
     op._state = jax.tree.unflatten(treedef, cast)
-    op._last_watermark = meta["last_watermark"]
-    op.max_lateness = meta["max_lateness"]
-    op.max_fixed_window_size = meta["max_fixed_window_size"]
+    _restore_meta(op, meta)
 
 
 def save_engine_operator_orbax(op, path: str) -> None:
@@ -88,7 +114,7 @@ def save_engine_operator_orbax(op, path: str) -> None:
         json.dump({"last_watermark": op._last_watermark,
                    "max_lateness": op.max_lateness,
                    "max_fixed_window_size": op.max_fixed_window_size,
-                   "orbax": True}, f)
+                   "orbax": True, **_host_clocks(op)}, f)
 
 
 def restore_engine_operator_orbax(op, path: str) -> None:
@@ -105,9 +131,7 @@ def restore_engine_operator_orbax(op, path: str) -> None:
     ckptr = ocp.PyTreeCheckpointer()
     op._state = ckptr.restore(os.path.join(os.path.abspath(path), "orbax"),
                               item=op._state)
-    op._last_watermark = meta["last_watermark"]
-    op.max_lateness = meta["max_lateness"]
-    op.max_fixed_window_size = meta["max_fixed_window_size"]
+    _restore_meta(op, meta)
 
 
 def save_host_operator(op, path: str) -> None:
