@@ -107,20 +107,19 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     engine = {"Slicing": "TpuEngine", "Flink": "Buckets"}.get(engine, engine)
 
     if engine == "TpuEngine":
-        if cfg.out_of_order_pct == 0:
-            try:
-                from ..engine import EngineConfig
-                from ..engine.pipeline import AlignedStreamPipeline
+        if cfg.out_of_order_pct == 0 and not cfg.session_config:
+            from ..engine import EngineConfig
+            from ..engine.pipeline import AlignedStreamPipeline, StreamPipeline
 
+            econf = EngineConfig(capacity=cfg.capacity, annex_capacity=8,
+                                 min_trigger_pad=32)
+            try:
                 tp = _round_throughput(
                     cfg.throughput,
                     AlignedStreamPipeline.slice_grid(
                         windows, cfg.watermark_period_ms))
                 p = AlignedStreamPipeline(
-                    windows, [make_aggregation(agg_name)],
-                    config=EngineConfig(capacity=cfg.capacity,
-                                        annex_capacity=8,
-                                        min_trigger_pad=32),
+                    windows, [make_aggregation(agg_name)], config=econf,
                     throughput=tp, wm_period_ms=cfg.watermark_period_ms,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
                     gc_every=32)
@@ -128,8 +127,22 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                                           "aligned")
             except NotImplementedError:
                 pass
-        # out-of-order / count-measure / band specs: batch-at-a-time device
-        # operator (annex path), via the classic harness
+            try:
+                # fused fallback for in-order specs the aligned pipeline
+                # rejects (fixed-band windows, sketch lifts on bands…):
+                # still one XLA dispatch per watermark interval, via the
+                # general scatter ingest
+                p = StreamPipeline(
+                    windows, [make_aggregation(agg_name)], config=econf,
+                    throughput=cfg.throughput,
+                    wm_period_ms=cfg.watermark_period_ms,
+                    max_lateness=cfg.max_lateness, seed=cfg.seed)
+                return _run_pipeline_cell(p, cfg, window_spec, agg_name,
+                                          "fused")
+            except NotImplementedError:
+                pass
+        # out-of-order / count-measure / session specs: batch-at-a-time
+        # device operator (annex path), via the classic harness
         return run_benchmark(cfg, window_spec, agg_name, engine="TpuEngine")
 
     if engine == "Buckets":

@@ -210,28 +210,49 @@ class StreamPipeline:
             return state, (ws, we, cnt, results)
 
         self._step = jax.jit(step, donate_argnums=0)
-        self._key = None
+        self._root = None
         self.state = None
+        self._interval = 0
 
     def reset(self) -> None:
+        import jax
+
         self.state = self._init_state()
+        self._root = jax.random.PRNGKey(self.seed)
+        self._interval = 0
 
     def run(self, n_intervals: int, collect: bool = True):
-        """Run n watermark intervals; returns list of per-interval
-        (ws, we, cnt, results) device handles (fetch with jax.device_get)."""
+        """Advance n watermark intervals (continuing from the last call —
+        interval numbering is stateful, so warmup + timed + latency phases
+        see one continuous stream); returns the per-interval
+        (ws, we, cnt, results) device handles."""
         import jax
 
         if self.state is None:
             self.reset()
-        root = jax.random.PRNGKey(self.seed)
         out = []
-        for i in range(n_intervals):
+        for _ in range(n_intervals):
+            i = self._interval
             self.state, res = self._step(self.state,
-                                         jax.random.fold_in(root, i),
+                                         jax.random.fold_in(self._root, i),
                                          np.int64(i))
+            self._interval += 1
             if collect:
                 out.append(res)
         return out
+
+    def sync(self) -> int:
+        """Drain all queued device work; returns n_slices."""
+        import jax
+
+        return int(jax.device_get(self.state.n_slices))
+
+    def check_overflow(self) -> None:
+        import jax
+
+        if bool(jax.device_get(self.state.overflow)):
+            raise RuntimeError("slice buffer overflow: raise capacity or "
+                               "advance watermarks more often")
 
     def lowered_results(self, interval_out) -> list:
         """Fetch + lower one interval's window results on host."""
