@@ -260,10 +260,6 @@ class TpuWindowOperator(WindowOperator):
         self._last_count = 0
         self._host_met = None           # host mirror of max event time
         self._host_min_ts = None        # host mirror of min event time
-        self._host_oldest = None        # host mirror of oldest slice start
-                                        # (evaluated with the spec current at
-                                        # ingest time — dynamic additions
-                                        # must not re-grid old slices)
         self._host_count = 0            # host mirror of current_count
         self._annex_dirty = False       # a late tuple may sit in the annex
         self._valid_dev = None          # cached all-true lane mask
@@ -326,9 +322,6 @@ class TpuWindowOperator(WindowOperator):
             mn = int(batch_t[0])
             self._host_min_ts = mn if self._host_min_ts is None \
                 else min(self._host_min_ts, mn)
-            og = self._host_grid_start(mn)
-            self._host_oldest = og if self._host_oldest is None \
-                else min(self._host_oldest, og)
             self._host_count += take
         valid = np.ones((B,), dtype=bool)
         if take < B:
@@ -365,9 +358,6 @@ class TpuWindowOperator(WindowOperator):
             else max(self._host_met, ts_max)
         self._host_min_ts = ts_min if self._host_min_ts is None \
             else min(self._host_min_ts, ts_min)
-        og = self._host_grid_start(ts_min)
-        self._host_oldest = og if self._host_oldest is None \
-            else min(self._host_oldest, og)
         self._host_count += n
         self._state = self._ingest(self._state, ts, vals, self._valid_dev)
 
@@ -384,21 +374,6 @@ class TpuWindowOperator(WindowOperator):
                  and measures[i] else WindowMeasure.Time)
             out.append(AggregateWindow(m, int(ws[i]), int(we[i]), values, has))
         return out
-
-    def _host_grid_start(self, ts: int) -> int:
-        """Host mirror of core.grid_start for one scalar — used for the
-        first-watermark clamp without a device roundtrip."""
-        best = 0
-        for p in self._spec.periods:
-            best = max(best, ts - ts % p if ts >= 0 else 0)
-        for (p, r) in self._spec.offset_periods:
-            best = max(best, ts - (ts - r) % p)
-        for (bs, bsz) in self._spec.bands:
-            if ts >= bs + bsz:
-                best = max(best, bs + bsz)
-            elif ts >= bs:
-                best = max(best, bs)
-        return best
 
     def process_watermark_async(self, watermark_ts: int):
         """Dispatch the full watermark program with NO device→host sync on
@@ -433,9 +408,12 @@ class TpuWindowOperator(WindowOperator):
             self._last_watermark = watermark_ts
             return no_result
 
-        if first_watermark:
-            if last_wm < self._host_oldest:
-                last_wm = self._host_oldest
+        # NOTE: the reference's first-watermark clamp to the oldest slice
+        # start (WindowManager.java:51-55) is a no-op here: its bootstrap
+        # slice always starts at 0 (SliceManager empty-store append at 0),
+        # and last_wm is already clamped to >= 0 above. Clamping to
+        # grid_start(min ts) instead would skip the leading empty windows
+        # the reference emits (caught by randomized differential fuzzing).
 
         if self._annex_dirty:
             self._state = self._merge(self._state)

@@ -35,7 +35,6 @@ def _host_clocks(op) -> dict:
     return {
         "host_met": op._host_met,
         "host_min_ts": op._host_min_ts,
-        "host_oldest": getattr(op, "_host_oldest", None),
         "host_count": op._host_count,
         "last_count": op._last_count,
         "annex_dirty": op._annex_dirty,
@@ -49,7 +48,6 @@ def _restore_meta(op, meta: dict) -> None:
     if "host_met" in meta:              # snapshots from ≥ this revision
         op._host_met = meta["host_met"]
         op._host_min_ts = meta["host_min_ts"]
-        op._host_oldest = meta["host_oldest"]
         op._host_count = meta["host_count"]
         op._last_count = meta["last_count"]
         op._annex_dirty = meta["annex_dirty"]

@@ -481,3 +481,44 @@ def test_dynamic_addition_sliding_nondivisible_exact():
         expected = float(arr_v[m].sum())
         got = float(w.get_agg_values()[0]) if w.has_value() else 0.0
         assert got == pytest.approx(expected), (s, e)
+
+
+@pytest.mark.parametrize("seed", [2, 5, 8, 14])
+def test_randomized_specs_with_valid_watermarks(seed):
+    """Randomized window mixes (pow2 tumbling, bands, divisible sliding) +
+    bounded disorder, with watermark sequences that never run ahead of the
+    observed max event time (the contract every real watermark policy
+    satisfies; the reference crashes identically on tuples older than its
+    oldest slice, so racing watermarks are out of contract)."""
+    rng = np.random.default_rng(seed)
+    pool = [
+        lambda r: TumblingWindow(Time, int(r.choice([2, 8, 10, 25, 64]))),
+        lambda r: SlidingWindow(Time, int(r.choice([20, 40, 80])),
+                                int(r.choice([2, 4, 5, 10, 20]))),
+        lambda r: FixedBandWindow(Time, int(r.integers(0, 200)),
+                                  int(r.integers(10, 100))),
+    ]
+    wins = []
+    for _ in range(int(rng.integers(1, 4))):
+        w = pool[int(rng.integers(0, len(pool)))](rng)
+        if isinstance(w, SlidingWindow) and w.size % w.slide:
+            continue
+        wins.append(w)
+    if not wins:
+        wins = [TumblingWindow(Time, 10)]
+    n = 200
+    ts = np.sort(rng.integers(0, 1200, size=n))
+    lateness = int(rng.choice([0, 50, 1000]))
+    if lateness:
+        late = rng.random(n) < 0.15
+        ts = np.where(late, np.maximum(
+            ts - rng.integers(0, lateness, size=n), 0), ts)
+    stream = [(int(v), int(t))
+              for v, t in zip(rng.integers(1, 99, size=n), ts)]
+    wms = []
+    for c in (n // 3, 2 * n // 3, n - 1):
+        met = int(np.max(ts[:c + 1]))
+        wms.append((c, max(1, met - int(rng.integers(0, 20)))))
+    wms.append((n - 1, int(np.max(ts)) + 3000))
+    run_both(wins, [SumAggregation, MinAggregation, CountAggregation],
+             stream, wms, lateness=lateness or 1000)
