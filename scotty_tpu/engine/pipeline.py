@@ -71,36 +71,105 @@ def build_trigger_grid(windows, wm_period_ms: int):
         else:
             raise NotImplementedError(f"pipeline: {type(w).__name__}")
 
-    def make_triggers(last_wm, wm):
-        ws_parts, we_parts, valid_parts = [], [], []
-        for (g, size, maxk, kind) in trig_layout:
-            if kind == "b":
-                end = jnp.asarray([g + size], jnp.int64)
-                start = jnp.asarray([g], jnp.int64)
-                ok = (end >= last_wm) & (end <= wm)
-            elif kind == "s":
-                # starts lie on the slide grid; ends = start + size are NOT
-                # multiples of the slide when size % slide != 0, so enumerate
-                # starts: smallest grid start with end > last_wm.
-                first_start = ((last_wm - size) // g + 1) * g
-                starts = first_start + g * jnp.arange(maxk, dtype=jnp.int64)
-                ends = starts + size
-                # SlidingWindow.java:50-57 guards (note <= wm + 1)
-                ok = (starts >= 0) & (ends <= wm + 1)
-                start, end = starts, ends
-            else:
-                first_end = (last_wm // g + 1) * g
-                ends = first_end + g * jnp.arange(maxk, dtype=jnp.int64)
-                starts = ends - size
-                ok = ends <= wm
-                start, end = starts, ends
-            ws_parts.append(start)
-            we_parts.append(end)
-            valid_parts.append(ok)
-        return (jnp.concatenate(ws_parts), jnp.concatenate(we_parts),
-                jnp.concatenate(valid_parts))
+    if len(trig_layout) <= 32:
+        # few windows: per-window parts, exact trigger counts
+        def make_triggers(last_wm, wm):
+            ws_parts, we_parts, valid_parts = [], [], []
+            for (g, size, maxk, kind) in trig_layout:
+                if kind == "b":
+                    end = jnp.asarray([g + size], jnp.int64)
+                    start = jnp.asarray([g], jnp.int64)
+                    ok = (end >= last_wm) & (end <= wm)
+                elif kind == "s":
+                    # starts lie on the slide grid; ends = start + size are
+                    # NOT multiples of the slide when size % slide != 0, so
+                    # enumerate starts: smallest grid start with
+                    # end > last_wm.
+                    first_start = ((last_wm - size) // g + 1) * g
+                    starts = first_start + g * jnp.arange(maxk,
+                                                          dtype=jnp.int64)
+                    ends = starts + size
+                    # SlidingWindow.java:50-57 guards (note <= wm + 1)
+                    ok = (starts >= 0) & (ends <= wm + 1)
+                    start, end = starts, ends
+                else:
+                    first_end = (last_wm // g + 1) * g
+                    ends = first_end + g * jnp.arange(maxk, dtype=jnp.int64)
+                    starts = ends - size
+                    ok = ends <= wm
+                    start, end = starts, ends
+                ws_parts.append(start)
+                we_parts.append(end)
+                valid_parts.append(ok)
+            return (jnp.concatenate(ws_parts), jnp.concatenate(we_parts),
+                    jnp.concatenate(valid_parts))
 
-    return make_triggers, sum(m for _, _, m, _ in trig_layout)
+        return make_triggers, sum(m for _, _, m, _ in trig_layout)
+
+    # many windows (e.g. 1000 random tumbling): a per-window op chain makes
+    # the traced graph O(5·n_windows) and OOM-kills the XLA compiler. Build
+    # ONE [N, K] grid per window kind instead (K = that kind's max trigger
+    # count; rows padded with an invalid mask), then restore exact
+    # registration order with a single static gather.
+    groups = {"t": [], "s": [], "b": []}
+    for idx, (g, size, maxk, kind) in enumerate(trig_layout):
+        groups[kind].append((idx, g, size, maxk))
+    # static row layout: (window idx, k) for each emitted slot, kind-grouped
+    slot_owner = []
+    for kind in ("t", "s", "b"):
+        rows = groups[kind]
+        if not rows:
+            continue
+        K = max(m for _, _, _, m in rows)
+        for (idx, _, _, _) in rows:
+            for k in range(K):
+                slot_owner.append((idx, k))
+    # permutation restoring registration order, dropping over-padded slots
+    # beyond each window's own maxk
+    slot_of = {ik: pos for pos, ik in enumerate(slot_owner)}
+    order = []
+    for idx, (g, size, maxk, kind) in enumerate(trig_layout):
+        for k in range(maxk):
+            order.append(slot_of[(idx, k)])
+    perm = np.asarray(order, dtype=np.int64)
+    T_total = perm.shape[0]
+
+    def make_triggers_grouped(last_wm, wm):
+        ws_parts, we_parts, ok_parts = [], [], []
+        for kind in ("t", "s", "b"):
+            rows = groups[kind]
+            if not rows:
+                continue
+            K = max(m for _, _, _, m in rows)
+            gs = jnp.asarray([g for _, g, _, _ in rows], jnp.int64)[:, None]
+            szs = jnp.asarray([s for _, _, s, _ in rows],
+                              jnp.int64)[:, None]
+            mks = jnp.asarray([m for _, _, _, m in rows],
+                              jnp.int64)[:, None]
+            k = jnp.arange(K, dtype=jnp.int64)[None, :]
+            if kind == "b":
+                ends = gs + szs + 0 * k
+                starts = gs + 0 * k
+                ok = (ends >= last_wm) & (ends <= wm)
+            elif kind == "s":
+                first_start = ((last_wm - szs) // gs + 1) * gs
+                starts = first_start + gs * k
+                ends = starts + szs
+                ok = (starts >= 0) & (ends <= wm + 1)
+            else:
+                first_end = (last_wm // gs + 1) * gs
+                ends = first_end + gs * k
+                starts = ends - szs
+                ok = ends <= wm
+            ok = ok & (k < mks)
+            ws_parts.append(starts.reshape(-1))
+            we_parts.append(ends.reshape(-1))
+            ok_parts.append(ok.reshape(-1))
+        return (jnp.concatenate(ws_parts)[perm],
+                jnp.concatenate(we_parts)[perm],
+                jnp.concatenate(ok_parts)[perm])
+
+    return make_triggers_grouped, T_total
 
 
 def lower_interval(aggregations: Sequence[AggregateFunction], interval_out):
