@@ -67,6 +67,13 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         # interval — a few deterministic intervals measure it fine
         timed = min(timed, 3)
         latency_samples = min(latency_samples, 3)
+    # the sparsest window must trigger at least once inside the timed
+    # region (a 60 s-slide window fires every 60 intervals — a 10-interval
+    # run would report windows_emitted=0)
+    max_period = max(
+        int(getattr(w, "slide", 0) or getattr(w, "size", 0))
+        for w in pipeline.windows)
+    timed = max(timed, -(-max_period // pipeline.wm_period_ms) + 1)
 
     pipeline.reset()
     if hasattr(pipeline, "prefill"):
