@@ -369,6 +369,7 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     next_wm = cfg.watermark_period_ms
     n_tuples = 0
     pending = []                 # (T, cnt_dev) handles, fetched at drain
+    pending_sessions = []        # per-watermark emitted-session counts (dev)
     wm_count = 0
     SAMPLE_EVERY = 8             # emit-latency sampling cadence
 
@@ -385,7 +386,11 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                 jax.device_get(op._state.n_slices)        # drain the queue
                 t_wm = time.perf_counter()
             out = op.process_watermark_async(wm)
-            if not isinstance(out[0], str) and out[3] is not None:
+            if isinstance(out[0], str):          # pure-session sweep
+                pending_sessions.append(out[1])  # m = sessions emitted
+                if sample:
+                    jax.device_get(out[1])
+            elif out[3] is not None:
                 pending.append((out[0].shape[0], out[3]))
                 if sample:
                     jax.device_get((out[3], out[4]))
@@ -427,6 +432,9 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         fetched = jax.device_get([c for _, c in pending])
         for (T, _), cnt in zip(pending, fetched):
             n_emitted += int((cnt[:T] > 0).sum())
+        if pending_sessions:
+            n_emitted += int(sum(int(m)
+                                 for m in jax.device_get(pending_sessions)))
         op.check_overflow()
     wall = time.perf_counter() - t0
 

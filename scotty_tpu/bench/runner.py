@@ -166,8 +166,25 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             wm_period_ms=cfg.watermark_period_ms, seed=cfg.seed)
         return _run_pipeline_cell(p, cfg, window_spec, agg_name, "buckets")
 
-    if engine in ("Simulator", "Hybrid"):
-        return run_benchmark(cfg, window_spec, agg_name, engine=engine)
+    if engine == "Hybrid":
+        # resolve the backend the way HybridWindowOperator would, then use
+        # the matching measurement loop: device-realizable workloads take
+        # the async TpuEngine path (the sync loop pays a full tunnel
+        # round-trip per watermark), everything else runs on the host
+        from ..hybrid import HybridWindowOperator
+
+        probe = HybridWindowOperator(
+            assume_inorder=cfg.out_of_order_pct == 0)
+        for w in windows:
+            probe.add_window_assigner(w)
+        probe.add_aggregation(make_aggregation(agg_name))
+        if probe._device_realizable():
+            return run_benchmark(cfg, window_spec, agg_name,
+                                 engine="TpuEngine")
+        return run_benchmark(cfg, window_spec, agg_name, engine="Hybrid")
+
+    if engine == "Simulator":
+        return run_benchmark(cfg, window_spec, agg_name, engine="Simulator")
 
     raise ValueError(f"unknown engine {engine!r}")
 
