@@ -186,7 +186,92 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if engine == "Simulator":
         return run_benchmark(cfg, window_spec, agg_name, engine="Simulator")
 
+    if engine == "Keyed":
+        return run_keyed_cell(cfg, window_spec, agg_name)
+
     raise ValueError(f"unknown engine {engine!r}")
+
+
+def run_keyed_cell(cfg: BenchmarkConfig, window_spec: str,
+                   agg_name: str) -> BenchResult:
+    """Keyed-throughput cell: ``cfg.n_keys`` independent keyed operators as
+    one batched device program (the reference's keyBy scaling model,
+    KeyedScottyWindowOperator.java:56-66 — there a HashMap of JVM objects,
+    here a [K, ...] slice-buffer batch; SURVEY.md §2.8).
+
+    The stream is generated ON DEVICE ([K, B] rounds, row k = key k's
+    tuples, cumulative-gap timestamps so rows are sorted by construction)
+    and fed zero-copy — the keyed analogue of make_device_source. Feeding
+    pre-partitioned per-key rows is the same work split as the reference,
+    where the host engine's keyBy does the partitioning before Scotty sees
+    the tuples; host-side partitioning is measured separately by
+    bench.micro's host_pack phase."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engine import EngineConfig
+    from ..parallel import KeyedTpuWindowOperator
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    K = cfg.n_keys
+    B = max(64, cfg.batch_size // max(1, K))
+    econf = EngineConfig(capacity=cfg.capacity, batch_size=B,
+                         min_trigger_pad=32)
+
+    op = KeyedTpuWindowOperator(n_keys=K, config=econf)
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(make_aggregation(agg_name))
+    op.set_max_lateness(cfg.max_lateness)
+
+    tuples_per_round = K * B
+    rounds_per_wm = max(1, cfg.throughput * cfg.watermark_period_ms
+                        // 1000 // tuples_per_round)
+    span = cfg.watermark_period_ms / rounds_per_wm    # event-ms per round
+
+    @jax.jit
+    def gen_round(key, lo):
+        u = jax.random.uniform(key, (2, K, B), dtype=jnp.float32)
+        gaps = u[0] / jnp.sum(u[0], axis=1, keepdims=True) * span
+        ts = (lo + jnp.cumsum(gaps.astype(jnp.float64), axis=1)) \
+            .astype(jnp.int64)
+        return ts, u[1] * 10_000.0
+
+    valid = jax.device_put(np.ones((K, B), bool))
+    root = jax.random.PRNGKey(cfg.seed)
+
+    def feed_interval(i):
+        base = i * cfg.watermark_period_ms
+        for r in range(rounds_per_wm):
+            lo = base + r * span
+            ts, vals = gen_round(jax.random.fold_in(root, i * 4096 + r),
+                                 jnp.float64(lo))
+            op.ingest_device_round(ts, vals, valid,
+                                   int(lo), int(lo + span))
+
+    # warmup interval: compile generator + ingest + watermark kernels
+    feed_interval(0)
+    op.process_watermark_arrays(cfg.watermark_period_ms)
+    jax.device_get(op._state.n_slices[0])
+
+    lats: list = []
+    emitted = 0
+    t0 = time.perf_counter()
+    for i in range(1, cfg.runtime_s + 1):
+        feed_interval(i)
+        t1 = time.perf_counter()
+        ws, we, cnt, lowered = op.process_watermark_arrays(
+            (i + 1) * cfg.watermark_period_ms)
+        lats.append((time.perf_counter() - t1) * 1e3)
+        emitted += int((cnt > 0).sum())
+    wall = time.perf_counter() - t0
+    n_tuples = cfg.runtime_s * rounds_per_wm * tuples_per_round
+    return BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=float(np.percentile(lats, 99)) if lats else 0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
 
 
 def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",

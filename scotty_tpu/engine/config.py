@@ -39,6 +39,15 @@ class EngineConfig:
     #: Partial-aggregate dtype on device.
     partial_dtype: str = "float32"
 
+    #: Run bound for the dense in-order ingest kernel (ingest_dense): an
+    #: in-order batch touching < this many NEW slices takes the
+    #: scatter-free path (int64 scatters are the dominant ingest cost on
+    #: TPU; the dense kernel replaces [batch]-lane scatters with run
+    #: reductions + a [runs]-lane update). Batches spanning more slices
+    #: fall back to the general kernel — the host checks the bound from
+    #: the batch's time span and the minimum grid period. 0 disables.
+    dense_ingest_runs: int = 16
+
     def trigger_pad(self, n: int) -> int:
         """Next power-of-two bucket ≥ n (≥ min_trigger_pad)."""
         p = self.min_trigger_pad

@@ -82,9 +82,53 @@ def _kernels(spec, capacity: int, annex_capacity: int):
             jax.jit(ec.build_count_probe(spec, capacity)),
             jax.jit(ec.build_annex_merge(spec, capacity, annex_capacity),
                     donate_argnums=0),
+            # in-order batches skip the late/annex scatter sets entirely
+            # (int64 scatters dominate ingest cost — ~100 ms per 1M lanes)
+            jax.jit(ec.build_ingest(spec, capacity, annex_capacity,
+                                    assume_inorder=True),
+                    donate_argnums=0),
         )
         _KERNEL_CACHE[key] = hit
     return hit
+
+
+def _dense_kernel(spec, capacity: int, runs: int):
+    """Jitted scatter-free in-order ingest (build_ingest_dense), cached."""
+    import jax
+    from . import core as ec
+
+    key = ("dense", spec.periods, spec.bands, spec.offset_periods,
+           tuple(a.token for a in spec.aggs), capacity, runs)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        hit = jax.jit(ec.build_ingest_dense(spec, capacity, runs),
+                      donate_argnums=0)
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
+def dense_eligible(spec) -> bool:
+    """Static part of the dense-ingest decision: no count/session windows,
+    dense-lift aggregations only."""
+    return (not spec.count_periods and not spec.session_gaps
+            and all(not a.is_sparse for a in spec.aggs))
+
+
+def min_grid_period(spec) -> int:
+    """Smallest distance between consecutive union-grid points — the
+    host-side bound for how many slices a time span can touch."""
+    g = 0
+    import math
+
+    for p in spec.periods:
+        g = math.gcd(g, int(p))
+    for (p, r) in spec.offset_periods:
+        g = math.gcd(g, int(p))
+        g = math.gcd(g, int(r))
+    for (bs, bsz) in spec.bands:
+        g = math.gcd(g, int(bs))
+        g = math.gcd(g, int(bsz))
+    return max(1, g)
 
 
 class TpuWindowOperator(WindowOperator):
@@ -187,7 +231,12 @@ class TpuWindowOperator(WindowOperator):
         self._spec = self._compute_spec()
         C, A = self.config.capacity, self.config.annex_capacity
         (self._ingest, self._query, self._gc, self._count_at,
-         self._merge) = _kernels(self._spec, C, A)
+         self._merge, self._ingest_inorder) = _kernels(self._spec, C, A)
+        # the dense fast path closes over the union grid too
+        self._dense_runs = self.config.dense_ingest_runs \
+            if dense_eligible(self._spec) else 0
+        self._min_grid = min_grid_period(self._spec)
+        self._ingest_dense = None
 
     def add_aggregation(self, window_function: AggregateFunction) -> None:
         if self._built:
@@ -252,10 +301,15 @@ class TpuWindowOperator(WindowOperator):
         if self._is_session:
             self._ingest, self._session_sweep = _session_kernels(
                 self._spec, C, A, self.config.trigger_pad(1024))
+            self._ingest_inorder = self._ingest
             self._emit_cap = self.config.trigger_pad(1024)
         else:
             (self._ingest, self._query, self._gc, self._count_at,
-             self._merge) = _kernels(self._spec, C, A)
+             self._merge, self._ingest_inorder) = _kernels(self._spec, C, A)
+        self._dense_runs = self.config.dense_ingest_runs \
+            if (not self._is_session and dense_eligible(self._spec)) else 0
+        self._min_grid = min_grid_period(self._spec)
+        self._ingest_dense = None       # built lazily on first eligible batch
         self._has_count = bool(self._spec.count_periods)
         self._last_count = 0
         self._host_met = None           # host mirror of max event time
@@ -311,9 +365,10 @@ class TpuWindowOperator(WindowOperator):
                 raise UnsupportedOnDevice(
                     "out-of-order tuples with count-measure or session "
                     "windows need the host operator")
+        has_late = (take > 0 and self._host_met is not None
+                    and int(batch_t[0]) < self._host_met)
         if take:
-            if (self._host_met is not None
-                    and int(batch_t[0]) < self._host_met):
+            if has_late:
                 # late tuples may open annex slices → merge before next query
                 self._annex_dirty = True
             mx = int(batch_t[take - 1]) if take < B else int(batch_t[-1])
@@ -331,7 +386,22 @@ class TpuWindowOperator(WindowOperator):
             batch_v = np.concatenate(
                 [batch_v, np.zeros((B - take,), np.float32)])
             valid[take:] = False
-        self._state = self._ingest(self._state, batch_t, batch_v, valid)
+        kern = self._ingest if has_late else self._pick_inorder_kernel(
+            int(batch_t[0]) if take else 0,
+            int(batch_t[take - 1]) if take else 0)
+        self._state = kern(self._state, batch_t, batch_v, valid)
+
+    def _pick_inorder_kernel(self, ts_lo: int, ts_hi: int):
+        """Scatter-free dense kernel when the batch's slice-run count is
+        provably under the bound; general in-order kernel otherwise."""
+        if self._dense_runs:
+            runs = (ts_hi - ts_lo) // self._min_grid + 3
+            if runs <= self._dense_runs:
+                if self._ingest_dense is None:
+                    self._ingest_dense = _dense_kernel(
+                        self._spec, self.config.capacity, self._dense_runs)
+                return self._ingest_dense
+        return self._ingest_inorder
 
     def _flush(self) -> None:
         while self._n_pending > 0:
@@ -359,7 +429,10 @@ class TpuWindowOperator(WindowOperator):
         self._host_min_ts = ts_min if self._host_min_ts is None \
             else min(self._host_min_ts, ts_min)
         self._host_count += n
-        self._state = self._ingest(self._state, ts, vals, self._valid_dev)
+        # contract: device batches are in-order → late-free kernel (dense
+        # scatter-free variant when the span bound allows)
+        kern = self._pick_inorder_kernel(ts_min, ts_max)
+        self._state = kern(self._state, ts, vals, self._valid_dev)
 
     # -- watermark ---------------------------------------------------------
     def process_watermark(self, watermark_ts: int) -> List[AggregateWindow]:

@@ -116,7 +116,15 @@ class KeyedTpuWindowOperator:
                id(self.mesh), self.axis)
         hit = _KERNEL_CACHE.get(key)
         if hit is None:
+            from ..engine.operator import dense_eligible, min_grid_period
+
             ingest1 = ec.build_ingest(self._spec, C, A)
+            ingest_io1 = ec.build_ingest(self._spec, C, A,
+                                         assume_inorder=True)
+            dense_runs = (self.config.dense_ingest_runs
+                          if dense_eligible(self._spec) else 0)
+            ingest_dense1 = (ec.build_ingest_dense(self._spec, C, dense_runs)
+                            if dense_runs else None)
             query1 = ec.build_query(self._spec, C, A)
             gc1 = ec.build_gc(self._spec, C, A)
             # sharding note: the state is device_put with
@@ -130,9 +138,19 @@ class KeyedTpuWindowOperator:
                 jax.jit(jax.vmap(query1, in_axes=(0, None, None, None, None))),
                 jax.jit(jax.vmap(gc1, in_axes=(0, None))),
                 jax.jit(jax.vmap(merge1)),
+                # in-order rounds skip the late/annex scatter sets — int64
+                # scatters are the dominant ingest cost on TPU
+                jax.jit(jax.vmap(ingest_io1)),
+                (jax.jit(jax.vmap(ingest_dense1))
+                 if ingest_dense1 is not None else None),
+                dense_runs,
             )
             _KERNEL_CACHE[key] = hit
-        self._ingest, self._query, self._gc, self._merge = hit
+        (self._ingest, self._query, self._gc, self._merge,
+         self._ingest_inorder, self._ingest_dense, self._dense_runs) = hit
+        from ..engine.operator import min_grid_period
+
+        self._min_grid = min_grid_period(self._spec)
         self._host_met = None
         self._annex_dirty = False
 
@@ -174,11 +192,16 @@ class KeyedTpuWindowOperator:
         self._pend, self._n_pending = [], 0
 
         # stable partition by key, then ts-sort within key
+        has_late = False
+        flush_span = int(t.max()) - int(t.min()) if t.size else 0
         if t.size:
             if self._host_met is not None and int(t.min()) < self._host_met:
                 # a late tuple may open an annex slice on some shard → merge
-                # before the next query
+                # before the next query. (Global in-order implies per-key
+                # in-order: each key's row is a subsequence of the sorted
+                # stream, and per-key max event time <= the global one.)
                 self._annex_dirty = True
+                has_late = True
             mx = int(t.max())
             self._host_met = mx if self._host_met is None \
                 else max(self._host_met, mx)
@@ -218,7 +241,35 @@ class KeyedTpuWindowOperator:
                            np.maximum(row_n - 1, 0)]
             pad = ~valid_b & (row_n > 0)[:, None]
             ts_b = np.where(pad, last_ts[:, None], ts_b)
-            self._state = self._ingest(self._state, ts_b, vals_b, valid_b)
+            if has_late:
+                kern = self._ingest
+            else:
+                kern = self._ingest_inorder
+                if self._ingest_dense is not None:
+                    span_runs = flush_span // self._min_grid + 3
+                    if span_runs <= self._dense_runs:
+                        kern = self._ingest_dense
+            self._state = kern(self._state, ts_b, vals_b, valid_b)
+
+    def ingest_device_round(self, ts, vals, valid, ts_min: int,
+                            ts_max: int) -> None:
+        """Zero-copy ingest of one device-resident [K, B] round (row k =
+        key k's tuples, ts ascending within each row, all >= the stream's
+        max event time). ``ts_min``/``ts_max`` are host-known bounds that
+        keep the host clocks exact without a device sync — the keyed
+        analogue of TpuWindowOperator.ingest_device_batch (host→device
+        bandwidth must never cap the measured operator throughput)."""
+        if not self._built:
+            self._build()
+        if self._host_met is not None and ts_min < self._host_met:
+            raise ValueError("device rounds must be in-order")
+        self._host_met = ts_max if self._host_met is None \
+            else max(self._host_met, ts_max)
+        kern = self._ingest_inorder
+        if self._ingest_dense is not None:
+            if (ts_max - ts_min) // self._min_grid + 3 <= self._dense_runs:
+                kern = self._ingest_dense
+        self._state = kern(self._state, ts, vals, valid)
 
     # -- watermark ---------------------------------------------------------
     def process_watermark_arrays(self, watermark_ts: int):
