@@ -217,6 +217,12 @@ def make_device_source(cfg: BenchmarkConfig):
     bounds the measured operator throughput, exactly as the reference's
     generator never crosses a process boundary.
 
+    With ``cfg.out_of_order_pct > 0``, that fraction of tuples is displaced
+    back by up to ``cfg.max_lateness`` ms and the batch re-sorted on device
+    (the engine's ingest contract wants ts-ascending batches with late
+    tuples forming the prefix relative to the stream's max event time —
+    exactly what sorting produces).
+
     Returns ``gen(i) -> (vals_dev, ts_dev, ts_min, ts_max)`` for batch i.
     """
     from .. import jax_config  # noqa: F401  (x64 before tracing)
@@ -227,6 +233,8 @@ def make_device_source(cfg: BenchmarkConfig):
     n_total = cfg.throughput * cfg.runtime_s
     n_batches = max(1, n_total // B)
     span_ms = max(1, cfg.runtime_s * 1000 // n_batches)
+    ooo = float(cfg.out_of_order_pct)
+    lateness = int(cfg.max_lateness)
 
     @jax.jit
     def _gen(key, lo):
@@ -235,13 +243,23 @@ def make_device_source(cfg: BenchmarkConfig):
         ts = lo + jnp.cumsum(gaps).astype(jnp.int64)
         ts = jnp.minimum(ts, lo + span_ms - 1)
         vals = jax.random.uniform(key, (B,), dtype=jnp.float32) * 10_000
+        if ooo > 0:
+            k1, k2 = jax.random.split(jax.random.fold_in(key, 7))
+            late = jax.random.uniform(k1, (B,)) < ooo
+            disp = jax.random.randint(k2, (B,), 0, max(1, lateness),
+                                      dtype=jnp.int64)
+            ts = jnp.maximum(jnp.where(late, ts - disp, ts), 0)
+            order = jnp.argsort(ts)
+            ts, vals = ts[order], vals[order]
         return vals, ts
 
     root = jax.random.PRNGKey(cfg.seed)
 
     def gen(i: int):
-        vals, ts = _gen(jax.random.fold_in(root, i), np.int64(i * span_ms))
-        return vals, ts, i * span_ms, (i + 1) * span_ms - 1
+        lo = np.int64(i * span_ms)
+        vals, ts = _gen(jax.random.fold_in(root, i), lo)
+        ts_min = max(0, int(lo) - lateness) if ooo > 0 else int(lo)
+        return vals, ts, ts_min, (i + 1) * span_ms - 1
 
     gen.n_batches = n_batches
     gen.span_ms = span_ms
@@ -305,8 +323,14 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     import jax
 
     windows = parse_window_spec(window_spec, seed=cfg.seed)
-    device_source = (engine == "TpuEngine" and cfg.out_of_order_pct == 0
-                     and not cfg.session_config)
+    # out-of-order streams can use the device source too (on-device
+    # displacement + re-sort) — except for count/session windows, whose
+    # OOO handling is host-only
+    _host_only_ooo = any(
+        w.measure == WindowMeasure.Count or isinstance(w, SessionWindow)
+        for w in windows)
+    device_source = (engine == "TpuEngine" and not cfg.session_config
+                     and (cfg.out_of_order_pct == 0 or not _host_only_ooo))
     if device_source:
         gen = make_device_source(cfg)
         batches = None

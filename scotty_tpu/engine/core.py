@@ -106,6 +106,26 @@ class EngineSpec:
             and not self.count_periods
 
 
+def collapse_periods(periods) -> tuple:
+    """Many-window grids: slicing on the union of N period grids costs N
+    int64 mods per tuple (emulated int64 makes this the per-tuple hot cost
+    at e.g. 1000 random tumbling windows). The GCD grid is a SUPERSET of
+    every period grid — every window edge is a multiple of its period,
+    hence of the gcd — so slicing on it alone is exactly as correct (finer
+    slices, same range-query answers). Collapse when the period count is
+    large; keep the union for few windows (their union grid is sparser
+    than the gcd's, fewer slices)."""
+    import math
+
+    ps = tuple(sorted(set(int(p) for p in periods)))
+    if len(ps) <= 32:
+        return ps
+    g = 0
+    for p in ps:
+        g = math.gcd(g, p)
+    return (max(1, g),)
+
+
 def grid_start(spec: EngineSpec, ts: jnp.ndarray) -> jnp.ndarray:
     """Latest union-grid point ≤ ts (vectorized; [B] -> [B]).
 

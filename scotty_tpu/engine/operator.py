@@ -278,7 +278,7 @@ class TpuWindowOperator(WindowOperator):
             elif isinstance(w, FixedBandWindow):
                 bands.append((int(w.start), int(w.size)))
         return ec.EngineSpec(
-            periods=tuple(sorted(set(periods))),
+            periods=ec.collapse_periods(periods),
             bands=tuple(sorted(set(bands))),
             count_periods=tuple(sorted(set(count_periods))),
             aggs=tuple(a.device_spec() for a in self.aggregations),
@@ -410,9 +410,10 @@ class TpuWindowOperator(WindowOperator):
     def ingest_device_batch(self, vals, ts, ts_min: int, ts_max: int,
                             n_valid: Optional[int] = None) -> None:
         """Zero-copy ingest of device-resident arrays (shape [batch_size],
-        ts ascending and ≥ the stream's max event time). ``ts_min``/``ts_max``
-        are the host-known event-time bounds of the batch (they keep the host
-        clock mirrors exact without a device sync). This is the path for
+        ts ascending — late tuples allowed as the sorted prefix, within
+        ``max_lateness``). ``ts_min``/``ts_max`` are host-known event-time
+        bounds of the batch (they keep the host clock mirrors exact without
+        a device sync; conservative bounds are fine). This is the path for
         device-side sources — host→device bandwidth never caps throughput."""
         if not self._built:
             self._build()
@@ -422,16 +423,23 @@ class TpuWindowOperator(WindowOperator):
 
             self._valid_dev = jax.device_put(np.ones((B,), bool))
         n = B if n_valid is None else n_valid
-        if self._host_met is not None and ts_min < self._host_met:
-            raise ValueError("device batches must be in-order")
+        has_late = self._host_met is not None and ts_min < self._host_met
+        if has_late:
+            if self._has_count or self._is_session:
+                raise UnsupportedOnDevice(
+                    "out-of-order device batches with count-measure or "
+                    "session windows need the host operator")
+            self._annex_dirty = True
         self._host_met = ts_max if self._host_met is None \
             else max(self._host_met, ts_max)
         self._host_min_ts = ts_min if self._host_min_ts is None \
             else min(self._host_min_ts, ts_min)
         self._host_count += n
-        # contract: device batches are in-order → late-free kernel (dense
-        # scatter-free variant when the span bound allows)
-        kern = self._pick_inorder_kernel(ts_min, ts_max)
+        if has_late:
+            kern = self._ingest         # general kernel: late/annex paths
+        else:
+            # dense scatter-free variant when the span bound allows
+            kern = self._pick_inorder_kernel(ts_min, ts_max)
         self._state = kern(self._state, ts, vals, self._valid_dev)
 
     # -- watermark ---------------------------------------------------------

@@ -239,7 +239,7 @@ class StreamPipeline:
             else:
                 raise NotImplementedError(f"pipeline: {type(w).__name__}")
         spec = ec.EngineSpec(
-            periods=tuple(sorted(set(periods))),
+            periods=ec.collapse_periods(periods),
             bands=tuple(sorted(set(bands))),
             count_periods=(),
             aggs=tuple(a.device_spec() for a in self.aggregations),

@@ -104,7 +104,7 @@ class KeyedTpuWindowOperator:
             elif isinstance(w, FixedBandWindow):
                 bands.append((int(w.start), int(w.size)))
         self._spec = ec.EngineSpec(
-            periods=tuple(sorted(set(periods))),
+            periods=ec.collapse_periods(periods),
             bands=tuple(sorted(set(bands))),
             count_periods=(),
             aggs=tuple(a.device_spec() for a in self.aggregations),
