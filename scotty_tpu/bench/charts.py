@@ -1,0 +1,106 @@
+"""Benchmark chart generation — parity with the reference's README figures
+(charts/SlidingWindow.png, charts/ConcurrentTumblingWindows.png;
+README.md:47-58). Reads bench_results/result_*.json written by
+``python -m scotty_tpu.bench`` and writes charts/*.png.
+
+Run: ``python -m scotty_tpu.bench.charts``.
+
+Colors are the first two categorical slots of a validated palette (blue
+#2a78d6, orange #eb6834 — adjacent-pair CVD-safe per the palette's
+validation record); text wears ink tokens, series identity is carried by
+the legend + a direct label per line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+BLUE, ORANGE = "#2a78d6", "#eb6834"
+INK, MUTED, GRID = "#1a1a19", "#6b6a62", "#e5e4dc"
+
+
+def _style(ax, title, xlabel):
+    ax.set_title(title, color=INK, fontsize=11, loc="left", pad=12)
+    ax.set_xlabel(xlabel, color=MUTED, fontsize=9)
+    ax.set_ylabel("tuples / s (log)", color=MUTED, fontsize=9)
+    ax.set_yscale("log")
+    ax.grid(True, axis="y", color=GRID, linewidth=0.8)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=MUTED, labelsize=8)
+
+
+def _series(rows, engine):
+    return [r for r in rows if r.get("engine") == engine
+            and "error" not in r]
+
+
+def _draw(plt, path, title, xlabel, xticklabels, get):
+    fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=150)
+    x = list(range(len(xticklabels)))
+    for eng, color, name in [
+            ("TpuEngine", BLUE, "scotty_tpu (slicing)"),
+            ("Buckets", ORANGE, "bucket baseline (no sharing)")]:
+        y = get(eng)
+        ax.plot(x, y, color=color, linewidth=2, marker="o", markersize=5,
+                label=name)
+        ax.annotate(name, (x[0], y[0]), textcoords="offset points",
+                    xytext=(2, 10), ha="left", color=INK, fontsize=8.5)
+    ax.set_xticks(x, xticklabels)
+    _style(ax, title, xlabel)
+    ax.legend(frameon=False, fontsize=8, labelcolor=INK, loc="center right")
+    fig.tight_layout()
+    fig.savefig(path)
+
+
+def main(results_dir: str = "bench_results", out_dir: str = "charts") -> int:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(out_dir, exist_ok=True)
+
+    rows = json.load(open(os.path.join(results_dir,
+                                       "result_sliding-suite.json")))
+    slides = [60000, 10000, 1000, 500, 250, 100, 1]
+
+    def tps_sliding(eng):
+        out = []
+        for sl in slides:
+            m = [r for r in _series(rows, eng)
+                 if r["windows"] == f"Sliding(60000,{sl})"]
+            out.append(m[-1]["tuples_per_sec"] if m else None)
+        return out
+
+    _draw(plt, os.path.join(out_dir, "sliding_suite.png"),
+          "Sliding 60 s window, slide 60 s → 1 ms "
+          "(≤ 60k concurrent windows), v5e-1",
+          "slide",
+          ["60 s", "10 s", "1 s", "500 ms", "250 ms", "100 ms", "1 ms"],
+          tps_sliding)
+
+    rows2 = json.load(open(os.path.join(results_dir,
+                                        "result_random-tumbling.json")))
+    ns = [1, 10, 100, 1000]
+
+    def tps_tumbling(eng):
+        out = []
+        for n in ns:
+            m = [r for r in _series(rows2, eng)
+                 if r["windows"] == f"randomTumbling({n},1000,20000)"]
+            out.append(m[-1]["tuples_per_sec"] if m else None)
+        return out
+
+    _draw(plt, os.path.join(out_dir, "concurrent_tumbling.png"),
+          "Concurrent random tumbling windows (1 → 1000), v5e-1",
+          "# concurrent windows", [str(n) for n in ns], tps_tumbling)
+    print(f"-> {out_dir}/sliding_suite.png, {out_dir}/concurrent_tumbling.png")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
