@@ -257,14 +257,24 @@ def run_keyed_cell(cfg: BenchmarkConfig, window_spec: str,
 
     lats: list = []
     emitted = 0
+    pending = []
+    SAMPLE_EVERY = 4
     t0 = time.perf_counter()
     for i in range(1, cfg.runtime_s + 1):
         feed_interval(i)
-        t1 = time.perf_counter()
-        ws, we, cnt, lowered = op.process_watermark_arrays(
-            (i + 1) * cfg.watermark_period_ms)
-        lats.append((time.perf_counter() - t1) * 1e3)
+        sample = i % SAMPLE_EVERY == 0
+        if sample:                      # drained dispatch→host round trip
+            jax.device_get(op._state.n_slices[0])
+            t1 = time.perf_counter()
+        out = op.process_watermark_async((i + 1) * cfg.watermark_period_ms)
+        if sample:
+            jax.device_get((out[2], out[3]))
+            lats.append((time.perf_counter() - t1) * 1e3)
+        pending.append(out)
+    for out in pending:                 # bundled result drain
+        ws, we, cnt, lowered = op.lower_results(*out)
         emitted += int((cnt > 0).sum())
+    op.check_overflow()
     wall = time.perf_counter() - t0
     n_tuples = cfg.runtime_s * rounds_per_wm * tuples_per_round
     return BenchResult(

@@ -272,11 +272,12 @@ class KeyedTpuWindowOperator:
         self._state = kern(self._state, ts, vals, valid)
 
     # -- watermark ---------------------------------------------------------
-    def process_watermark_arrays(self, watermark_ts: int):
-        """Returns (window_starts[T], window_ends[T], counts[K, T],
-        lowered per agg [K, T]) — all keys answered by one device query,
-        mirroring the connectors' all-keys watermark loop
-        (flink-connector KeyedScottyWindowOperator.java:72-86)."""
+    def process_watermark_async(self, watermark_ts: int):
+        """Dispatch the full watermark program (trigger enumeration, query,
+        GC) with NO device→host sync: returns ``(ws[T], we[T], cnt_dev,
+        results_dev)`` where the device handles are [K, Tp]-padded. The
+        overflow check is deferred — async users call
+        :meth:`check_overflow` after a drain."""
         if not self._built:
             self._build()
         self._flush()
@@ -284,8 +285,6 @@ class KeyedTpuWindowOperator:
             self._state = self._merge(self._state)
             self._annex_dirty = False
         st = self._state
-        if bool(np.any(np.asarray(st.overflow))):
-            raise RuntimeError("slice buffer overflow on some key shard")
 
         last_wm = self._last_watermark
         if last_wm == -1:
@@ -301,8 +300,7 @@ class KeyedTpuWindowOperator:
         we = np.concatenate(trig_e) if trig_e else empty
         T = ws.shape[0]
 
-        cnt_np = np.zeros((self.n_keys, 0), np.int64)
-        lowered: List[np.ndarray] = []
+        cnt_d = results = None
         if T:
             Tp = self.config.trigger_pad(T)
             ws_p = np.zeros((Tp,), np.int64)
@@ -311,18 +309,44 @@ class KeyedTpuWindowOperator:
             ws_p[:T], we_p[:T], mask[:T] = ws, we, True
             cnt_d, results = self._query(st, ws_p, we_p, mask,
                                          np.zeros((Tp,), bool))
-            cnt_np = np.asarray(cnt_d)[:, :T]
-            for agg, res in zip(self.aggregations, results):
+
+        bound = (watermark_ts - self.max_lateness) - self.max_fixed_window_size
+        self._state = self._gc(st, np.int64(bound))
+        self._last_watermark = watermark_ts
+        return ws, we, cnt_d, results
+
+    def lower_results(self, ws, we, cnt_d, results):
+        """Fetch + lower one async watermark's handles: (ws, we,
+        counts[K, T], lowered per agg [K, T])."""
+        T = ws.shape[0]
+        cnt_np = np.zeros((self.n_keys, 0), np.int64)
+        lowered: List[np.ndarray] = []
+        if T:
+            import jax
+
+            cnt_h, res_h = jax.device_get((cnt_d, results))
+            cnt_np = np.asarray(cnt_h)[:, :T]
+            for agg, res in zip(self.aggregations, res_h):
                 spec = agg.device_spec()
                 r = np.asarray(res)[:, :T, :]          # [K, T, w]
                 flat = spec.lower(r.reshape(-1, r.shape[-1]),
                                   cnt_np.reshape(-1))
                 lowered.append(np.asarray(flat).reshape(self.n_keys, T))
-
-        bound = (watermark_ts - self.max_lateness) - self.max_fixed_window_size
-        self._state = self._gc(st, np.int64(bound))
-        self._last_watermark = watermark_ts
         return ws, we, cnt_np, lowered
+
+    def check_overflow(self) -> None:
+        if self._state is not None and bool(
+                np.any(np.asarray(self._state.overflow))):
+            raise RuntimeError("slice buffer overflow on some key shard")
+
+    def process_watermark_arrays(self, watermark_ts: int):
+        """Synchronous watermark: (window_starts[T], window_ends[T],
+        counts[K, T], lowered per agg [K, T]) — all keys answered by one
+        device query, mirroring the connectors' all-keys watermark loop
+        (flink-connector KeyedScottyWindowOperator.java:72-86)."""
+        out = self.lower_results(*self.process_watermark_async(watermark_ts))
+        self.check_overflow()
+        return out
 
     def process_watermark(self, watermark_ts: int):
         """Object results: list of (key, AggregateWindow), non-empty windows
