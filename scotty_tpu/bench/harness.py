@@ -217,13 +217,14 @@ def make_device_source(cfg: BenchmarkConfig):
     bounds the measured operator throughput, exactly as the reference's
     generator never crosses a process boundary.
 
-    With ``cfg.out_of_order_pct > 0``, that fraction of tuples is displaced
-    back by up to ``cfg.max_lateness`` ms and the batch re-sorted on device
-    (the engine's ingest contract wants ts-ascending batches with late
-    tuples forming the prefix relative to the stream's max event time —
-    exactly what sorting produces).
+    With ``cfg.out_of_order_pct > 0`` the generator emits an extra LATE
+    sub-batch per base batch (that fraction of tuples, displaced back by up
+    to ``cfg.max_lateness`` ms, sorted) — delivered separately so only the
+    small sub-batch pays the general kernel's late/annex machinery, while
+    the in-order base stream takes the dense fast path.
 
-    Returns ``gen(i) -> (vals_dev, ts_dev, ts_min, ts_max)`` for batch i.
+    Returns ``gen(i) -> (vals, ts, ts_min, ts_max)``; when OOO is enabled,
+    ``gen.gen_late(i) -> (vals, ts, valid, n, ts_min, ts_max)``.
     """
     from .. import jax_config  # noqa: F401  (x64 before tracing)
     import jax
@@ -235,6 +236,8 @@ def make_device_source(cfg: BenchmarkConfig):
     span_ms = max(1, cfg.runtime_s * 1000 // n_batches)
     ooo = float(cfg.out_of_order_pct)
     lateness = int(cfg.max_lateness)
+    n_late = int(B * ooo)
+    late_cap = max(64, 1 << (max(1, n_late) - 1).bit_length())
 
     @jax.jit
     def _gen(key, lo):
@@ -243,26 +246,41 @@ def make_device_source(cfg: BenchmarkConfig):
         ts = lo + jnp.cumsum(gaps).astype(jnp.int64)
         ts = jnp.minimum(ts, lo + span_ms - 1)
         vals = jax.random.uniform(key, (B,), dtype=jnp.float32) * 10_000
-        if ooo > 0:
-            k1, k2 = jax.random.split(jax.random.fold_in(key, 7))
-            late = jax.random.uniform(k1, (B,)) < ooo
-            disp = jax.random.randint(k2, (B,), 0, max(1, lateness),
-                                      dtype=jnp.int64)
-            ts = jnp.maximum(jnp.where(late, ts - disp, ts), 0)
-            order = jnp.argsort(ts)
-            ts, vals = ts[order], vals[order]
         return vals, ts
 
+    @jax.jit
+    def _gen_late(key, lo):
+        """n_late tuples in [max(0, lo - lateness), lo), sorted — tuples of
+        earlier event time arriving now."""
+        u = jax.random.uniform(key, (2, late_cap), dtype=jnp.float32)
+        lo_f = jnp.maximum(lo.astype(jnp.float64) - lateness, 0.0)
+        ts = (lo_f + jnp.sort(u[0]).astype(jnp.float64)
+              * (lo.astype(jnp.float64) - lo_f)).astype(jnp.int64)
+        return u[1] * 10_000.0, ts
+
     root = jax.random.PRNGKey(cfg.seed)
+    valid_late = None
 
     def gen(i: int):
         lo = np.int64(i * span_ms)
         vals, ts = _gen(jax.random.fold_in(root, i), lo)
-        ts_min = max(0, int(lo) - lateness) if ooo > 0 else int(lo)
-        return vals, ts, ts_min, (i + 1) * span_ms - 1
+        return vals, ts, int(lo), (i + 1) * span_ms - 1
+
+    def gen_late(i: int):
+        nonlocal valid_late
+        if valid_late is None:
+            v = np.zeros((late_cap,), bool)
+            v[:n_late] = True
+            valid_late = jax.device_put(v)
+        lo = np.int64(i * span_ms)
+        vals, ts = _gen_late(jax.random.fold_in(root, 1 << 20 | i), lo)
+        return (vals, ts, valid_late, n_late,
+                max(0, int(lo) - lateness), int(lo))
 
     gen.n_batches = n_batches
     gen.span_ms = span_ms
+    gen.gen_late = gen_late if (ooo > 0 and n_late > 0) else None
+    gen.n_late = n_late
     return gen
 
 
@@ -377,6 +395,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             for i in range(warmup_batches):
                 vals, ts, lo, hi = gen(i)
                 twin.ingest_device_batch(vals, ts, lo, hi)
+                if gen.gen_late is not None and i > 0:
+                    twin.ingest_device_late(*gen.gen_late(i))
                 last = hi
             twin.process_watermark_async(last + 1)
             twin.process_watermark_async(last + cfg.watermark_period_ms + 1)
@@ -435,6 +455,10 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             vals, ts, lo, hi = gen(i)
             op.ingest_device_batch(vals, ts, lo, hi)
             n_tuples += cfg.batch_size
+            if gen.gen_late is not None and i > 0:
+                lv, lt, lvalid, n, lmin, lmax = gen.gen_late(i)
+                op.ingest_device_late(lt, lv, lvalid, n, lmin, lmax)
+                n_tuples += n
             while hi >= next_wm:
                 advance_watermark(next_wm)
                 next_wm += cfg.watermark_period_ms

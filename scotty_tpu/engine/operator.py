@@ -365,8 +365,9 @@ class TpuWindowOperator(WindowOperator):
                 raise UnsupportedOnDevice(
                     "out-of-order tuples with count-measure or session "
                     "windows need the host operator")
-        has_late = (take > 0 and self._host_met is not None
-                    and int(batch_t[0]) < self._host_met)
+        met_pre = self._host_met            # max event time BEFORE this batch
+        has_late = (take > 0 and met_pre is not None
+                    and int(batch_t[0]) < met_pre)
         if take:
             if has_late:
                 # late tuples may open annex slices → merge before next query
@@ -386,7 +387,44 @@ class TpuWindowOperator(WindowOperator):
             batch_v = np.concatenate(
                 [batch_v, np.zeros((B - take,), np.float32)])
             valid[take:] = False
-        kern = self._ingest if has_late else self._pick_inorder_kernel(
+        if has_late:
+            # Split the sorted batch at the lateness boundary: the late
+            # prefix is usually a small fraction, but the combined general
+            # kernel pays its full-lane scatter sets (in-order + late +
+            # annex) for EVERY lane. Ingest the in-order tail through the
+            # cheap kernels and only the late prefix through the general
+            # kernel on a B/8 sub-batch — same semantics (the combined
+            # kernel also folds late tuples against the already-updated
+            # slice buffer). Falls back to one combined dispatch when the
+            # late prefix exceeds the sub-batch.
+            n_late = int(np.searchsorted(batch_t[:take], met_pre))
+            late_cap = max(64, B // 8)
+            if 0 < n_late <= late_cap and n_late < take:
+                io_t = np.empty_like(batch_t)
+                io_v = np.empty_like(batch_v)
+                n_io = take - n_late
+                io_t[:n_io] = batch_t[n_late:take]
+                io_v[:n_io] = batch_v[n_late:take]
+                io_t[n_io:] = io_t[n_io - 1]
+                io_v[n_io:] = 0
+                io_valid = np.zeros((B,), bool)
+                io_valid[:n_io] = True
+                kern = self._pick_inorder_kernel(int(io_t[0]),
+                                                 int(io_t[n_io - 1]))
+                self._state = kern(self._state, io_t, io_v, io_valid)
+
+                lt = np.empty((late_cap,), np.int64)
+                lv = np.zeros((late_cap,), np.float32)
+                lt[:n_late] = batch_t[:n_late]
+                lv[:n_late] = batch_v[:n_late]
+                lt[n_late:] = lt[n_late - 1]
+                l_valid = np.zeros((late_cap,), bool)
+                l_valid[:n_late] = True
+                self._state = self._ingest(self._state, lt, lv, l_valid)
+                return
+            self._state = self._ingest(self._state, batch_t, batch_v, valid)
+            return
+        kern = self._pick_inorder_kernel(
             int(batch_t[0]) if take else 0,
             int(batch_t[take - 1]) if take else 0)
         self._state = kern(self._state, batch_t, batch_v, valid)
@@ -441,6 +479,28 @@ class TpuWindowOperator(WindowOperator):
             # dense scatter-free variant when the span bound allows
             kern = self._pick_inorder_kernel(ts_min, ts_max)
         self._state = kern(self._state, ts, vals, self._valid_dev)
+
+    def ingest_device_late(self, ts, vals, valid, n: int, ts_min: int,
+                           ts_max: int) -> None:
+        """Zero-copy ingest of a device-resident LATE sub-batch (ts sorted,
+        all within ``max_lateness``; shape is the caller's static late
+        capacity — typically a small fraction of batch_size, so the general
+        kernel's full-lane late/annex scatters stay cheap). Companion to
+        :meth:`ingest_device_batch` for device sources that separate their
+        disorder from the in-order base stream."""
+        if not self._built:
+            self._build()
+        if self._has_count or self._is_session:
+            raise UnsupportedOnDevice(
+                "out-of-order device batches with count-measure or session "
+                "windows need the host operator")
+        self._annex_dirty = True
+        self._host_met = ts_max if self._host_met is None \
+            else max(self._host_met, ts_max)
+        self._host_min_ts = ts_min if self._host_min_ts is None \
+            else min(self._host_min_ts, ts_min)
+        self._host_count += n
+        self._state = self._ingest(self._state, ts, vals, valid)
 
     # -- watermark ---------------------------------------------------------
     def process_watermark(self, watermark_ts: int) -> List[AggregateWindow]:
