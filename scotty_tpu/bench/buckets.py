@@ -124,11 +124,15 @@ class BucketWindowPipeline:
                 ring_vals, vals, (slot.astype(jnp.int32),))
             return ring_ts, ring_vals
 
+        first_lw = max(0, P - 1000)        # first-watermark lateness clamp
+                                           # (reference default 1000 ms)
+
         def step(ring_ts, ring_vals, key, interval_idx):
             base = interval_idx * P
             ring_ts, ring_vals = gen_and_write(ring_ts, ring_vals, key,
                                                interval_idx)
-            ws, we, tmask = make_triggers(base, base + P)
+            last_wm = jnp.where(interval_idx > 0, base, jnp.int64(first_lw))
+            ws, we, tmask = make_triggers(last_wm, base + P)
             Tn = ws.shape[0]
 
             def body(carry, c):

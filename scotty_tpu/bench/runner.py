@@ -149,7 +149,11 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             except NotImplementedError:
                 pass
         # out-of-order / count-measure / session specs: batch-at-a-time
-        # device operator (annex path), via the classic harness
+        # device operator via the classic harness (device-generated streams
+        # with split late sub-batches). A fused OOO StreamPipeline exists
+        # (out_of_order_pct ctor arg, differential-tested) but measured no
+        # faster than the split batch path at a much larger compile, so the
+        # runner doesn't default to it.
         return run_benchmark(cfg, window_spec, agg_name, engine="TpuEngine")
 
     if engine == "Buckets":

@@ -204,7 +204,7 @@ class StreamPipeline:
                  config: Optional[EngineConfig] = None,
                  throughput: int = 50_000_000, wm_period_ms: int = 1000,
                  max_lateness: int = 1000, seed: int = 0,
-                 sub_batch: int = 1 << 18):
+                 sub_batch: int = 1 << 18, out_of_order_pct: float = 0.0):
         import jax
         import jax.numpy as jnp
 
@@ -216,12 +216,24 @@ class StreamPipeline:
         self.max_lateness = max_lateness
         self.wm_period_ms = wm_period_ms
         self.seed = seed
+        self.out_of_order_pct = float(out_of_order_pct)
 
         B = sub_batch
         tuples_per_interval = throughput * wm_period_ms // 1000
         G = max(1, tuples_per_interval // B)
-        self.G, self.B = G, B
-        self.tuples_per_interval = G * B
+        # disorder: each sub-batch is followed by a small sorted LATE batch
+        # (tuples displaced back by < max_lateness) — the in-order base
+        # takes the cheap kernel, only the late lanes pay the general
+        # kernel's late/annex machinery, and the annex folds back once per
+        # interval before the query. No sort anywhere: both parts are
+        # sorted by construction.
+        B_late = 0
+        if self.out_of_order_pct > 0:
+            n = int(B * self.out_of_order_pct)
+            B_late = max(64, 1 << max(0, (n - 1).bit_length()))
+        self.G, self.B, self.B_late = G, B, B_late
+        self.tuples_per_interval = G * (B + (int(B * self.out_of_order_pct)
+                                             if B_late else 0))
         span = wm_period_ms / G            # event-ms per sub-batch
 
         periods, bands = [], []
@@ -247,6 +259,8 @@ class StreamPipeline:
         self.spec = spec
         C, A = self.config.capacity, self.config.annex_capacity
         ingest = ec.build_ingest(spec, C, A, assume_inorder=True)
+        ingest_general = ec.build_ingest(spec, C, A) if B_late else None
+        annex_merge = ec.build_annex_merge(spec, C, A) if B_late else None
         query = ec.build_query(spec, C, A)
         gc = ec.build_gc(spec, C, A)
         self._init_state = lambda: ec.init_state(spec, C, A)
@@ -254,23 +268,48 @@ class StreamPipeline:
         # ---- static trigger grid per window ------------------------------
         make_triggers, self.T = build_trigger_grid(self.windows, wm_period_ms)
         P = wm_period_ms
+        ooo = self.out_of_order_pct
+        n_late = int(B * ooo)
 
         valid_all = np.ones((B,), bool)
+        valid_late = np.zeros((B_late,), bool)
+        valid_late[:n_late] = True
+
+        # the reference's FIRST watermark clamps its trigger range to
+        # wm - maxLateness (WindowManager.java:43-45, floored at the
+        # bootstrap slice start 0); later watermarks continue from the
+        # previous one. Latent until max_lateness < wm_period.
+        first_lw = max(0, P - max_lateness)
 
         def step(state, key, interval_idx):
-            last_wm = interval_idx * P
-            wm = last_wm + P
+            base = interval_idx * P
+            last_wm = jnp.where(interval_idx > 0, base,
+                                jnp.int64(first_lw))
+            wm = base + P
 
             def body(st, g):
                 kg = jax.random.fold_in(key, g)
-                lo = (last_wm + g * span).astype(jnp.float64)
+                lo = (base + g * span).astype(jnp.float64)
                 gaps = jax.random.uniform(kg, (B,), dtype=jnp.float32)
                 gaps = gaps / jnp.sum(gaps) * span
                 ts = lo.astype(jnp.int64) + jnp.cumsum(gaps).astype(jnp.int64)
                 vals = jax.random.uniform(kg, (B,), dtype=jnp.float32) * 10_000
-                return ingest(st, ts, vals, valid_all), None
+                st = ingest(st, ts, vals, valid_all)
+                if B_late:
+                    kl = jax.random.fold_in(kg, 7)
+                    u = jax.random.uniform(kl, (2, B_late),
+                                           dtype=jnp.float32)
+                    lo_l = jnp.maximum(lo - max_lateness, 0.0)
+                    lts = (lo_l + jnp.sort(u[0]).astype(jnp.float64)
+                           * (lo - lo_l)).astype(jnp.int64)
+                    lvals = u[1] * 10_000.0
+                    st = ingest_general(st, lts, lvals,
+                                        jnp.asarray(valid_late))
+                return st, None
 
             state, _ = jax.lax.scan(body, state, jnp.arange(G))
+            if B_late:
+                state = annex_merge(state)
             ws, we, tmask = make_triggers(last_wm, wm)
             is_count = jnp.zeros_like(tmask)
             cnt, results = query(state, ws, we, tmask, is_count)
@@ -457,6 +496,9 @@ class AlignedStreamPipeline:
             u = jax.random.uniform(kg, (2, d, R), dtype=jnp.float32)
             return u[0] * value_scale, u[1]        # vals [d,R], offs [d,R]
 
+        first_lw = max(0, P - max_lateness)   # first-watermark clamp
+                                              # (WindowManager.java:43-45)
+
         def step(state, key, interval_idx):
             base = interval_idx * P
 
@@ -517,7 +559,9 @@ class AlignedStreamPipeline:
                 current_count=state.current_count + S * R,
                 overflow=state.overflow | (n + S > C),
             )
-            ws, we, tmask = make_triggers(base, base + P)
+            last_wm = jnp.where(interval_idx > 0, base,
+                                jnp.int64(first_lw))
+            ws, we, tmask = make_triggers(last_wm, base + P)
             cnt, results = query(state, ws, we, tmask,
                                  jnp.zeros_like(tmask))
             return state, (ws, we, cnt, results)

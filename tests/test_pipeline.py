@@ -151,3 +151,68 @@ def test_aligned_gc_preserves_results():
                 # prefix sums re-associate after the GC roll → f32 rounding
                 assert float(a) == pytest.approx(float(b), rel=1e-5)
     p.check_overflow()
+
+
+def test_stream_pipeline_out_of_order_matches_simulator():
+    """The fused OOO pipeline (in-order base + sorted late sub-batch per
+    scan step, annex merged per interval) must emit the same windows as the
+    simulator fed the identical regenerated stream in the same order."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scotty_tpu import (SlicingWindowOperator, SumAggregation,
+                            TumblingWindow, WindowMeasure)
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.pipeline import StreamPipeline
+
+    Time = WindowMeasure.Time
+    P, LAT = 100, 50
+    p = StreamPipeline(
+        [TumblingWindow(Time, 20)], [SumAggregation()],
+        config=EngineConfig(capacity=1 << 10, annex_capacity=256,
+                            min_trigger_pad=32),
+        throughput=2000 * 1000 // P, wm_period_ms=P, max_lateness=LAT,
+        seed=3, sub_batch=256, out_of_order_pct=0.1)
+    assert p.B_late > 0
+    p.reset()
+    outs = p.run(5, collect=True)
+
+    # regenerate the exact device stream on host (same fold_in tree)
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(TumblingWindow(Time, 20))
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(LAT)
+    root = jax.random.PRNGKey(3)
+    B, BL, G = p.B, p.B_late, p.G
+    n_late = int(B * p.out_of_order_pct)
+    span = P / G
+    for i in range(5):
+        key = jax.random.fold_in(root, i)
+        for g in range(G):
+            kg = jax.random.fold_in(key, jnp.int64(g))
+            lo = np.float64(i * P + g * span)
+            gaps = np.asarray(jax.random.uniform(kg, (B,), dtype=jnp.float32))
+            gaps = gaps / gaps.sum() * span
+            ts = (np.int64(lo) + np.cumsum(gaps).astype(np.int64))
+            vals = np.asarray(jax.random.uniform(kg, (B,),
+                                                 dtype=jnp.float32)) * 10_000
+            sim.process_elements(vals, ts)
+            kl = jax.random.fold_in(kg, 7)
+            u = np.asarray(jax.random.uniform(kl, (2, BL),
+                                              dtype=jnp.float32))
+            lo_l = max(lo - LAT, 0.0)
+            lts = (lo_l + np.sort(u[0]).astype(np.float64)
+                   * (lo - lo_l)).astype(np.int64)
+            sim.process_elements(u[1][:n_late] * 10_000.0, lts[:n_late])
+        want = sim.process_watermark((i + 1) * P)
+        got = p.lowered_results(outs[i])
+        want_rows = [(w.get_start(), w.get_end(),
+                      float(w.get_agg_values()[0]))
+                     for w in want if w.has_value()]
+        got_rows = [(s, e, float(v[0])) for s, e, c, v in got]
+        assert len(want_rows) == len(got_rows), (i, want_rows, got_rows)
+        for (s1, e1, v1), (s2, e2, v2) in zip(want_rows, got_rows):
+            assert (s1, e1) == (s2, e2), i
+            assert v1 == pytest.approx(v2, rel=1e-4), (i, s1, e1)
+    p.check_overflow()
