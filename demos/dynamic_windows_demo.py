@@ -1,0 +1,56 @@
+"""Dynamic window registration mid-stream, on the device engine.
+
+The reference supports adding window assigners while the stream is running
+(TumblingWindowOperatorTest.java:96-145); here the engine rebuilds its
+kernels around the new union grid at the registration call while the slice
+buffer carries over untouched. Windows of the new assigner that straddle
+pre-addition (coarser) slices follow the reference's t_last containment.
+
+Run: PYTHONPATH=. python demos/dynamic_windows_demo.py
+"""
+
+import numpy as np
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+
+Time = WindowMeasure.Time
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=1 << 12, batch_size=256, annex_capacity=64,
+        min_trigger_pad=32))
+    op.add_window_assigner(TumblingWindow(Time, 1000))
+    op.add_aggregation(SumAggregation())
+    # span the whole demo stream, or the FIRST watermark's lateness clamp
+    # (WindowManager.java:43-45) drops the leading windows
+    op.set_max_lateness(10_000)
+
+    def feed(lo, hi, n=2048):
+        ts = np.sort(rng.integers(lo, hi, size=n)).astype(np.int64)
+        vals = np.ones(n, np.float32)
+        op.process_elements(vals, ts)
+
+    feed(0, 4000)
+    print("watermark 4000 (only the 1 s tumbling window registered):")
+    for w in op.process_watermark(4000):
+        if w.has_value():
+            print(f"  [{w.get_start():5d}, {w.get_end():5d})  "
+                  f"count={w.get_agg_values()[0]:.0f}")
+
+    print("\n-- registering a 250 ms tumbling window mid-stream --\n")
+    op.add_window_assigner(TumblingWindow(Time, 250))
+    feed(4000, 6000)
+    print("watermark 6000 (both windows; the fine one starts emitting "
+          "from its registration point):")
+    for w in op.process_watermark(6000):
+        if w.has_value():
+            size = w.get_end() - w.get_start()
+            print(f"  [{w.get_start():5d}, {w.get_end():5d}) {size:4d}ms  "
+                  f"count={w.get_agg_values()[0]:.0f}")
+
+
+if __name__ == "__main__":
+    main()

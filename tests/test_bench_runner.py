@@ -155,3 +155,32 @@ def test_band_spec_runs_through_fused_stream_pipeline():
                    "TpuEngine")
     assert res.n_windows_emitted > 0
     assert res.tuples_per_sec > 0
+
+
+def test_charts_render_from_results(tmp_path):
+    """Chart generation consumes the runner's JSON schema and writes both
+    figures (charts/*.png parity with the reference README figures)."""
+    import json
+
+    matplotlib = pytest.importorskip("matplotlib")  # noqa: F841
+    from scotty_tpu.bench.charts import main as charts_main
+
+    res = tmp_path / "results"
+    res.mkdir()
+    sliding = []
+    for sl in (60000, 10000, 1000, 500, 250, 100, 1):
+        for eng, tps in (("TpuEngine", 4e9), ("Buckets", 5e5)):
+            sliding.append({"windows": f"Sliding(60000,{sl})",
+                            "engine": eng, "tuples_per_sec": tps})
+    (res / "result_sliding-suite.json").write_text(json.dumps(sliding))
+    tumbling = []
+    for n in (1, 10, 100, 1000):
+        for eng, tps in (("TpuEngine", 4e9), ("Buckets", 2e6)):
+            tumbling.append({"windows": f"randomTumbling({n},1000,20000)",
+                             "engine": eng, "tuples_per_sec": tps})
+    (res / "result_random-tumbling.json").write_text(json.dumps(tumbling))
+
+    out = tmp_path / "charts"
+    charts_main(results_dir=str(res), out_dir=str(out))
+    assert (out / "sliding_suite.png").stat().st_size > 10_000
+    assert (out / "concurrent_tumbling.png").stat().st_size > 10_000
