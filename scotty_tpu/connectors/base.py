@@ -101,6 +101,8 @@ class KeyedScottyWindowOperator:
         self.n_key_shards = n_key_shards
         self.engine_config = engine_config
         self._host_ops: Dict[Hashable, Any] = {}
+        self._key_lanes: Dict[Hashable, int] = {}
+        self._lane_keys: List[Hashable] = []
         self._device_op = None
 
     # -- builder API (README.md:31-42 chaining) ----------------------------
@@ -145,13 +147,28 @@ class KeyedScottyWindowOperator:
             self._device_op.set_max_lateness(self.allowed_lateness)
         return self._device_op
 
+    def _lane_for_key(self, key: Hashable) -> int:
+        """Exact key→lane assignment. Hashing keys onto lanes would MERGE
+        colliding keys' windows (the reference keeps one operator per
+        distinct key — KeyedScottyWindowOperator.java:56-61); lanes are
+        assigned first-come instead, and running out is an explicit error."""
+        lane = self._key_lanes.get(key)
+        if lane is None:
+            if len(self._key_lanes) >= self.n_key_shards:
+                raise RuntimeError(
+                    f"more than n_key_shards={self.n_key_shards} distinct "
+                    "keys on the device backend; raise n_key_shards")
+            lane = len(self._key_lanes)
+            self._key_lanes[key] = lane
+            self._lane_keys.append(key)
+        return lane
+
     def process_element(self, key: Hashable, value: Any, ts: int
                         ) -> List[Tuple[Hashable, AggregateWindow]]:
         """Feed one tuple; returns window results if this tuple's ts advanced
         the watermark (the connector emit path)."""
         if self.backend == "device":
-            shard = hash(key) % self.n_key_shards
-            self._device().process_element(shard, value, ts)
+            self._device().process_element(self._lane_for_key(key), value, ts)
         else:
             self._op_for_key(key).process_element(value, ts)
         wm = self.policy.observe(ts)
@@ -163,7 +180,9 @@ class KeyedScottyWindowOperator:
         out: List[Tuple[Hashable, AggregateWindow]] = []
         if self.backend == "device":
             if self._device_op is not None:
-                out.extend(self._device().process_watermark(wm))
+                for lane, w in self._device().process_watermark(wm):
+                    out.append((self._lane_keys[lane]
+                                if lane < len(self._lane_keys) else lane, w))
         else:
             for key, op in self._host_ops.items():
                 for w in op.process_watermark(wm):

@@ -106,8 +106,7 @@ class KeyedScottyWindowOperator(_KeyedBase):
         ``(key, start, end, values)`` rows."""
         core = self._ensure_core()
         if core.backend == "device":
-            shard = hash(key) % core.n_key_shards
-            core._device().process_element(shard, value, ts)
+            core._device().process_element(core._lane_for_key(key), value, ts)
         else:
             core._op_for_key(key).process_element(value, ts)
         wm = self._policy.observe_with_engine(ts, current_watermark)

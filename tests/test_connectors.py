@@ -252,3 +252,63 @@ def test_flink_global_adapter():
     op.process_record(2, 5)
     rows = op.process_record(3, 15)
     assert rows == [(0, 10, (3,))]
+
+
+def test_keyed_connector_device_backend():
+    """backend="device" routes the connector through the batched
+    KeyedTpuWindowOperator (keys hashed onto shard lanes); same windows as
+    the host backend for a keyed stream."""
+    from scotty_tpu.engine import EngineConfig
+
+    src = [("a", 1, 1), ("b", 10, 2), ("a", 2, 5), ("b", 20, 7),
+           ("a", 3, 12), ("b", 30, 15), ("a", 4, 21), ("b", 40, 25),
+           ("a", 5, 33), ("b", 50, 41)]
+
+    def run(backend):
+        op = KeyedScottyWindowOperator(
+            backend=backend, n_key_shards=8,
+            engine_config=EngineConfig(capacity=512, batch_size=16,
+                                       annex_capacity=64,
+                                       min_trigger_pad=32))
+        op.add_window(TumblingWindow(Time, 10))
+        op.add_aggregation(SumAggregation())
+        op.with_allowed_lateness(100)
+        got = []
+        for k, v, t in src:
+            got.extend(op.process_element(k, v, t))
+        got.extend(op.process_watermark(100))
+        return got
+
+    host = run("host")
+    dev = run("device")
+    # device results are keyed by shard id, host by original key — compare
+    # the multiset of (start, end, value) windows
+    h = sorted((w.get_start(), w.get_end(), float(w.get_agg_values()[0]))
+               for _, w in host)
+    d = sorted((w.get_start(), w.get_end(), float(w.get_agg_values()[0]))
+               for _, w in dev)
+    assert h == d, (h, d)
+
+
+def test_keyed_connector_device_backend_preserves_keys():
+    """Distinct keys get distinct device lanes (hashing would merge
+    colliding keys' windows) and results come back under the ORIGINAL key;
+    exceeding n_key_shards distinct keys is an explicit error."""
+    from scotty_tpu.engine import EngineConfig
+
+    op = KeyedScottyWindowOperator(
+        backend="device", n_key_shards=2,
+        engine_config=EngineConfig(capacity=512, batch_size=8,
+                                   annex_capacity=64, min_trigger_pad=32))
+    op.add_window(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.with_allowed_lateness(100)
+    for k, v, t in [("x", 1, 1), ("y", 10, 2), ("x", 2, 5), ("y", 20, 8)]:
+        op.process_element(k, v, t)
+    got = op.process_watermark(50)
+    by_key = {k: float(w.get_agg_values()[0]) for k, w in got
+              if w.has_value()}
+    assert by_key == {"x": 3.0, "y": 30.0}
+
+    with pytest.raises(RuntimeError, match="n_key_shards"):
+        op.process_element("z", 1, 9)
