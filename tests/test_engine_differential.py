@@ -559,3 +559,61 @@ def test_device_resident_ooo_batches_match_oracle():
             compare(sim.process_watermark(lo), eng.process_watermark(lo), lo)
     compare(sim.process_watermark(lo + 500),
             eng.process_watermark(lo + 500), lo + 500)
+
+
+# ---------------------------------------------------------------------------
+# Brute-force exactness fuzz: the engine's documented claim is EXACT window
+# aggregates (it deviates from the reference only where the reference drops
+# data — PARITY.md deviations). Verify against direct recomputation from
+# the raw stream, which has no oracle quirks at all.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [11, 23, 37])
+def test_engine_exact_vs_brute_force(seed):
+    rng = np.random.default_rng(seed)
+    wins = [TumblingWindow(Time, int(rng.choice([7, 16, 25, 60]))),
+            SlidingWindow(Time, int(rng.choice([30, 45])),
+                          int(rng.choice([5, 10, 15])))]
+    n = 300
+    ts = np.sort(rng.integers(0, 2000, size=n))
+    lateness = 400
+    late = rng.random(n) < 0.2
+    ts = np.where(late, np.maximum(ts - rng.integers(0, lateness, size=n),
+                                   0), ts)
+    vals = rng.integers(1, 50, size=n).astype(np.int64)
+
+    eng = TpuWindowOperator(config=SMALL)
+    for w in wins:
+        eng.add_window_assigner(w)
+    eng.add_aggregation(SumAggregation())
+    eng.add_aggregation(CountAggregation())
+    eng.add_aggregation(MinAggregation())   # sparse-table query path
+    eng.add_aggregation(MaxAggregation())
+    eng.set_max_lateness(10_000)       # no GC interference with brute force
+
+    arr_t = np.asarray(ts, np.int64)
+    arr_v = np.asarray(vals, np.float64)
+    pos = 0
+    for cut, wm_off in ((n // 3, 5), (2 * n // 3, 11), (n - 1, 3000)):
+        while pos <= cut:
+            eng.process_element(int(vals[pos]), int(ts[pos]))
+            pos += 1
+        wm = int(np.max(arr_t[:cut + 1])) + wm_off
+        seen = arr_t[:pos]
+        seen_v = arr_v[:pos]
+        for w in eng.process_watermark(wm):
+            m = (seen >= w.get_start()) & (seen < w.get_end())
+            want_sum = float(seen_v[m].sum())
+            want_cnt = float(m.sum())
+            if w.has_value():
+                got_sum, got_cnt, got_min, got_max = (
+                    float(x) for x in w.get_agg_values())
+            else:
+                got_sum = got_cnt = 0.0
+                got_min = got_max = None
+            assert got_cnt == want_cnt, (w, want_cnt)
+            assert got_sum == pytest.approx(want_sum, rel=1e-5), (w, want_sum)
+            if want_cnt:
+                assert got_min == float(seen_v[m].min()), w
+                assert got_max == float(seen_v[m].max()), w
