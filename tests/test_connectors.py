@@ -312,3 +312,27 @@ def test_keyed_connector_device_backend_preserves_keys():
 
     with pytest.raises(RuntimeError, match="n_key_shards"):
         op.process_element("z", 1, 9)
+
+
+def test_global_connector_device_backend():
+    """GlobalScottyWindowOperator with backend="device" routes through the
+    sharded GlobalTpuWindowOperator; totals match the host backend."""
+    from scotty_tpu.engine import EngineConfig
+
+    def run(backend):
+        op = GlobalScottyWindowOperator(
+            backend=backend, n_shards=4,
+            engine_config=EngineConfig(capacity=512, batch_size=16,
+                                       annex_capacity=64,
+                                       min_trigger_pad=32))
+        op.add_window(TumblingWindow(Time, 10))
+        op.add_aggregation(SumAggregation())
+        op.allowed_lateness = 100
+        got = []
+        for v, t in [(1, 1), (2, 5), (3, 12), (4, 18), (5, 25), (6, 33)]:
+            got.extend(op.process_element(v, t))
+        got.extend(op.process_watermark(50))
+        return sorted((w.get_start(), w.get_end(),
+                       float(w.get_agg_values()[0])) for w in got)
+
+    assert run("host") == run("device")
