@@ -274,7 +274,8 @@ def make_device_source(cfg: BenchmarkConfig):
             valid_late = jax.device_put(v)
         lo = np.int64(i * span_ms)
         vals, ts = _gen_late(jax.random.fold_in(root, 1 << 20 | i), lo)
-        return (vals, ts, valid_late, n_late,
+        # tuple order matches ingest_device_late(ts, vals, valid, n, ...)
+        return (ts, vals, valid_late, n_late,
                 max(0, int(lo) - lateness), int(lo))
 
     gen.n_batches = n_batches
@@ -400,7 +401,7 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                 last = hi
             twin.process_watermark_async(last + 1)
             twin.process_watermark_async(last + cfg.watermark_period_ms + 1)
-            jax.block_until_ready(twin._state.starts)
+            jax.block_until_ready(jax.tree.leaves(twin._state)[0])
         else:
             for vals, ts in batches[:warmup_batches]:
                 twin.process_elements(vals, ts)
@@ -427,7 +428,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         if engine == "TpuEngine":
             sample = wm_count % SAMPLE_EVERY == 0
             if sample:
-                jax.device_get(op._state.n_slices)        # drain the queue
+                jax.device_get(                           # drain the queue
+                    jax.tree.leaves(op._state)[0].ravel()[0])
                 t_wm = time.perf_counter()
             out = op.process_watermark_async(wm)
             if isinstance(out[0], str):          # pure-session sweep
@@ -456,9 +458,9 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             op.ingest_device_batch(vals, ts, lo, hi)
             n_tuples += cfg.batch_size
             if gen.gen_late is not None and i > 0:
-                lv, lt, lvalid, n, lmin, lmax = gen.gen_late(i)
-                op.ingest_device_late(lt, lv, lvalid, n, lmin, lmax)
-                n_tuples += n
+                late_args = gen.gen_late(i)
+                op.ingest_device_late(*late_args)
+                n_tuples += late_args[3]
             while hi >= next_wm:
                 advance_watermark(next_wm)
                 next_wm += cfg.watermark_period_ms

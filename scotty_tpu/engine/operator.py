@@ -160,8 +160,11 @@ class TpuWindowOperator(WindowOperator):
             return
         if isinstance(window, SessionWindow):
             # pure-session device path (the eager session case,
-            # SliceFactory.java:17-22): one session window, nothing else.
-            if self.windows:
+            # SliceFactory.java:17-22 / isSessionWindowCase): SESSION
+            # windows only — any number of gaps, each an independent
+            # per-gap session state fed the same stream.
+            if self.windows and not all(isinstance(w, SessionWindow)
+                                        for w in self.windows):
                 raise UnsupportedOnDevice(
                     "session windows mixed with other windows need the host "
                     "operator (flexible-edge repair, SliceManager.java:89-166)")
@@ -296,14 +299,31 @@ class TpuWindowOperator(WindowOperator):
             raise RuntimeError("no aggregations registered")
         self._spec = self._compute_spec()
         C, A = self.config.capacity, self.config.annex_capacity
-        self._state = ec.init_state(self._spec, C, A)
         self._is_session = self._spec.pure_session
         if self._is_session:
-            self._ingest, self._session_sweep = _session_kernels(
-                self._spec, C, A, self.config.trigger_pad(1024))
-            self._ingest_inorder = self._ingest
+            # one independent session state per gap (sessions of different
+            # gaps are independent computations over the same stream); each
+            # gap gets its own ingest + sweep kernel and slice buffer
             self._emit_cap = self.config.trigger_pad(1024)
+            self._session_specs = tuple(
+                ec.EngineSpec(periods=(), bands=(), count_periods=(),
+                              aggs=self._spec.aggs, session_gaps=(g,))
+                for g in self._spec.session_gaps)
+            pairs = [_session_kernels(sp, C, A, self._emit_cap)
+                     for sp in self._session_specs]
+            ingests = tuple(p[0] for p in pairs)
+            self._session_sweeps = tuple(p[1] for p in pairs)
+
+            def ingest_all(states, ts, vals, valid):
+                return tuple(k(s, ts, vals, valid)
+                             for k, s in zip(ingests, states))
+
+            self._ingest = ingest_all
+            self._ingest_inorder = ingest_all
+            self._state = tuple(ec.init_state(sp, C, A)
+                                for sp in self._session_specs)
         else:
+            self._state = ec.init_state(self._spec, C, A)
             (self._ingest, self._query, self._gc, self._count_at,
              self._merge, self._ingest_inorder) = _kernels(self._spec, C, A)
         self._dense_runs = self.config.dense_ingest_runs \
@@ -639,43 +659,67 @@ class TpuWindowOperator(WindowOperator):
     def check_overflow(self) -> None:
         """One deliberate sync validating the run (async users call this
         after draining a stream)."""
-        if self._state is not None:
-            self._raise_if_overflow(self._state.overflow)
+        if self._state is None:
+            return
+        # per-gap session states are a plain tuple OF states; a single
+        # SliceBufferState is itself a NamedTuple, so test by attribute
+        states = ((self._state,) if hasattr(self._state, "overflow")
+                  else self._state)
+        for st in states:
+            self._raise_if_overflow(st.overflow)
 
     def _session_watermark_async(self, st, watermark_ts: int):
-        """Pure-session watermark: one sweep kernel emits complete sessions
-        and compacts the buffer (SessionWindow.java:107-116 semantics)."""
-        new_state, m_d, e_s, e_e, e_c, e_p = self._session_sweep(
-            st, np.int64(watermark_ts))
-        self._state = new_state
+        """Pure-session watermark: per-gap sweep kernels emit complete
+        sessions and compact each buffer (SessionWindow.java:107-116
+        semantics); gaps emit in window-registration order."""
+        new_states, outs = [], []
+        for sweep, st_g in zip(self._session_sweeps, st):
+            new_g, m_d, e_s, e_e, e_c, e_p = sweep(st_g,
+                                                   np.int64(watermark_ts))
+            new_states.append(new_g)
+            outs.append((m_d, e_s, e_e, e_c, e_p))
+        self._state = tuple(new_states)
         self._last_watermark = watermark_ts
-        return ("session", m_d, e_s, e_e, e_c, e_p)
+        return ("session", outs)
 
     def _session_fetch(self, out):
         import jax
 
-        _, m_d, e_s, e_e, e_c, e_p = out
-        if m_d is None:
-            empty = np.empty(0, dtype=np.int64)
-            return empty, empty, empty, []
-        m, ws_h, we_h, cnt_h, res_h, ovf = jax.device_get(
-            (m_d, e_s, e_e, e_c, e_p, self._state.overflow))
-        m = int(m)
-        self._raise_if_overflow(ovf)
-        if m > self._emit_cap:
-            raise RuntimeError(
-                f"{m} sessions completed in one watermark exceeds the "
-                f"emission buffer ({self._emit_cap}); raise "
-                "EngineConfig.min_trigger_pad")
-        cnt = cnt_h[:m]
-        lowered = []
-        for agg, res in zip(self.aggregations, res_h):
-            spec = agg.device_spec()
-            lowered.append(np.asarray(spec.lower(res[:m], cnt)))
-        self._trigger_measures = np.zeros((m,), bool)
-        return ws_h[:m], we_h[:m], cnt, lowered
+        _, outs = out
+        fetched = jax.device_get(
+            (outs, tuple(s.overflow for s in self._state)))
+        gap_outs, ovfs = fetched
+        for ovf in ovfs:
+            self._raise_if_overflow(ovf)
+        ws_parts, we_parts, cnt_parts = [], [], []
+        low_parts = [[] for _ in self.aggregations]
+        for (m, ws_h, we_h, cnt_h, res_h) in gap_outs:
+            m = int(m)
+            if m > self._emit_cap:
+                raise RuntimeError(
+                    f"{m} sessions completed in one watermark exceeds the "
+                    f"emission buffer ({self._emit_cap}); raise "
+                    "EngineConfig.min_trigger_pad")
+            ws_parts.append(ws_h[:m])
+            we_parts.append(we_h[:m])
+            cnt_parts.append(cnt_h[:m])
+            for j, (agg, res) in enumerate(zip(self.aggregations, res_h)):
+                spec = agg.device_spec()
+                low_parts[j].append(
+                    np.asarray(spec.lower(res[:m], cnt_h[:m])))
+        ws = np.concatenate(ws_parts) if ws_parts else np.empty(0, np.int64)
+        we = np.concatenate(we_parts) if we_parts else np.empty(0, np.int64)
+        cnt = np.concatenate(cnt_parts) if cnt_parts \
+            else np.empty(0, np.int64)
+        lowered = [np.concatenate(p) if p else np.empty(0) for p in low_parts]
+        self._trigger_measures = np.zeros((ws.shape[0],), bool)
+        return ws, we, cnt, lowered
 
     # -- introspection -----------------------------------------------------
     @property
     def n_slices(self) -> int:
-        return int(self._state.n_slices) if self._state is not None else 0
+        if self._state is None:
+            return 0
+        if hasattr(self._state, "n_slices"):
+            return int(self._state.n_slices)
+        return sum(int(st.n_slices) for st in self._state)  # per-gap states

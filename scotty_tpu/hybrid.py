@@ -56,10 +56,13 @@ class HybridWindowOperator(WindowOperator):
                         if isinstance(w, SessionWindow)}
         if session_gaps:
             # the device session path is the eager pure-session case
-            # (SliceFactory.java:17-22): ONE session window, Time measure,
-            # and an in-order stream declared by the caller
-            if not self.assume_inorder or len(self.windows) != 1 \
-                    or self.windows[0].measure != WindowMeasure.Time:
+            # (SliceFactory.java:17-22 isSessionWindowCase): SESSION windows
+            # only (any number of gaps — one device state per gap), Time
+            # measure, and an in-order stream declared by the caller
+            if not self.assume_inorder \
+                    or not all(isinstance(w, SessionWindow)
+                               and w.measure == WindowMeasure.Time
+                               for w in self.windows):
                 return False
         else:
             for w in self.windows:

@@ -617,3 +617,24 @@ def test_engine_exact_vs_brute_force(seed):
             if want_cnt:
                 assert got_min == float(seen_v[m].min()), w
                 assert got_max == float(seen_v[m].max()), w
+
+
+def test_multi_gap_pure_sessions():
+    """Two concurrent session windows with different gaps
+    (SessionWindowOperatorTest.java:207-236, in-order): the device runs one
+    session state per gap; results match the simulator. Watermarks fire
+    inside long stream gaps so the reference's re-opened-session quirk
+    (PARITY.md deviation 5) can't trigger."""
+    from scotty_tpu import SessionWindow
+
+    rng = np.random.default_rng(21)
+    t, stream, safe_points = 0, [], []
+    for burst in range(12):
+        for _ in range(int(rng.integers(5, 15))):
+            t += int(rng.integers(0, 3))
+            stream.append((int(rng.integers(1, 30)), t))
+        safe_points.append((len(stream) - 1, t + 40))   # mid-long-gap
+        t += int(rng.integers(60, 100))                 # >> both gaps
+    wms = safe_points[3::4] + [safe_points[-1]]
+    run_both([SessionWindow(Time, 8), SessionWindow(Time, 20)],
+             [SumAggregation, MaxAggregation], stream, wms)
