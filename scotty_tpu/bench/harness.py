@@ -433,9 +433,10 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                 t_wm = time.perf_counter()
             out = op.process_watermark_async(wm)
             if isinstance(out[0], str):          # pure-session sweep
-                pending_sessions.append(out[1])  # m = sessions emitted
+                ms = tuple(g[0] for g in out[1])   # per-gap emitted counts
+                pending_sessions.append(ms)
                 if sample:
-                    jax.device_get(out[1])
+                    jax.device_get(ms)
             elif out[3] is not None:
                 pending.append((out[0].shape[0], out[3]))
                 if sample:
@@ -484,7 +485,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             n_emitted += int((cnt[:T] > 0).sum())
         if pending_sessions:
             n_emitted += int(sum(int(m)
-                                 for m in jax.device_get(pending_sessions)))
+                                 for grp in jax.device_get(pending_sessions)
+                                 for m in grp))
         op.check_overflow()
     wall = time.perf_counter() - t0
 
