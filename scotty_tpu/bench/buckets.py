@@ -42,7 +42,7 @@ class BucketWindowPipeline:
                  throughput: int = 1_000_000, wm_period_ms: int = 1000,
                  seed: int = 0, chunk: int = 1 << 18,
                  max_chunk_elems: int = 1 << 25,
-                 value_scale: float = 10_000.0):
+                 value_scale: float = 10_000.0, max_lateness: int = 1000):
         import jax
         import jax.numpy as jnp
 
@@ -124,8 +124,9 @@ class BucketWindowPipeline:
                 ring_vals, vals, (slot.astype(jnp.int32),))
             return ring_ts, ring_vals
 
-        first_lw = max(0, P - 1000)        # first-watermark lateness clamp
-                                           # (reference default 1000 ms)
+        first_lw = max(0, P - max_lateness)   # first-watermark lateness
+                                              # clamp, same rule as the
+                                              # engine pipelines
 
         def step(ring_ts, ring_vals, key, interval_idx):
             base = interval_idx * P

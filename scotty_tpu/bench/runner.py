@@ -70,9 +70,16 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
     # the sparsest window must trigger at least once inside the timed
     # region (a 60 s-slide window fires every 60 intervals — a 10-interval
     # run would report windows_emitted=0)
-    max_period = max(
-        int(getattr(w, "slide", 0) or getattr(w, "size", 0))
-        for w in pipeline.windows)
+    def _trigger_horizon(w):
+        from ..core.windows import FixedBandWindow, SlidingWindow
+
+        if isinstance(w, FixedBandWindow):
+            return int(w.start + w.size)      # its single trigger point
+        if isinstance(w, SlidingWindow):
+            return int(w.slide)
+        return int(w.size)
+
+    max_period = max(_trigger_horizon(w) for w in pipeline.windows)
     timed = max(timed, -(-max_period // pipeline.wm_period_ms) + 1)
 
     pipeline.reset()
@@ -167,7 +174,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                                                  cfg.watermark_period_ms))
         p = BucketWindowPipeline(
             windows, [make_aggregation(agg_name)], throughput=tp,
-            wm_period_ms=cfg.watermark_period_ms, seed=cfg.seed)
+            wm_period_ms=cfg.watermark_period_ms, seed=cfg.seed,
+            max_lateness=cfg.max_lateness)
         return _run_pipeline_cell(p, cfg, window_spec, agg_name, "buckets")
 
     if engine == "Hybrid":

@@ -475,12 +475,21 @@ class TpuWindowOperator(WindowOperator):
         device-side sources — host→device bandwidth never caps throughput."""
         if not self._built:
             self._build()
+        import jax
+
         B = self.config.batch_size
         if self._valid_dev is None:
-            import jax
-
             self._valid_dev = jax.device_put(np.ones((B,), bool))
         n = B if n_valid is None else n_valid
+        if n == B:
+            valid = self._valid_dev
+        else:
+            # partially filled batch: lanes >= n_valid MUST be masked or
+            # their pad values aggregate into real windows (lanes must be a
+            # sorted prefix, pad lanes repeating the last valid ts)
+            m = np.zeros((B,), bool)
+            m[:n] = True
+            valid = jax.device_put(m)
         has_late = self._host_met is not None and ts_min < self._host_met
         if has_late:
             if self._has_count or self._is_session:
@@ -498,7 +507,7 @@ class TpuWindowOperator(WindowOperator):
         else:
             # dense scatter-free variant when the span bound allows
             kern = self._pick_inorder_kernel(ts_min, ts_max)
-        self._state = kern(self._state, ts, vals, self._valid_dev)
+        self._state = kern(self._state, ts, vals, valid)
 
     def ingest_device_late(self, ts, vals, valid, n: int, ts_min: int,
                            ts_max: int) -> None:

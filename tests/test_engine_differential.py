@@ -638,3 +638,24 @@ def test_multi_gap_pure_sessions():
     wms = safe_points[3::4] + [safe_points[-1]]
     run_both([SessionWindow(Time, 8), SessionWindow(Time, 20)],
              [SumAggregation, MaxAggregation], stream, wms)
+
+
+def test_ingest_device_batch_honors_n_valid():
+    """Pad lanes beyond n_valid must not aggregate (review finding: the
+    mask was previously always all-true)."""
+    import jax
+    import jax.numpy as jnp
+
+    op = TpuWindowOperator(config=SMALL)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    B = SMALL.batch_size
+    ts = np.arange(B, dtype=np.int64) // 8          # ts 0..7
+    ts[10:] = ts[9]                                 # pad lanes repeat
+    vals = np.full((B,), 5.0, np.float32)
+    op.ingest_device_batch(jax.device_put(jnp.asarray(vals)),
+                           jax.device_put(jnp.asarray(ts)),
+                           0, int(ts[9]), n_valid=10)
+    res = [w for w in op.process_watermark(20) if w.has_value()]
+    assert len(res) == 1
+    assert float(res[0].get_agg_values()[0]) == 50.0    # 10 lanes, not B
