@@ -428,15 +428,27 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         if engine == "TpuEngine":
             sample = wm_count % SAMPLE_EVERY == 0
             if sample:
+                anchor = op._state if op._state is not None \
+                    else op._session_states[0]
                 jax.device_get(                           # drain the queue
-                    jax.tree.leaves(op._state)[0].ravel()[0])
+                    jax.tree.leaves(anchor)[0].ravel()[0])
                 t_wm = time.perf_counter()
             out = op.process_watermark_async(wm)
-            if isinstance(out[0], str):          # pure-session sweep
-                ms = tuple(g[0] for g in out[1])   # per-gap emitted counts
+            if isinstance(out[0], str) and out[0] == "session":
+                ms = tuple(g[0] for g in out[1])   # per-window emit counts
                 pending_sessions.append(ms)
                 if sample:
                     jax.device_get(ms)
+            elif isinstance(out[0], str):        # mixed grid + sessions
+                _, grid, s_outs = out
+                ms = tuple(g[0] for g in s_outs)
+                pending_sessions.append(ms)
+                if grid[3] is not None:
+                    pending.append((grid[0].shape[0], grid[3]))
+                if sample:
+                    jax.device_get(ms)
+                    if grid[3] is not None:
+                        jax.device_get((grid[3], grid[4]))
             elif out[3] is not None:
                 pending.append((out[0].shape[0], out[3]))
                 if sample:

@@ -43,22 +43,41 @@ class UnsupportedOnDevice(NotImplementedError):
 _KERNEL_CACHE: dict = {}
 
 
-def _session_kernels(spec, capacity: int, annex_capacity: int, emit_cap: int):
-    """Jitted pure-session kernels (ingest + sweep), cached like _kernels."""
+def _session_kernels(aggs, gap: int, capacity: int, late_len: int,
+                     emit_cap: int):
+    """Jitted session kernels (in-order ingest + late scan + sweep) for one
+    registered session window, cached like _kernels."""
     import jax
-    from . import core as ec
+    from . import sessions as es
 
-    key = ("session", spec.session_gaps,
-           tuple(a.token for a in spec.aggs), capacity, annex_capacity,
+    key = ("session", gap, tuple(a.token for a in aggs), capacity, late_len,
            emit_cap)
     hit = _KERNEL_CACHE.get(key)
     if hit is None:
         hit = (
-            jax.jit(ec.build_ingest(spec, capacity, annex_capacity),
+            jax.jit(es.build_session_ingest(aggs, gap, capacity),
                     donate_argnums=0),
-            jax.jit(ec.build_session_sweep(spec, capacity, emit_cap),
+            jax.jit(es.build_session_late(aggs, gap, capacity, late_len),
+                    donate_argnums=0),
+            jax.jit(es.build_session_sweep(aggs, gap, capacity, emit_cap),
                     donate_argnums=0),
         )
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
+def _session_dense_kernel(aggs, gap: int, capacity: int, runs: int):
+    """Jitted run-bounded in-order session ingest, cached."""
+    import jax
+    from . import sessions as es
+
+    key = ("session-dense", gap, tuple(a.token for a in aggs), capacity,
+           runs)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        hit = jax.jit(es.build_session_ingest_dense(aggs, gap, capacity,
+                                                    runs),
+                      donate_argnums=0)
         _KERNEL_CACHE[key] = hit
     return hit
 
@@ -159,23 +178,13 @@ class TpuWindowOperator(WindowOperator):
             self._add_window_dynamic(window)
             return
         if isinstance(window, SessionWindow):
-            # pure-session device path (the eager session case,
-            # SliceFactory.java:17-22 / isSessionWindowCase): SESSION
-            # windows only — any number of gaps, each an independent
-            # per-gap session state fed the same stream.
-            if self.windows and not all(isinstance(w, SessionWindow)
-                                        for w in self.windows):
-                raise UnsupportedOnDevice(
-                    "session windows mixed with other windows need the host "
-                    "operator (flexible-edge repair, SliceManager.java:89-166)")
+            # sessions run on their own bounded active-session arrays
+            # (engine/sessions.py), one per registered window — any mix
+            # with time-grid windows, in- or out-of-order streams.
             if window.measure != WindowMeasure.Time:
                 raise UnsupportedOnDevice("count-measure sessions: host only")
             self.windows.append(window)
             return
-        if self.windows and isinstance(self.windows[0], SessionWindow):
-            raise UnsupportedOnDevice(
-                "session windows mixed with other windows need the host "
-                "operator")
         if not isinstance(window, (TumblingWindow, SlidingWindow,
                                    FixedBandWindow)):
             raise UnsupportedOnDevice(
@@ -215,7 +224,7 @@ class TpuWindowOperator(WindowOperator):
         see them; results are identical from the first old-grid edge after
         the addition onward.
         """
-        if self._is_session or isinstance(window, SessionWindow):
+        if self._session_windows or isinstance(window, SessionWindow):
             raise UnsupportedOnDevice(
                 "dynamic addition with session windows needs the host "
                 "operator")
@@ -231,14 +240,14 @@ class TpuWindowOperator(WindowOperator):
         self.windows.append(window)
         self.max_fixed_window_size = max(self.max_fixed_window_size,
                                          window.clear_delay())
-        self._spec = self._compute_spec()
+        self._spec = self._grid_spec = self._compute_spec()
         C, A = self.config.capacity, self.config.annex_capacity
         (self._ingest, self._query, self._gc, self._count_at,
-         self._merge, self._ingest_inorder) = _kernels(self._spec, C, A)
+         self._merge, self._ingest_inorder) = _kernels(self._grid_spec, C, A)
         # the dense fast path closes over the union grid too
         self._dense_runs = self.config.dense_ingest_runs \
-            if dense_eligible(self._spec) else 0
-        self._min_grid = min_grid_period(self._spec)
+            if dense_eligible(self._grid_spec) else 0
+        self._min_grid = min_grid_period(self._grid_spec)
         self._ingest_dense = None
 
     def add_aggregation(self, window_function: AggregateFunction) -> None:
@@ -290,8 +299,8 @@ class TpuWindowOperator(WindowOperator):
         )
 
     def _build(self) -> None:
-        import jax
         from . import core as ec
+        from . import sessions as es
 
         if not self.windows:
             raise RuntimeError("no windows registered")
@@ -299,38 +308,52 @@ class TpuWindowOperator(WindowOperator):
             raise RuntimeError("no aggregations registered")
         self._spec = self._compute_spec()
         C, A = self.config.capacity, self.config.annex_capacity
-        self._is_session = self._spec.pure_session
-        if self._is_session:
-            # one independent session state per gap (sessions of different
-            # gaps are independent computations over the same stream); each
-            # gap gets its own ingest + sweep kernel and slice buffer
-            self._emit_cap = self.config.trigger_pad(1024)
-            self._session_specs = tuple(
-                ec.EngineSpec(periods=(), bands=(), count_periods=(),
-                              aggs=self._spec.aggs, session_gaps=(g,))
-                for g in self._spec.session_gaps)
-            pairs = [_session_kernels(sp, C, A, self._emit_cap)
-                     for sp in self._session_specs]
-            ingests = tuple(p[0] for p in pairs)
-            self._session_sweeps = tuple(p[1] for p in pairs)
+        # Session windows run on their own per-registration active-session
+        # arrays (engine/sessions.py); the grid slice buffer serves only
+        # context-free windows. Stripping the gaps from the grid spec keeps
+        # kernel-cache keys and the dense fast path independent of sessions.
+        self._session_windows = [w for w in self.windows
+                                 if isinstance(w, SessionWindow)]
+        import dataclasses
 
-            def ingest_all(states, ts, vals, valid):
-                return tuple(k(s, ts, vals, valid)
-                             for k, s in zip(ingests, states))
-
-            self._ingest = ingest_all
-            self._ingest_inorder = ingest_all
-            self._state = tuple(ec.init_state(sp, C, A)
-                                for sp in self._session_specs)
-        else:
-            self._state = ec.init_state(self._spec, C, A)
+        self._grid_spec = dataclasses.replace(self._spec, session_gaps=())
+        self._has_grid = (self._grid_spec.has_time_grid
+                          or bool(self._grid_spec.count_periods))
+        self._pure_session = bool(self._session_windows) and not self._has_grid
+        if self._has_grid:
+            self._state = ec.init_state(self._grid_spec, C, A)
             (self._ingest, self._query, self._gc, self._count_at,
-             self._merge, self._ingest_inorder) = _kernels(self._spec, C, A)
+             self._merge, self._ingest_inorder) = _kernels(self._grid_spec,
+                                                           C, A)
+        else:
+            self._state = None
+        if self._session_windows:
+            self._emit_cap = self.config.trigger_pad(1024)
+            # the late scan is SEQUENTIAL (one device step per late tuple) —
+            # cap its static length well below bench batch sizes; rarer
+            # larger late sets chunk through it (_feed_sessions)
+            self._late_len = min(self.config.batch_size, 256)
+            trips = [_session_kernels(self._spec.aggs, int(w.gap), C,
+                                      self._late_len, self._emit_cap)
+                     for w in self._session_windows]
+            self._session_ingests = tuple(t[0] for t in trips)
+            self._session_lates = tuple(t[1] for t in trips)
+            self._session_sweeps = tuple(t[2] for t in trips)
+            # orphan capacity rides annex_capacity: both hold the rare
+            # out-of-contract-ish residue between watermarks
+            self._session_states = [
+                es.init_session_state(
+                    self._spec.aggs, C,
+                    orphan_capacity=max(64, A))
+                for _ in self._session_windows]
+            self._session_dense = [None] * len(self._session_windows)
+        else:
+            self._session_states = []
         self._dense_runs = self.config.dense_ingest_runs \
-            if (not self._is_session and dense_eligible(self._spec)) else 0
-        self._min_grid = min_grid_period(self._spec)
+            if (self._has_grid and dense_eligible(self._grid_spec)) else 0
+        self._min_grid = min_grid_period(self._grid_spec)
         self._ingest_dense = None       # built lazily on first eligible batch
-        self._has_count = bool(self._spec.count_periods)
+        self._has_count = bool(self._grid_spec.count_periods)
         self._last_count = 0
         self._host_met = None           # host mirror of max event time
         self._host_min_ts = None        # host mirror of min event time
@@ -373,25 +396,27 @@ class TpuWindowOperator(WindowOperator):
         self._pend_ts = [rest_t] if rest_t.size else []
         self._n_pending -= take
 
+        met_pre = self._host_met            # max event time BEFORE this batch
+        if self._has_count and take and met_pre is not None \
+                and int(batch_t[:take].min()) < met_pre:
+            # out-of-order + count measure needs the reference's record
+            # ripple (SliceManager.java:77-85) — host-only. Checked before
+            # ANY state mutation so a caller can fall back cleanly.
+            raise UnsupportedOnDevice(
+                "out-of-order tuples with count-measure windows need "
+                "the host operator")
+        if self._session_states and take:
+            # sessions consume the batch in ARRIVAL order — the reference's
+            # session calculus is arrival-order-dependent at exact-gap
+            # boundaries (engine/sessions.py module docstring)
+            self._feed_sessions(batch_v[:take], batch_t[:take], met_pre)
+
         if take and not bool((batch_t[:-1] <= batch_t[1:]).all()):
             order = np.argsort(batch_t, kind="stable")
             batch_v, batch_t = batch_v[order], batch_t[order]
-        if self._has_count or self._is_session:
-            # out-of-order + count measure needs the reference's record
-            # ripple (SliceManager.java:77-85); out-of-order sessions need
-            # context repair (SessionWindow.java:40-84) — host-only.
-            if (self._host_met is not None and take
-                    and batch_t[0] < self._host_met):
-                raise UnsupportedOnDevice(
-                    "out-of-order tuples with count-measure or session "
-                    "windows need the host operator")
-        met_pre = self._host_met            # max event time BEFORE this batch
         has_late = (take > 0 and met_pre is not None
                     and int(batch_t[0]) < met_pre)
         if take:
-            if has_late:
-                # late tuples may open annex slices → merge before next query
-                self._annex_dirty = True
             mx = int(batch_t[take - 1]) if take < B else int(batch_t[-1])
             self._host_met = mx if self._host_met is None \
                 else max(self._host_met, mx)
@@ -399,6 +424,11 @@ class TpuWindowOperator(WindowOperator):
             self._host_min_ts = mn if self._host_min_ts is None \
                 else min(self._host_min_ts, mn)
             self._host_count += take
+        if not self._has_grid:
+            return
+        if has_late:
+            # late tuples may open annex slices → merge before next query
+            self._annex_dirty = True
         valid = np.ones((B,), dtype=bool)
         if take < B:
             pad_t = batch_t[-1] if take else 0
@@ -449,6 +479,70 @@ class TpuWindowOperator(WindowOperator):
             int(batch_t[take - 1]) if take else 0)
         self._state = kern(self._state, batch_t, batch_v, valid)
 
+    def _feed_sessions(self, vals: np.ndarray, tss: np.ndarray,
+                       met_pre) -> None:
+        """Update every registered session window's active-session array
+        with this batch, in arrival order.
+
+        In-order tuples (at/above the running max event time) go through the
+        vectorized chain kernel first; late tuples follow one at a time
+        through the sequential scan kernel — processing all in-order tuples
+        before the interleaved late ones provably cannot change any outcome
+        (sessions.py module docstring), and within each class arrival order
+        is preserved.
+        """
+        B = self.config.batch_size
+        seed = np.int64(met_pre) if met_pre is not None \
+            else np.iinfo(np.int64).min
+        prev_rm = np.maximum.accumulate(
+            np.concatenate((np.asarray([seed]), tss[:-1])))
+        late_m = tss < prev_rm
+        io_t, io_v = tss[~late_m], vals[~late_m]
+        n_io = io_t.size
+        if n_io:
+            for lo in range(0, n_io, B):
+                chunk_t, chunk_v = io_t[lo:lo + B], io_v[lo:lo + B]
+                k = chunk_t.size
+                pt = np.full((B,), chunk_t[-1], np.int64)
+                pv = np.zeros((B,), np.float32)
+                pt[:k], pv[:k] = chunk_t, chunk_v
+                m = np.zeros((B,), bool)
+                m[:k] = True
+                gaps_t = np.diff(chunk_t) if k > 1 else \
+                    np.empty(0, np.int64)
+                for i, kern in enumerate(self._session_ingests):
+                    # scatter-free run-bounded kernel when the chunk opens
+                    # few sessions (the common bench shape: long sessions,
+                    # huge batches) — same gate as the grid dense path
+                    R = self.config.dense_ingest_runs
+                    if R:
+                        gap = int(self._session_windows[i].gap)
+                        n_new = int((gaps_t > gap).sum()) + 2
+                        if n_new <= R:
+                            if self._session_dense[i] is None:
+                                self._session_dense[i] = \
+                                    _session_dense_kernel(
+                                        self._spec.aggs, gap,
+                                        self.config.capacity, R)
+                            kern = self._session_dense[i]
+                    self._session_states[i] = kern(
+                        self._session_states[i], pt, pv, m)
+        n_late = int(late_m.sum())
+        if n_late:
+            lt_all, lv_all = tss[late_m], vals[late_m]
+            L = self._late_len
+            for lo in range(0, n_late, L):
+                chunk_t, chunk_v = lt_all[lo:lo + L], lv_all[lo:lo + L]
+                k = chunk_t.size
+                pt = np.full((L,), chunk_t[-1], np.int64)
+                pv = np.zeros((L,), np.float32)
+                pt[:k], pv[:k] = chunk_t, chunk_v
+                m = np.zeros((L,), bool)
+                m[:k] = True
+                for i, kern in enumerate(self._session_lates):
+                    self._session_states[i] = kern(
+                        self._session_states[i], pt, pv, m)
+
     def _pick_inorder_kernel(self, ts_lo: int, ts_hi: int):
         """Scatter-free dense kernel when the batch's slice-run count is
         provably under the bound; general in-order kernel otherwise."""
@@ -457,7 +551,8 @@ class TpuWindowOperator(WindowOperator):
             if runs <= self._dense_runs:
                 if self._ingest_dense is None:
                     self._ingest_dense = _dense_kernel(
-                        self._spec, self.config.capacity, self._dense_runs)
+                        self._grid_spec, self.config.capacity,
+                        self._dense_runs)
                 return self._ingest_dense
         return self._ingest_inorder
 
@@ -490,12 +585,16 @@ class TpuWindowOperator(WindowOperator):
             m = np.zeros((B,), bool)
             m[:n] = True
             valid = jax.device_put(m)
+        if self._session_states:
+            raise UnsupportedOnDevice(
+                "device-resident batches with session windows: use "
+                "process_elements (host-fed) for session workloads")
         has_late = self._host_met is not None and ts_min < self._host_met
         if has_late:
-            if self._has_count or self._is_session:
+            if self._has_count:
                 raise UnsupportedOnDevice(
-                    "out-of-order device batches with count-measure or "
-                    "session windows need the host operator")
+                    "out-of-order device batches with count-measure "
+                    "windows need the host operator")
             self._annex_dirty = True
         self._host_met = ts_max if self._host_met is None \
             else max(self._host_met, ts_max)
@@ -519,7 +618,7 @@ class TpuWindowOperator(WindowOperator):
         disorder from the in-order base stream."""
         if not self._built:
             self._build()
-        if self._has_count or self._is_session:
+        if self._has_count or self._session_states:
             raise UnsupportedOnDevice(
                 "out-of-order device batches with count-measure or session "
                 "windows need the host operator")
@@ -562,10 +661,11 @@ class TpuWindowOperator(WindowOperator):
         if not self._built:
             self._build()
         self._flush()
+        if self._pure_session:
+            outs = self._sweep_sessions(watermark_ts)
+            self._last_watermark = watermark_ts
+            return ("session", outs)
         st = self._state
-
-        if self._is_session:
-            return self._session_watermark_async(st, watermark_ts)
 
         last_wm = self._last_watermark
         first_watermark = last_wm == -1
@@ -576,7 +676,7 @@ class TpuWindowOperator(WindowOperator):
         no_result = (empty, empty, np.empty(0, bool), None, None)
         if self._host_met is None:           # store empty: :46-49
             self._last_watermark = watermark_ts
-            return no_result
+            return self._wrap_mixed(no_result, watermark_ts)
 
         # NOTE: the reference's first-watermark clamp to the oldest slice
         # start (WindowManager.java:51-55) is a no-op here: its bootstrap
@@ -599,6 +699,8 @@ class TpuWindowOperator(WindowOperator):
 
         trig_s, trig_e, trig_c = [], [], []
         for w in self.windows:
+            if isinstance(w, SessionWindow):
+                continue              # sessions emit via their own sweeps
             if w.measure == WindowMeasure.Count:
                 s_arr, e_arr = w.trigger_arrays(self._last_count, cend + 1)
                 trig_c.append(np.ones(s_arr.shape[0], bool))
@@ -634,20 +736,61 @@ class TpuWindowOperator(WindowOperator):
         self._state = self._gc(st, np.int64(bound))
         self._last_watermark = watermark_ts
         self._trigger_measures = is_count
-        return ws, we, is_count, cnt_d, results
+        return self._wrap_mixed((ws, we, is_count, cnt_d, results),
+                                watermark_ts)
+
+    def _wrap_mixed(self, grid, watermark_ts: int):
+        """Append session sweeps to a grid watermark result when session
+        windows are registered (emission order matches the simulator:
+        context-free windows first, then context-aware —
+        WindowManager.java:98-118)."""
+        if not self._session_states:
+            return grid
+        return ("mixed", grid, self._sweep_sessions(watermark_ts))
+
+    def _sweep_sessions(self, watermark_ts: int):
+        outs = []
+        wm = np.int64(watermark_ts)
+        gc_bound = np.int64(watermark_ts - self.max_lateness)
+        for i, sweep in enumerate(self._session_sweeps):
+            new_s, m_d, e_s, e_e, e_c, e_p = sweep(self._session_states[i],
+                                                   wm, gc_bound)
+            self._session_states[i] = new_s
+            outs.append((m_d, e_s, e_e, e_c, e_p))
+        return outs
 
     def process_watermark_arrays(self, watermark_ts: int):
         """Synchronous watermark: returns numpy ``(starts[T], ends[T],
         counts[T], [per-agg lowered [T]])`` — one bundled device fetch."""
+        out = self.process_watermark_async(watermark_ts)
+        if isinstance(out[0], str) and out[0] == "session":
+            ws, we, cnt, lowered = self._fetch_sessions(out[1])
+            self._trigger_measures = np.zeros((ws.shape[0],), bool)
+            return ws, we, cnt, lowered
+        if isinstance(out[0], str) and out[0] == "mixed":
+            _, grid, s_outs = out
+            g_ws, g_we, g_cnt, g_low = self._fetch_grid(grid)
+            s_ws, s_we, s_cnt, s_low = self._fetch_sessions(s_outs)
+            ws = np.concatenate([g_ws, s_ws])
+            we = np.concatenate([g_we, s_we])
+            cnt = np.concatenate([g_cnt, s_cnt])
+            lowered = [np.concatenate([np.asarray(a), np.asarray(b)])
+                       for a, b in zip(g_low, s_low)]
+            is_count = grid[2]
+            self._trigger_measures = np.concatenate(
+                [is_count, np.zeros((s_ws.shape[0],), bool)])
+            return ws, we, cnt, lowered
+        return self._fetch_grid(out)
+
+    def _fetch_grid(self, grid):
         import jax
 
-        out = self.process_watermark_async(watermark_ts)
-        if self._is_session:
-            return self._session_fetch(out)
-        ws, we, is_count, cnt_d, results = out
+        ws, we, is_count, cnt_d, results = grid
         T = ws.shape[0]
-        lowered: List[np.ndarray] = []
-        cnt_np = np.empty(0, dtype=np.int64)
+        lowered: List[np.ndarray] = [np.empty(0)
+                                     for _ in self.aggregations] if T == 0 \
+            else []
+        cnt_np = np.zeros((T,), dtype=np.int64)
         if T:
             cnt_h, res_h, ovf = jax.device_get(
                 (cnt_d, results, self._state.overflow))
@@ -661,42 +804,28 @@ class TpuWindowOperator(WindowOperator):
     def _raise_if_overflow(self, ovf) -> None:
         if bool(ovf):
             raise RuntimeError(
-                "slice buffer overflow: raise EngineConfig.capacity / "
-                "annex_capacity / batch sizing, or advance watermarks more "
-                "often")
+                "slice/session buffer overflow: raise EngineConfig.capacity "
+                "(slice rows, session rows) / annex_capacity (late annex & "
+                "session orphan buffer) / batch sizing, or advance "
+                "watermarks more often")
 
     def check_overflow(self) -> None:
         """One deliberate sync validating the run (async users call this
         after draining a stream)."""
-        if self._state is None:
+        if not self._built:
             return
-        # per-gap session states are a plain tuple OF states; a single
-        # SliceBufferState is itself a NamedTuple, so test by attribute
-        states = ((self._state,) if hasattr(self._state, "overflow")
-                  else self._state)
-        for st in states:
+        if self._state is not None:
+            self._raise_if_overflow(self._state.overflow)
+        for st in getattr(self, "_session_states", ()):
             self._raise_if_overflow(st.overflow)
 
-    def _session_watermark_async(self, st, watermark_ts: int):
-        """Pure-session watermark: per-gap sweep kernels emit complete
-        sessions and compact each buffer (SessionWindow.java:107-116
-        semantics); gaps emit in window-registration order."""
-        new_states, outs = [], []
-        for sweep, st_g in zip(self._session_sweeps, st):
-            new_g, m_d, e_s, e_e, e_c, e_p = sweep(st_g,
-                                                   np.int64(watermark_ts))
-            new_states.append(new_g)
-            outs.append((m_d, e_s, e_e, e_c, e_p))
-        self._state = tuple(new_states)
-        self._last_watermark = watermark_ts
-        return ("session", outs)
-
-    def _session_fetch(self, out):
+    def _fetch_sessions(self, outs):
+        """Fetch per-session-window sweep outputs; emission follows window
+        registration order (the simulator's context list order)."""
         import jax
 
-        _, outs = out
         fetched = jax.device_get(
-            (outs, tuple(s.overflow for s in self._state)))
+            (outs, tuple(s.overflow for s in self._session_states)))
         gap_outs, ovfs = fetched
         for ovf in ovfs:
             self._raise_if_overflow(ovf)
@@ -721,14 +850,14 @@ class TpuWindowOperator(WindowOperator):
         cnt = np.concatenate(cnt_parts) if cnt_parts \
             else np.empty(0, np.int64)
         lowered = [np.concatenate(p) if p else np.empty(0) for p in low_parts]
-        self._trigger_measures = np.zeros((ws.shape[0],), bool)
         return ws, we, cnt, lowered
 
     # -- introspection -----------------------------------------------------
     @property
     def n_slices(self) -> int:
-        if self._state is None:
-            return 0
-        if hasattr(self._state, "n_slices"):
-            return int(self._state.n_slices)
-        return sum(int(st.n_slices) for st in self._state)  # per-gap states
+        total = 0
+        if self._state is not None:
+            total += int(self._state.n_slices)
+        for st in getattr(self, "_session_states", ()):
+            total += int(st.n)              # live sessions
+        return total

@@ -1,0 +1,484 @@
+"""Device session state + kernels: fully general session windows on TPU.
+
+TPU-first redesign of the reference's session machinery
+(core/.../SessionWindow.java:40-116 session calculus,
+slicing/.../SliceManager.java:89-166 flexible-edge slice repair): instead of
+sharing one slice store between session and time-grid windows and repairing
+slice edges when sessions move (the reference's Shift/Add/Delete calculus),
+each registered session window owns a bounded **active-session array** —
+SURVEY.md §7 "hard parts" #3 — holding, per live session, its observed tuple
+extent ``[first, last]``, tuple count, and one fixed-width partial aggregate
+per registered aggregation. Time-grid windows are answered by the grid slice
+buffer (:mod:`.core`) untouched; duplicating partial state per window family
+is cheap on HBM and removes all data-dependent slice topology.
+
+Invariant (holds under every kernel here, matching the reference calculus):
+live sessions are sorted by ``first`` and separated by **strictly more than
+``gap``** — so they are also sorted by ``last``, and completed sessions
+(``last + gap < watermark``) always form a prefix.
+
+Three kernels:
+
+* **in-order ingest** — a batch of ascending tuples chains into sessions
+  wherever the inter-arrival gap exceeds ``gap`` (the in-order
+  specialization of SessionContext.updateContext): one segmented
+  scatter-combine, no data-dependent control flow.
+* **late ingest** — a ``lax.scan`` applying late tuples ONE AT A TIME in
+  arrival order. Sequential on purpose: the reference's session calculus is
+  arrival-order-dependent at exact-gap boundaries (a tuple landing exactly
+  ``gap`` before a session's start extends nothing — SessionWindow.java's
+  update falls through every branch — while the same tuple arriving before
+  that session existed would have seeded it), so a batched merge cannot
+  reproduce it. Late tuples are rare by contract; each step is O(S)
+  vectorized work over the session array.
+* **sweep** — watermark trigger: emit the completed prefix
+  (``[first, last + gap)`` windows, SessionWindow.java:107-116) and compact.
+
+In-order tuples may be processed before interleaved late tuples without
+changing any outcome: an in-order tuple interacts only with the newest
+session (whose ``last`` equals the running max event time, which no late
+tuple can change), and a late tuple's session lookup is unaffected by
+sessions created above the pre-batch maximum.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.aggregates import DeviceAggregateSpec
+from .core import I64_MAX, I64_MIN, _combine_scatter, _lift
+
+
+class SessionState(NamedTuple):
+    """One session window's live sessions as a pytree of device arrays.
+
+    The orphan buffer holds tuples the session calculus DROPS (the
+    exact-gap fall-through in SessionWindow.java's update — see
+    :func:`build_session_late`). In the reference those tuples still live in
+    shared slices, so a session that later merges/extends over their
+    position recovers their values at emission; the orphan buffer
+    reproduces that recovery by position (slice-granularity data loss the
+    reference sporadically exhibits is NOT reproduced — the engine reports
+    the exact aggregate, same policy as PARITY.md deviation 5).
+    """
+
+    first: jnp.ndarray     # i64[S] min observed tuple ts; I64_MAX = unused
+    last: jnp.ndarray      # i64[S] max observed tuple ts; I64_MIN = unused
+    counts: jnp.ndarray    # i64[S] tuples per session
+    partials: tuple        # per agg: f32[S, width]
+    n: jnp.ndarray         # i32 scalar — live session count
+    o_pos: jnp.ndarray     # i64[O] orphan tuple positions; I64_MAX = unused
+    o_partials: tuple      # per agg: f32[O, width] — one lifted tuple each
+    o_n: jnp.ndarray       # i32 scalar — orphan count
+    overflow: jnp.ndarray  # bool scalar — capacity exhausted
+
+
+def init_session_state(aggs: tuple[DeviceAggregateSpec, ...], capacity: int,
+                       orphan_capacity: int = 64,
+                       dtype=jnp.float32) -> SessionState:
+    S, O = capacity, orphan_capacity
+    return SessionState(
+        first=jnp.full((S,), I64_MAX, dtype=jnp.int64),
+        last=jnp.full((S,), I64_MIN, dtype=jnp.int64),
+        counts=jnp.zeros((S,), dtype=jnp.int64),
+        partials=tuple(jnp.full((S, a.width), a.identity, dtype=dtype)
+                       for a in aggs),
+        n=jnp.int32(0),
+        o_pos=jnp.full((O,), I64_MAX, dtype=jnp.int64),
+        o_partials=tuple(jnp.full((O, a.width), a.identity, dtype=dtype)
+                         for a in aggs),
+        o_n=jnp.int32(0),
+        overflow=jnp.bool_(False),
+    )
+
+
+def build_session_ingest(aggs: tuple[DeviceAggregateSpec, ...], gap: int,
+                         capacity: int):
+    """Batched in-order ingest: ``ts`` ascending, every ts at or above the
+    newest session's ``last``. A new session opens where the inter-arrival
+    gap exceeds ``gap`` (inclusive join: ``ts - prev <= gap`` chains, the
+    reference's ``end + gap >= position`` forward extension)."""
+    S = capacity
+    gap_j = jnp.int64(gap)
+
+    def ingest(st: SessionState, ts: jnp.ndarray, vals: jnp.ndarray,
+               valid: jnp.ndarray) -> SessionState:
+        B = ts.shape[0]
+        n = st.n
+        # chain against the NEWEST LIVE session's extent, not the stream
+        # max event time: after a sweep emptied the array (or late tuples
+        # seeded sessions below the max) the two differ, and the reference
+        # chains on the live context only (SessionWindow.java:40-45).
+        open_last = jnp.where(n > 0, st.last[jnp.maximum(n - 1, 0)],
+                              jnp.int64(I64_MIN))
+        prev = jnp.concatenate([open_last[None], ts[:-1]])
+        first_ever = (jnp.arange(B) == 0) & (n == 0)
+        newflag = valid & (first_ever | (ts - prev > gap_j))
+        k = jnp.cumsum(newflag.astype(jnp.int32))
+        pos = jnp.clip((n - 1) + k, 0, S - 1)
+        overflow = st.overflow | (((n - 1) + k[-1]) >= S)
+
+        one = jnp.where(valid, jnp.int64(1), jnp.int64(0))
+        first = st.first.at[pos].min(jnp.where(valid, ts, I64_MAX))
+        last = st.last.at[pos].max(jnp.where(valid, ts, I64_MIN))
+        counts = st.counts.at[pos].add(one)
+        partials = []
+        for agg, part in zip(aggs, st.partials):
+            dense, sparse = _lift(agg, vals, valid)
+            if sparse is None:
+                part = _combine_scatter(part, pos, dense, agg.kind)
+            else:
+                col, v = sparse
+                part = _combine_scatter(part, (pos, col), v, agg.kind)
+            partials.append(part)
+        return st._replace(
+            first=first, last=last, counts=counts, partials=tuple(partials),
+            n=(n + k[-1]).astype(jnp.int32), overflow=overflow)
+
+    return ingest
+
+
+def build_session_ingest_dense(aggs: tuple[DeviceAggregateSpec, ...],
+                               gap: int, capacity: int, runs: int):
+    """In-order session ingest without [B]-lane scatters (the benchmark fast
+    path, same trick as :func:`.core.build_ingest_dense`): when the batch
+    opens fewer than ``runs`` sessions, run boundaries come from two vmapped
+    ``searchsorted``, sum partials from a one-hot MXU matmul, min/max from a
+    masked reduce, and only ``runs`` buffer rows are scattered. Raises the
+    overflow flag when the bound is violated (host falls back)."""
+    S, R = capacity, runs
+    gap_j = jnp.int64(gap)
+
+    def ingest(st: SessionState, ts: jnp.ndarray, vals: jnp.ndarray,
+               valid: jnp.ndarray) -> SessionState:
+        B = ts.shape[0]
+        n = st.n
+        open_last = jnp.where(n > 0, st.last[jnp.maximum(n - 1, 0)],
+                              jnp.int64(I64_MIN))
+        prev = jnp.concatenate([open_last[None], ts[:-1]])
+        first_ever = (jnp.arange(B) == 0) & (n == 0)
+        newflag = valid & (first_ever | (ts - prev > gap_j))
+        k = jnp.cumsum(newflag.astype(jnp.int32))        # run id per lane
+        k_last = k[-1]
+        row_n = jnp.sum(valid.astype(jnp.int32))
+
+        r_idx = jnp.arange(R, dtype=jnp.int32)
+        lo = jnp.searchsorted(k, r_idx, side="left")
+        hi = jnp.minimum(jnp.searchsorted(k, r_idx, side="right") - 1,
+                         row_n - 1)
+        cnt_r = jnp.maximum(hi - lo + 1, 0).astype(jnp.int64)
+        live = cnt_r > 0
+        first_r = ts[jnp.clip(lo, 0, B - 1)]
+        last_r = ts[jnp.clip(hi, 0, B - 1)]
+
+        rows = jnp.clip((n - 1) + r_idx, 0, S - 1)
+        first = st.first.at[rows].min(jnp.where(live, first_r, I64_MAX))
+        last = st.last.at[rows].max(jnp.where(live, last_r, I64_MIN))
+        counts = st.counts.at[rows].add(jnp.where(live, cnt_r, 0))
+
+        partials = []
+        for agg, part in zip(aggs, st.partials):
+            dense, sparse = _lift(agg, vals, valid)
+            if sparse is None:
+                if agg.kind == "sum":
+                    oh = (k[:, None] == r_idx[None, :]).astype(part.dtype)
+                    upd = oh.T @ dense                       # [R, w] — MXU
+                    upd = jnp.where(live[:, None], upd, 0)
+                    part = part.at[rows].add(upd)
+                else:
+                    oh = k[:, None] == r_idx[None, :]
+                    ident = jnp.asarray(agg.identity, part.dtype)
+                    masked = jnp.where(oh[:, :, None], dense[:, None, :],
+                                       ident)                # [B, R, w]
+                    op_ = jnp.min if agg.kind == "min" else jnp.max
+                    upd = op_(masked, axis=0)
+                    upd = jnp.where(live[:, None], upd, ident)
+                    part = _combine_scatter(part, rows, upd, agg.kind)
+            else:
+                # sparse lifts (sketches) scatter into [R, w] — R rows, so
+                # the scatter target is tiny even at 1M-lane batches
+                col, v = sparse
+                part = _combine_scatter(part, (rows[k], col), v, agg.kind)
+            partials.append(part)
+
+        return st._replace(
+            first=first, last=last, counts=counts, partials=tuple(partials),
+            n=(n + k_last).astype(jnp.int32),
+            overflow=(st.overflow | (((n - 1) + k_last) >= S)
+                      | (k_last > R - 1)))
+
+    return ingest
+
+
+def build_session_late(aggs: tuple[DeviceAggregateSpec, ...], gap: int,
+                       capacity: int, late_len: int):
+    """Sequential late-tuple application (lax.scan, arrival order).
+
+    Each step replays SessionContext.updateContext exactly
+    (SessionWindow.java:40-98) against the session array:
+
+    * find the EARLIEST session in reach (``first - gap <= pos <= last +
+      gap`` — the getSession linear scan, vectorized to a masked argmax);
+    * inside ``[first, last]`` → fold the tuple in;
+    * ``first - gap < pos < first`` → extend start, then merge with the
+      previous session when ``last[j-1] + gap >= pos`` (mergeWithPre);
+    * ``last < pos <= last + gap`` → extend end, then merge with the next
+      session when ``pos + gap >= first[j+1]``;
+    * exactly ``pos == first - gap`` (and out of reach of every earlier
+      session) → **no session change**: the reference's update falls through
+      every branch and returns null, and the tuple's slice lands outside
+      every emitted session window — the tuple vanishes from session
+      results. Reproduced bit-for-bit (the count/value still reaches
+      time-grid windows through the grid path).
+    * no session in reach → insert a fresh ``[pos, pos]`` session at its
+      sorted position.
+    """
+    S, L = capacity, late_len
+    gap_j = jnp.int64(gap)
+    idx = jnp.arange(S)
+
+    def shift_left(arr, b, flag, fill):
+        """Delete row b (rows above slide down) where flag."""
+        nxt = jnp.concatenate([arr[1:], jnp.full_like(arr[:1], fill)])
+        return jnp.where(_bcast(flag & (idx >= b), arr), nxt, arr)
+
+    def shift_right(arr, p, flag, fill):
+        """Open row p (rows at/above slide up) where flag."""
+        prv = jnp.concatenate([jnp.full_like(arr[:1], fill), arr[:-1]])
+        return jnp.where(_bcast(flag & (idx > p), arr), prv, arr)
+
+    def _bcast(mask, arr):
+        return mask if arr.ndim == 1 else mask[:, None]
+
+    def step(carry, x):
+        st = carry
+        pos, valid, lifts = x
+        live = idx < st.n
+        reach = live & (st.first - gap_j <= pos) & (pos <= st.last + gap_j)
+        has = reach.any()
+        j = jnp.argmax(reach)                    # earliest session in reach
+        fj, lj = st.first[j], st.last[j]
+        inside = valid & has & (fj <= pos) & (pos <= lj)
+        ext_s = valid & has & (fj > pos) & (fj - gap_j < pos)
+        ext_e = valid & has & (lj < pos) & (pos <= lj + gap_j)
+        new = valid & ~has
+        touch = inside | ext_s | ext_e
+        # the exact-gap fall-through (pos == first - gap, out of reach of
+        # every earlier session): no session changes, but the tuple's value
+        # must be recoverable by a session that later covers its position —
+        # park it in the orphan buffer (consumed or GC'd at sweep time)
+        dropped = valid & has & ~touch
+
+        jm1 = jnp.maximum(j - 1, 0)
+        jp1 = jnp.minimum(j + 1, S - 1)
+        merge_pre = ext_s & (j > 0) & (st.last[jm1] + gap_j >= pos)
+        merge_nxt = ext_e & (j + 1 < st.n) & (pos + gap_j >= st.first[jp1])
+
+        onej = idx == j
+        first = jnp.where(onej & ext_s, pos, st.first)
+        last = jnp.where(onej & ext_e, pos, st.last)
+        counts = st.counts + jnp.where(onej & touch, 1, 0)
+        partials = []
+        for agg, part, lift in zip(aggs, st.partials, lifts):
+            if agg.is_sparse:
+                col, v = lift
+                m2 = (onej & touch)[:, None] \
+                    & (jnp.arange(part.shape[1]) == col)[None, :]
+            else:
+                v = lift
+                m2 = (onej & touch)[:, None]
+            if agg.kind == "sum":
+                part = jnp.where(m2, part + v, part)
+            elif agg.kind == "min":
+                part = jnp.where(m2, jnp.minimum(part, v), part)
+            else:
+                part = jnp.where(m2, jnp.maximum(part, v), part)
+            partials.append(part)
+
+        # -- merge (at most one per tuple, like the reference) -------------
+        do_merge = merge_pre | merge_nxt
+        a = jnp.where(merge_pre, jm1, j)         # absorbing row
+        b = a + 1                                # deleted row
+        onea = idx == a
+        last = jnp.where(onea & do_merge, last[jnp.minimum(b, S - 1)], last)
+        counts = jnp.where(onea & do_merge,
+                           counts[a] + counts[jnp.minimum(b, S - 1)], counts)
+        merged = []
+        for agg, part in zip(aggs, partials):
+            pa = part[a]
+            pb = part[jnp.minimum(b, S - 1)]
+            comb = (pa + pb if agg.kind == "sum"
+                    else jnp.minimum(pa, pb) if agg.kind == "min"
+                    else jnp.maximum(pa, pb))
+            merged.append(jnp.where((onea & do_merge)[:, None], comb, part))
+        first = shift_left(first, b, do_merge, I64_MAX)
+        last = shift_left(last, b, do_merge, I64_MIN)
+        counts = shift_left(counts, b, do_merge, 0)
+        merged = [shift_left(p, b, do_merge, a.identity)
+                  for a, p in zip(aggs, merged)]
+
+        # -- insert (exclusive with merge: only when nothing in reach) -----
+        p = jnp.searchsorted(first, pos, side="left").astype(idx.dtype)
+        first = shift_right(first, p, new, I64_MAX)
+        last = shift_right(last, p, new, I64_MIN)
+        counts = shift_right(counts, p, new, 0)
+        inserted = []
+        for agg, part, lift in zip(aggs, merged, lifts):
+            part = shift_right(part, p, new, agg.identity)
+            if agg.is_sparse:
+                col, v = lift
+                m2 = (idx == p)[:, None] \
+                    & (jnp.arange(part.shape[1]) == col)[None, :] & new
+                base = jnp.where((idx == p)[:, None] & new,
+                                 jnp.asarray(agg.identity, part.dtype), part)
+                part = jnp.where(m2, v, base)
+            else:
+                part = jnp.where((idx == p)[:, None] & new, lift, part)
+            inserted.append(part)
+        onep = idx == p
+        first = jnp.where(onep & new, pos, first)
+        last = jnp.where(onep & new, pos, last)
+        counts = jnp.where(onep & new, 1, counts)
+
+        # -- orphan append (exclusive with every other action) -------------
+        O = st.o_pos.shape[0]
+        oidx = jnp.arange(O)
+        oneo = (oidx == st.o_n) & dropped
+        o_pos = jnp.where(oneo, pos, st.o_pos)
+        o_partials = []
+        for agg, part, lift in zip(aggs, st.o_partials, lifts):
+            if agg.is_sparse:
+                col, v = lift
+                m2 = oneo[:, None] \
+                    & (jnp.arange(part.shape[1]) == col)[None, :]
+                base = jnp.where(oneo[:, None],
+                                 jnp.asarray(agg.identity, part.dtype), part)
+                part = jnp.where(m2, v, base)
+            else:
+                part = jnp.where(oneo[:, None], lift, part)
+            o_partials.append(part)
+
+        n2 = st.n + jnp.where(new, 1, 0) - jnp.where(do_merge, 1, 0)
+        o_n2 = st.o_n + jnp.where(dropped, 1, 0)
+        overflow = st.overflow | (new & (st.n >= S)) \
+            | (dropped & (st.o_n >= O))
+        return SessionState(first=first, last=last, counts=counts,
+                            partials=tuple(inserted),
+                            n=n2.astype(jnp.int32),
+                            o_pos=o_pos, o_partials=tuple(o_partials),
+                            o_n=o_n2.astype(jnp.int32),
+                            overflow=overflow), None
+
+    # lifts are precomputed vectorized OUTSIDE the scan (one lift per agg
+    # over the [L] late lanes), so each step only gathers its row.
+    def ingest(st: SessionState, ts: jnp.ndarray, vals: jnp.ndarray,
+               valid: jnp.ndarray) -> SessionState:
+        lifts = []
+        for agg in aggs:
+            if agg.is_sparse:
+                col, v = agg.lift_sparse(vals)
+                lifts.append((col.astype(jnp.int32),
+                              jnp.where(valid, v, agg.identity)))
+            else:
+                lifted = agg.lift_dense(vals)
+                lifts.append(jnp.where(valid[:, None], lifted, agg.identity))
+        out, _ = jax.lax.scan(step, st, (ts, valid, tuple(lifts)))
+        return out
+
+    return ingest
+
+
+def build_session_sweep(aggs: tuple[DeviceAggregateSpec, ...], gap: int,
+                        capacity: int, emit_cap: int):
+    """Watermark trigger: emit sessions with ``last + gap < watermark`` as
+    ``[first, last + gap)`` windows (SessionWindow.java:107-116) and compact
+    the array. Completed sessions are a prefix (see module invariant), so
+    emission is a prefix gather and compaction a masked roll.
+
+    Orphaned tuples (exact-gap drops) whose position an emitted window
+    covers fold into that window's value — the engine equivalent of the
+    reference recovering a context-dropped tuple through slice containment
+    when a session later expands over it. Consumed orphans and orphans
+    behind ``gc_bound`` (no future tuple may create a session reaching
+    them) are compacted away.
+
+    Returns (new_state, m, starts[E], ends[E], counts[E], partials…[E]);
+    rows at index >= m are padding.
+    """
+    S, E = capacity, emit_cap
+    gap_j = jnp.int64(gap)
+
+    def sweep(st: SessionState, wm: jnp.ndarray, gc_bound: jnp.ndarray):
+        live = jnp.arange(S) < st.n
+        done = live & (st.last + gap_j < wm)
+        m = jnp.sum(done.astype(jnp.int32))
+        idx = jnp.arange(E)
+        sel = jnp.clip(idx, 0, S - 1)
+        e_starts = jnp.where(idx < m, st.first[sel], I64_MAX)
+        e_ends = jnp.where(idx < m, st.last[sel] + gap_j, I64_MAX)
+        e_counts = jnp.where(idx < m, st.counts[sel], 0)
+        e_partials = [p[sel] for p in st.partials]
+        em_overflow = m > E
+
+        # -- orphan recovery (at most one window covers an orphan) ---------
+        O = st.o_pos.shape[0]
+        o_live = jnp.arange(O) < st.o_n
+        cov = (o_live[None, :] & (e_starts[:, None] <= st.o_pos[None, :])
+               & (st.o_pos[None, :] < e_ends[:, None]))        # [E, O]
+        e_counts = e_counts + jnp.sum(cov, axis=1)
+        for i, (agg, op_) in enumerate(zip(aggs, st.o_partials)):
+            if agg.kind == "sum":
+                e_partials[i] = e_partials[i] \
+                    + cov.astype(op_.dtype) @ op_              # [E, w] MXU
+            else:
+                ident = jnp.asarray(agg.identity, op_.dtype)
+                masked = jnp.where(cov[:, :, None], op_[None, :, :], ident)
+                red = (jnp.min if agg.kind == "min" else jnp.max)(masked,
+                                                                 axis=1)
+                e_partials[i] = (jnp.minimum if agg.kind == "min"
+                                 else jnp.maximum)(e_partials[i], red)
+        consumed = jnp.any(cov, axis=0)
+        # an orphan stays alive while (a) a still-live session's eventual
+        # window [first, last+gap) could cover it, or (b) an in-contract
+        # future tuple (ts >= gc_bound = wm - lateness) could seed a session
+        # reaching it; otherwise it is dead and compacted away
+        live_rows = jnp.arange(S) >= m
+        live_mask = live_rows & (jnp.arange(S) < st.n)
+        cov_live = jnp.any(
+            live_mask[:, None] & (st.first[:, None] <= st.o_pos[None, :])
+            & (st.o_pos[None, :] < st.last[:, None] + gap_j), axis=0)
+        keep_o = o_live & ~consumed \
+            & (cov_live | (st.o_pos >= gc_bound - gap_j))
+        order = jnp.argsort(~keep_o, stable=True)      # kept orphans first
+        o_n2 = jnp.sum(keep_o.astype(jnp.int32)).astype(jnp.int32)
+        o_pos2 = jnp.where(jnp.arange(O) < o_n2, st.o_pos[order], I64_MAX)
+        o_partials2 = tuple(
+            jnp.where((jnp.arange(O) < o_n2)[:, None], p[order],
+                      jnp.asarray(a.identity, p.dtype))
+            for a, p in zip(aggs, st.o_partials))
+
+        def roll(a, fill):
+            rolled = jnp.roll(a, -m, axis=0)
+            keep = jnp.arange(a.shape[0]) < (a.shape[0] - m)
+            if a.ndim == 1:
+                return jnp.where(keep, rolled, fill)
+            return jnp.where(keep[:, None], rolled, fill)
+
+        new_state = SessionState(
+            first=roll(st.first, I64_MAX),
+            last=roll(st.last, I64_MIN),
+            counts=roll(st.counts, 0),
+            partials=tuple(roll(p, a.identity)
+                           for a, p in zip(aggs, st.partials)),
+            n=(st.n - m).astype(jnp.int32),
+            o_pos=o_pos2, o_partials=o_partials2, o_n=o_n2,
+            overflow=st.overflow | em_overflow,
+        )
+        return new_state, m, e_starts, e_ends, e_counts, tuple(e_partials)
+
+    return sweep

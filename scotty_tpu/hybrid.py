@@ -52,28 +52,23 @@ class HybridWindowOperator(WindowOperator):
     def _device_realizable(self) -> bool:
         from .core.windows import SessionWindow
 
-        session_gaps = {int(w.gap) for w in self.windows
-                        if isinstance(w, SessionWindow)}
-        if session_gaps:
-            # the device session path is the eager pure-session case
-            # (SliceFactory.java:17-22 isSessionWindowCase): SESSION windows
-            # only (any number of gaps — one device state per gap), Time
-            # measure, and an in-order stream declared by the caller
-            if not self.assume_inorder \
-                    or not all(isinstance(w, SessionWindow)
-                               and w.measure == WindowMeasure.Time
-                               for w in self.windows):
+        for w in self.windows:
+            if isinstance(w, SessionWindow):
+                # device sessions are fully general (bounded active-session
+                # arrays, in- or out-of-order, any mix with time-grid
+                # windows — engine/sessions.py); only the Count measure
+                # stays host-only
+                if w.measure != WindowMeasure.Time:
+                    return False
+                continue
+            if not isinstance(w, (TumblingWindow, SlidingWindow,
+                                  FixedBandWindow)):
                 return False
-        else:
-            for w in self.windows:
-                if not isinstance(w, (TumblingWindow, SlidingWindow,
-                                      FixedBandWindow)):
-                    return False
-                if w.measure != WindowMeasure.Time and not self.assume_inorder:
-                    return False            # OOO + count measure: host only
-                if (w.measure == WindowMeasure.Count
-                        and isinstance(w, FixedBandWindow)):
-                    return False
+            if w.measure != WindowMeasure.Time and not self.assume_inorder:
+                return False            # OOO + count measure: host only
+            if (w.measure == WindowMeasure.Count
+                    and isinstance(w, FixedBandWindow)):
+                return False
         for a in self.aggregations:
             if a.device_spec() is None:
                 return False

@@ -28,6 +28,19 @@ def _state_to_host(state) -> dict:
     }
 
 
+def _full_state(op):
+    """The operator's complete device state as one pytree: the grid slice
+    buffer (None for pure-session workloads) plus every registered session
+    window's active-session array (round 3 — engine/sessions.py)."""
+    return {"grid": op._state,
+            "sessions": list(getattr(op, "_session_states", []))}
+
+
+def _set_full_state(op, tree) -> None:
+    op._state = tree["grid"]
+    op._session_states = list(tree["sessions"])
+
+
 def _host_clocks(op) -> dict:
     """The TpuWindowOperator's host-side clock mirrors: without them a
     restored operator thinks its store is empty (``_host_met is None``
@@ -62,9 +75,9 @@ def save_engine_operator(op, path: str) -> None:
     op._flush()
     import jax
 
-    if op._state is None:
+    if not op._built:
         raise ValueError("operator not built yet; nothing to checkpoint")
-    leaves = jax.tree.flatten(op._state)[0]
+    leaves = jax.tree.flatten(_full_state(op))[0]
     np.savez(os.path.join(path, "state.npz"),
              **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
     meta = {
@@ -89,11 +102,12 @@ def restore_engine_operator(op, path: str) -> None:
         meta = json.load(f)
     data = np.load(os.path.join(path, "state.npz"))
     leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
-    treedef = jax.tree.structure(op._state)
-    template = jax.tree.flatten(op._state)[0]
+    full = _full_state(op)
+    treedef = jax.tree.structure(full)
+    template = jax.tree.flatten(full)[0]
     cast = [np.asarray(l, dtype=np.asarray(t).dtype)
             for l, t in zip(leaves, template)]
-    op._state = jax.tree.unflatten(treedef, cast)
+    _set_full_state(op, jax.tree.unflatten(treedef, cast))
     _restore_meta(op, meta)
 
 
@@ -107,7 +121,7 @@ def save_engine_operator_orbax(op, path: str) -> None:
     op._flush()
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(os.path.join(os.path.abspath(path), "orbax"),
-               op._state, force=True)
+               _full_state(op), force=True)
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({"last_watermark": op._last_watermark,
                    "max_lateness": op.max_lateness,
@@ -127,8 +141,9 @@ def restore_engine_operator_orbax(op, path: str) -> None:
     if not op._built:
         op._build()
     ckptr = ocp.PyTreeCheckpointer()
-    op._state = ckptr.restore(os.path.join(os.path.abspath(path), "orbax"),
-                              item=op._state)
+    restored = ckptr.restore(os.path.join(os.path.abspath(path), "orbax"),
+                             item=_full_state(op))
+    _set_full_state(op, restored)
     _restore_meta(op, meta)
 
 

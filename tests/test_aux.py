@@ -39,8 +39,16 @@ def test_hybrid_picks_device_for_context_free_time():
                    [SumAggregation(), CountAggregation()])
 
 
-def test_hybrid_picks_host_for_sessions():
-    assert not _decide([SessionWindow(Time, 10)], [SumAggregation()])
+def test_hybrid_picks_device_for_sessions():
+    # round 3: device sessions are fully general (engine/sessions.py) —
+    # pure, mixed with time-grid windows, in- or out-of-order
+    assert _decide([SessionWindow(Time, 10)], [SumAggregation()])
+    assert _decide([SessionWindow(Time, 10), TumblingWindow(Time, 40)],
+                   [SumAggregation()])
+
+
+def test_hybrid_picks_host_for_count_measure_sessions():
+    assert not _decide([SessionWindow(Count, 10)], [SumAggregation()])
 
 
 def test_hybrid_picks_host_for_count_measure():
@@ -54,11 +62,25 @@ def test_hybrid_picks_host_for_host_only_aggregate():
 def test_hybrid_runs_host_path_end_to_end():
     op = HybridWindowOperator()
     op.add_window_assigner(SessionWindow(Time, 5))
-    op.add_aggregation(SumAggregation())
+    op.add_aggregation(QuantileAggregation(0.5))   # host-only aggregate
     op.process_element(1, 0)
     op.process_element(2, 2)
     op.process_element(5, 50)
     assert op.backend == "host"
+    res = op.process_watermark(100)
+    wins = [(w.get_start(), w.get_end(), w.get_agg_values()[0])
+            for w in res if w.has_value()]
+    assert (0, 7, 2) in wins           # median of {1, 2}
+
+
+def test_hybrid_runs_device_sessions_end_to_end():
+    op = HybridWindowOperator()
+    op.add_window_assigner(SessionWindow(Time, 5))
+    op.add_aggregation(SumAggregation())
+    op.process_element(1, 0)
+    op.process_element(2, 2)
+    op.process_element(5, 50)
+    assert op.backend == "device"
     res = op.process_watermark(100)
     wins = [(w.get_start(), w.get_end(), w.get_agg_values()[0])
             for w in res if w.has_value()]
@@ -219,16 +241,16 @@ def test_bench_small_run_device_vs_simulator():
     assert r_dev.n_windows_emitted == r_sim.n_windows_emitted
 
 
-def test_hybrid_routes_pure_session_to_device_when_inorder():
-    """With an in-order declaration, the pure-session workload runs on the
-    engine's device session path (the eager session case,
-    SliceFactory.java:17-22); without it, conservatively on the host."""
+def test_hybrid_routes_sessions_to_device():
+    """Session workloads run on the engine's device session path with no
+    in-order declaration required (round 3: fully general device sessions);
+    a forced host backend stays available and agrees."""
     from scotty_tpu.engine import EngineConfig
 
     cfg = EngineConfig(capacity=512, batch_size=32, annex_capacity=64,
                        min_trigger_pad=32)
-    dev = HybridWindowOperator(engine_config=cfg, assume_inorder=True)
-    host = HybridWindowOperator(engine_config=cfg)
+    dev = HybridWindowOperator(engine_config=cfg)
+    host = HybridWindowOperator(engine_config=cfg, force_backend="host")
     for op in (dev, host):
         op.add_window_assigner(SessionWindow(Time, 5))
         op.add_aggregation(SumAggregation())
