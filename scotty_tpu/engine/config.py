@@ -39,6 +39,17 @@ class EngineConfig:
     #: Partial-aggregate dtype on device.
     partial_dtype: str = "float32"
 
+    #: Record-buffer capacity for count-measure workloads (0 = 4×capacity).
+    #: Count windows aggregate ts-sorted rank ranges, so the engine retains
+    #: raw (ts, value) records while count windows are registered — the
+    #: device analogue of the reference's lazy slices (record retention is
+    #: forced by count measure in its decision tree, SliceFactory.java:17-22).
+    record_capacity: int = 0
+
+    @property
+    def records(self) -> int:
+        return self.record_capacity or 4 * self.capacity
+
     #: Run bound for the dense in-order ingest kernel (ingest_dense): an
     #: in-order batch touching < this many NEW slices takes the
     #: scatter-free path (int64 scatters are the dominant ingest cost on

@@ -390,7 +390,19 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
 
         io_valid = valid & ~late
         one = jnp.where(io_valid, jnp.int64(1), jnp.int64(0))
-        starts = state.starts.at[pos].min(jnp.where(valid, io_s, I64_MAX))
+        if spec.count_periods and not spec.has_time_grid:
+            # pure-count slices: only count-cutting lanes (and the stream's
+            # first tuple, matching the reference's bootstrap-at-first-ts)
+            # define a slice start. Non-cut lanes carry grid_start(ts) == 0,
+            # and min-scattering that into the open slice would zero every
+            # start — breaking the ts-based GC bound and watermark probe.
+            first_lane = (jnp.arange(B) == 0) & (n == 0)
+            start_val = jnp.where(count_flag & ~late, io_s,
+                                  jnp.where(first_lane, ts, I64_MAX))
+        else:
+            start_val = io_s
+        starts = state.starts.at[pos].min(
+            jnp.where(valid, start_val, I64_MAX))
         # pinned lanes don't define a new slice: keep the open slice's
         # closing edge as recorded at creation (post-dynamic-addition it is
         # coarser than next_edge under the current union grid)
@@ -606,7 +618,35 @@ def build_ingest_dense(spec: EngineSpec, capacity: int, runs: int):
 # ---------------------------------------------------------------------------
 
 
-def build_query(spec: EngineSpec, capacity: int, annex_capacity: int):
+def _range_combine(tbl: jnp.ndarray, lo: jnp.ndarray, length: jnp.ndarray,
+                   op, ident, levels: int):
+    """Min/max over row ranges [lo, lo+length) of ``tbl`` via a log-sweep
+    sparse table: each query answered at level floor(log2(len)) with two
+    gathers; the table doubles per level."""
+    N = tbl.shape[0]
+    kbits = jnp.where(
+        length > 0,
+        jnp.floor(jnp.log2(jnp.maximum(length, 1)
+                           .astype(jnp.float64))).astype(jnp.int32),
+        -1)
+    res = jnp.full((lo.shape[0], tbl.shape[1]), ident, tbl.dtype)
+    hi = lo + length
+    for lvl in range(levels):
+        size = 1 << lvl
+        sel = (kbits == lvl)
+        a = tbl[jnp.clip(lo, 0, N - 1)]
+        b = tbl[jnp.clip(hi - size, 0, N - 1)]
+        res = jnp.where(sel[:, None], op(a, b), res)
+        if size < N:
+            shifted = jnp.concatenate(
+                [tbl[size:],
+                 jnp.full((size, tbl.shape[1]), ident, tbl.dtype)])
+            tbl = op(tbl, shifted)
+    return res
+
+
+def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
+                record_capacity: int = 0):
     """All triggered windows answered at once.
 
     Replaces LazyAggregateStore.aggregate's O(#slices × #windows) nested
@@ -614,12 +654,23 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int):
     - prefix-sum range queries for sum-like partials,
     - a log-sweep sparse table for min/max-like partials,
     over the sorted slice buffer, plus a masked fold over the (small) annex.
+
+    With ``record_capacity`` set (count-measure workloads), count-window
+    VALUES come from ts-sorted rank ranges of the record buffer — the
+    closed form of the reference's out-of-order ripple (see
+    :class:`RecordBuffer`); slice counts still provide containment and
+    emptiness.
     """
     C, A = capacity, annex_capacity
-    L = max(1, (C - 1).bit_length())
+    # levels must include log2(N) itself: a range spanning the WHOLE table
+    # (length == N, N a power of two) is answered at that level
+    L = max(1, C.bit_length())
+    RC = record_capacity
+    use_rec = RC > 0 and bool(spec.count_periods)
+    Lr = max(1, RC.bit_length()) if use_rec else 0
 
-    def query(state: SliceBufferState, ws: jnp.ndarray, we: jnp.ndarray,
-              tmask: jnp.ndarray, is_count: jnp.ndarray):
+    def answer(state: SliceBufferState, rec, ws: jnp.ndarray,
+               we: jnp.ndarray, tmask: jnp.ndarray, is_count: jnp.ndarray):
         lo_t = jnp.searchsorted(state.starts, ws, side="left")
         # Upper containment bound per the reference: a slice is covered iff
         # window.end > slice.tLast (AggregateWindowState.java:25-31).
@@ -657,41 +708,56 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int):
         # annex-merge kernel before any query once a late tuple was ingested
         # (an O(T × A) masked annex scan in this kernel costs seconds at
         # benchmark trigger counts — measured 2.2 s at T=65k, A=4k).
+        if use_rec:
+            live_r = jnp.arange(RC) < rec.n
+            # rank range of the covered slices: c_start of the first covered
+            # slice (absolute counts) → buffer row; extent = covered count
+            rlo = jnp.clip(state.c_start[jnp.clip(lo, 0, C - 1)] - rec.base,
+                           0, RC)
+            rlen = jnp.where(is_count, jnp.clip(cnt, 0, RC - rlo), 0)
+
         results = []
         for agg, part in zip(spec.aggs, state.partials):
+            op = jnp.minimum if agg.kind == "min" else jnp.maximum
+            ident = jnp.asarray(agg.identity, part.dtype)
             if agg.kind == "sum":
                 P = jnp.concatenate(
                     [jnp.zeros((1, part.shape[1]), part.dtype),
                      jnp.cumsum(part, axis=0)])
                 res = P[hi] - P[lo]
             else:
-                op = jnp.minimum if agg.kind == "min" else jnp.maximum
-                ident = jnp.asarray(agg.identity, part.dtype)
-                # log-sweep sparse table: window answered at level
-                # floor(log2(len)) with two gathers; table doubles per level.
-                kbits = jnp.where(
-                    length > 0,
-                    jnp.floor(jnp.log2(jnp.maximum(length, 1)
-                                       .astype(jnp.float64))).astype(jnp.int32),
-                    -1)
-                res = jnp.full((ws.shape[0], part.shape[1]), ident, part.dtype)
-                tbl = part
-                for lvl in range(L):
-                    size = 1 << lvl
-                    sel = (kbits == lvl)
-                    a = tbl[jnp.clip(lo, 0, C - 1)]
-                    b = tbl[jnp.clip(hi - size, 0, C - 1)]
-                    res = jnp.where(sel[:, None], op(a, b), res)
-                    if size < C:
-                        shifted = jnp.concatenate(
-                            [tbl[size:],
-                             jnp.full((size, part.shape[1]), ident, part.dtype)])
-                        tbl = op(tbl, shifted)
-            results.append(jnp.where(tmask[:, None], res,
-                                     jnp.asarray(agg.identity, res.dtype)))
+                res = _range_combine(part, lo, length, op, agg.identity, L)
+            if use_rec:
+                # count windows: aggregate the ts-sorted rank range directly
+                if agg.is_sparse:
+                    col, v = agg.lift_sparse(rec.rvals)
+                    lifted = jnp.full((RC, part.shape[1]), agg.identity,
+                                      part.dtype)
+                    lifted = _combine_scatter(
+                        lifted, (jnp.arange(RC), col),
+                        jnp.where(live_r, v, agg.identity), agg.kind)
+                else:
+                    lifted = agg.lift_dense(rec.rvals)
+                    lifted = jnp.where(live_r[:, None], lifted, agg.identity)
+                if agg.kind == "sum":
+                    Pr = jnp.concatenate(
+                        [jnp.zeros((1, part.shape[1]), part.dtype),
+                         jnp.cumsum(lifted, axis=0)])
+                    rres = Pr[rlo + rlen] - Pr[rlo]
+                else:
+                    rres = _range_combine(lifted, rlo, rlen, op,
+                                          agg.identity, Lr)
+                res = jnp.where(is_count[:, None], rres, res)
+            results.append(jnp.where(tmask[:, None], res, ident))
 
         return jnp.where(tmask, cnt, 0), tuple(results)
 
+    if use_rec:
+        def query(state, rec, ws, we, tmask, is_count):
+            return answer(state, rec, ws, we, tmask, is_count)
+    else:
+        def query(state, ws, we, tmask, is_count):
+            return answer(state, None, ws, we, tmask, is_count)
     return query
 
 
@@ -797,6 +863,105 @@ def build_gc(spec: EngineSpec, capacity: int, annex_capacity: int):
         )
 
     return gc
+
+# ---------------------------------------------------------------------------
+# Record buffer (count-measure workloads)
+# ---------------------------------------------------------------------------
+
+
+class RecordBuffer(NamedTuple):
+    """Raw (ts, value) records in ascending-ts order — retained only while
+    count-measure windows are registered, mirroring the reference's lazy
+    record retention (SliceFactory.java:17-22: count measure forces lazy
+    slices). Count windows aggregate ts-sorted RANK ranges: the reference's
+    out-of-order ripple (SliceManager.java:77-85) shifts the ts-max element
+    of every later slice forward so each slice keeps its fixed count range —
+    i.e. after any repairs, slice k holds exactly the ts-sorted ranks
+    ``[c_start_k, c_start_k + counts_k)``. The engine answers count windows
+    directly from this buffer instead of materializing the shifts."""
+
+    rts: jnp.ndarray      # i64[RC] record timestamps, ascending; pad I64_MAX
+    rvals: jnp.ndarray    # f32[RC] record values
+    n: jnp.ndarray        # i32 scalar — live record count
+    base: jnp.ndarray     # i64 scalar — absolute count index of row 0
+    overflow: jnp.ndarray
+
+
+def init_records(record_capacity: int) -> RecordBuffer:
+    RC = record_capacity
+    return RecordBuffer(
+        rts=jnp.full((RC,), I64_MAX, dtype=jnp.int64),
+        rvals=jnp.zeros((RC,), dtype=jnp.float32),
+        n=jnp.int32(0),
+        base=jnp.int64(0),
+        overflow=jnp.bool_(False),
+    )
+
+
+def build_record_merge(record_capacity: int):
+    """Merge a ts-sorted batch into the sorted record buffer (stable:
+    existing records precede batch records at equal ts — insertion order,
+    like the reference's TreeSet walk)."""
+    RC = record_capacity
+
+    def merge(rec: RecordBuffer, ts: jnp.ndarray, vals: jnp.ndarray,
+              valid: jnp.ndarray) -> RecordBuffer:
+        B = ts.shape[0]
+        n = rec.n
+        live = jnp.arange(RC) < n
+        bts = jnp.where(valid, ts, I64_MAX)
+        nb = jnp.sum(valid.astype(jnp.int32))
+        # final position of each existing record: own rank + batch records
+        # strictly before it (ties: batch goes after → side='left')
+        pos_old = jnp.arange(RC) + jnp.searchsorted(bts, rec.rts,
+                                                    side="left")
+        pos_old = jnp.where(live, pos_old, RC)          # dead rows drop
+        # final position of each batch record: own rank + existing records
+        # at-or-before it (side='right')
+        pos_new = jnp.arange(B) + jnp.searchsorted(
+            jnp.where(live, rec.rts, I64_MAX), bts, side="right")
+        pos_new = jnp.where(valid, pos_new, RC)
+        rts = jnp.full((RC,), I64_MAX, jnp.int64)
+        rts = rts.at[pos_old].set(rec.rts, mode="drop")
+        rts = rts.at[pos_new].set(bts, mode="drop")
+        rvals = jnp.zeros((RC,), rec.rvals.dtype)
+        rvals = rvals.at[pos_old].set(rec.rvals, mode="drop")
+        rvals = rvals.at[pos_new].set(vals.astype(rec.rvals.dtype),
+                                      mode="drop")
+        return RecordBuffer(
+            rts=rts, rvals=rvals, n=(n + nb).astype(jnp.int32),
+            base=rec.base, overflow=rec.overflow | ((n + nb) > RC))
+
+    return merge
+
+
+def build_record_gc(capacity: int, record_capacity: int):
+    """Drop records behind the slice-GC bound, keeping ranks aligned with
+    the surviving slices: the new base is the first surviving slice's
+    ``c_start`` (computed from the PRE-GC slice buffer, same bound as
+    :func:`build_gc`)."""
+    C, RC = capacity, record_capacity
+
+    def rgc(state: SliceBufferState, rec: RecordBuffer,
+            bound: jnp.ndarray) -> RecordBuffer:
+        idx = jnp.searchsorted(state.starts, bound, side="right") - 1
+        k = jnp.clip(idx, 0, jnp.maximum(state.n_slices - 1, 0))
+        new_base = state.c_start[k]
+        new_base = jnp.where(new_base < I64_MAX, new_base, rec.base)
+        d = jnp.clip(new_base - rec.base, 0, RC).astype(jnp.int32)
+
+        def roll(a, fill):
+            rolled = jnp.roll(a, -d, axis=0)
+            keep = jnp.arange(a.shape[0]) < (a.shape[0] - d)
+            return jnp.where(keep, rolled, fill)
+
+        return RecordBuffer(
+            rts=roll(rec.rts, I64_MAX), rvals=roll(rec.rvals, 0),
+            n=(rec.n - d).astype(jnp.int32), base=new_base,
+            overflow=rec.overflow)
+
+    return rgc
+
 
 # ---------------------------------------------------------------------------
 # Watermark → count probe

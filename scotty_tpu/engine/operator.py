@@ -82,7 +82,8 @@ def _session_dense_kernel(aggs, gap: int, capacity: int, runs: int):
     return hit
 
 
-def _kernels(spec, capacity: int, annex_capacity: int):
+def _kernels(spec, capacity: int, annex_capacity: int,
+             record_capacity: int = 0):
     """Jitted kernels shared across operator instances with the same static
     spec — compilation is the dominant cost of small runs/tests."""
     import jax
@@ -90,13 +91,14 @@ def _kernels(spec, capacity: int, annex_capacity: int):
 
     key = (spec.periods, spec.bands, spec.count_periods, spec.session_gaps,
            spec.offset_periods, tuple(a.token for a in spec.aggs), capacity,
-           annex_capacity)
+           annex_capacity, record_capacity)
     hit = _KERNEL_CACHE.get(key)
     if hit is None:
         hit = (
             jax.jit(ec.build_ingest(spec, capacity, annex_capacity),
                     donate_argnums=0),
-            jax.jit(ec.build_query(spec, capacity, annex_capacity)),
+            jax.jit(ec.build_query(spec, capacity, annex_capacity,
+                                   record_capacity)),
             jax.jit(ec.build_gc(spec, capacity, annex_capacity)),
             jax.jit(ec.build_count_probe(spec, capacity)),
             jax.jit(ec.build_annex_merge(spec, capacity, annex_capacity),
@@ -106,6 +108,24 @@ def _kernels(spec, capacity: int, annex_capacity: int):
             jax.jit(ec.build_ingest(spec, capacity, annex_capacity,
                                     assume_inorder=True),
                     donate_argnums=0),
+        )
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
+def _record_kernels(record_capacity: int, capacity: int):
+    """Jitted record-buffer kernels (count-measure workloads), cached."""
+    import jax
+    from . import core as ec
+
+    key = ("records", record_capacity, capacity)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        hit = (
+            jax.jit(ec.build_record_merge(record_capacity),
+                    donate_argnums=0),
+            jax.jit(ec.build_record_gc(capacity, record_capacity),
+                    donate_argnums=1),
         )
         _KERNEL_CACHE[key] = hit
     return hit
@@ -242,8 +262,10 @@ class TpuWindowOperator(WindowOperator):
                                          window.clear_delay())
         self._spec = self._grid_spec = self._compute_spec()
         C, A = self.config.capacity, self.config.annex_capacity
+        RCap = self.config.records if self._has_count else 0
         (self._ingest, self._query, self._gc, self._count_at,
-         self._merge, self._ingest_inorder) = _kernels(self._grid_spec, C, A)
+         self._merge, self._ingest_inorder) = _kernels(self._grid_spec, C, A,
+                                                       RCap)
         # the dense fast path closes over the union grid too
         self._dense_runs = self.config.dense_ingest_runs \
             if dense_eligible(self._grid_spec) else 0
@@ -320,11 +342,19 @@ class TpuWindowOperator(WindowOperator):
         self._has_grid = (self._grid_spec.has_time_grid
                           or bool(self._grid_spec.count_periods))
         self._pure_session = bool(self._session_windows) and not self._has_grid
+        self._has_count = bool(self._grid_spec.count_periods)
+        self._rec = None
         if self._has_grid:
+            RCap = self.config.records if self._has_count else 0
             self._state = ec.init_state(self._grid_spec, C, A)
             (self._ingest, self._query, self._gc, self._count_at,
              self._merge, self._ingest_inorder) = _kernels(self._grid_spec,
-                                                           C, A)
+                                                           C, A, RCap)
+            if self._has_count:
+                # count windows aggregate ts-sorted rank ranges — retain
+                # records (the reference's lazy-slice retention)
+                self._rec = ec.init_records(RCap)
+                self._rec_merge, self._rec_gc = _record_kernels(RCap, C)
         else:
             self._state = None
         if self._session_windows:
@@ -353,7 +383,6 @@ class TpuWindowOperator(WindowOperator):
             if (self._has_grid and dense_eligible(self._grid_spec)) else 0
         self._min_grid = min_grid_period(self._grid_spec)
         self._ingest_dense = None       # built lazily on first eligible batch
-        self._has_count = bool(self._grid_spec.count_periods)
         self._last_count = 0
         self._host_met = None           # host mirror of max event time
         self._host_min_ts = None        # host mirror of min event time
@@ -397,14 +426,20 @@ class TpuWindowOperator(WindowOperator):
         self._n_pending -= take
 
         met_pre = self._host_met            # max event time BEFORE this batch
-        if self._has_count and take and met_pre is not None \
+        if self._has_count and self._grid_spec.has_time_grid and take \
+                and met_pre is not None \
                 and int(batch_t[:take].min()) < met_pre:
-            # out-of-order + count measure needs the reference's record
-            # ripple (SliceManager.java:77-85) — host-only. Checked before
-            # ANY state mutation so a caller can fall back cleanly.
+            # Out-of-order count+TIME mixes stay host-only: the reference's
+            # ripple (SliceManager.java:77-85) displaces records across time
+            # edges, and its containment quirks have no exact closed form.
+            # Count-only workloads proceed: the sorted batch through the
+            # in-order kernel realizes the ripple's count semantics (every
+            # non-cutting lane folds into the open slice), and count-window
+            # values come from the record buffer's rank ranges. Checked
+            # before ANY state mutation so a caller can fall back cleanly.
             raise UnsupportedOnDevice(
-                "out-of-order tuples with count-measure windows need "
-                "the host operator")
+                "out-of-order tuples with count-measure + time-measure "
+                "window mixes need the host operator")
         if self._session_states and take:
             # sessions consume the batch in ARRIVAL order — the reference's
             # session calculus is arrival-order-dependent at exact-gap
@@ -426,8 +461,11 @@ class TpuWindowOperator(WindowOperator):
             self._host_count += take
         if not self._has_grid:
             return
-        if has_late:
-            # late tuples may open annex slices → merge before next query
+        if has_late and not self._has_count:
+            # late tuples may open annex slices → merge before next query.
+            # (Count-only OOO never touches the annex, and the merge's
+            # coincident-start combining would corrupt count slices, whose
+            # starts legitimately repeat.)
             self._annex_dirty = True
         valid = np.ones((B,), dtype=bool)
         if take < B:
@@ -437,6 +475,17 @@ class TpuWindowOperator(WindowOperator):
             batch_v = np.concatenate(
                 [batch_v, np.zeros((B - take,), np.float32)])
             valid[take:] = False
+        if self._has_count:
+            self._rec = self._rec_merge(self._rec, batch_t, batch_v, valid)
+            if has_late:
+                # count-only OOO: the ts-sorted batch through the in-order
+                # kernel IS the ripple's count bookkeeping — every
+                # non-cutting lane folds into the open slice (closed slices
+                # keep their fixed count ranges) and count edges still cut.
+                # Values come from the record buffer at query time.
+                self._state = self._ingest_inorder(self._state, batch_t,
+                                                   batch_v, valid)
+                return
         if has_late:
             # Split the sorted batch at the lateness boundary: the late
             # prefix is usually a small fraction, but the combined general
@@ -607,6 +656,8 @@ class TpuWindowOperator(WindowOperator):
             # dense scatter-free variant when the span bound allows
             kern = self._pick_inorder_kernel(ts_min, ts_max)
         self._state = kern(self._state, ts, vals, valid)
+        if self._has_count:
+            self._rec = self._rec_merge(self._rec, ts, vals, valid)
 
     def ingest_device_late(self, ts, vals, valid, n: int, ts_min: int,
                            ts_max: int) -> None:
@@ -728,11 +779,19 @@ class TpuWindowOperator(WindowOperator):
             ic_p = np.zeros((Tp,), bool)
             ws_p[:T], we_p[:T], mask[:T] = ws, we, True
             ic_p[:T] = is_count
-            cnt_d, results = self._query(st, ws_p, we_p, mask, ic_p)
+            if self._has_count:
+                cnt_d, results = self._query(st, self._rec, ws_p, we_p,
+                                             mask, ic_p)
+            else:
+                cnt_d, results = self._query(st, ws_p, we_p, mask, ic_p)
 
         if self._has_count:
             self._last_count = self._host_count   # exact host mirror
         bound = (watermark_ts - self.max_lateness) - self.max_fixed_window_size
+        if self._has_count:
+            # records GC in rank-lockstep with the slices (reads the PRE-GC
+            # slice buffer; dispatched before the slice GC)
+            self._rec = self._rec_gc(st, self._rec, np.int64(bound))
         self._state = self._gc(st, np.int64(bound))
         self._last_watermark = watermark_ts
         self._trigger_measures = is_count
@@ -792,8 +851,9 @@ class TpuWindowOperator(WindowOperator):
             else []
         cnt_np = np.zeros((T,), dtype=np.int64)
         if T:
-            cnt_h, res_h, ovf = jax.device_get(
-                (cnt_d, results, self._state.overflow))
+            ovf_src = self._state.overflow if self._rec is None \
+                else self._state.overflow | self._rec.overflow
+            cnt_h, res_h, ovf = jax.device_get((cnt_d, results, ovf_src))
             self._raise_if_overflow(ovf)
             cnt_np = cnt_h[:T]
             for agg, res in zip(self.aggregations, res_h):
@@ -816,6 +876,8 @@ class TpuWindowOperator(WindowOperator):
             return
         if self._state is not None:
             self._raise_if_overflow(self._state.overflow)
+        if self._rec is not None:
+            self._raise_if_overflow(self._rec.overflow)
         for st in getattr(self, "_session_states", ()):
             self._raise_if_overflow(st.overflow)
 

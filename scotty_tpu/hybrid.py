@@ -52,6 +52,7 @@ class HybridWindowOperator(WindowOperator):
     def _device_realizable(self) -> bool:
         from .core.windows import SessionWindow
 
+        has_count = has_time_grid = False
         for w in self.windows:
             if isinstance(w, SessionWindow):
                 # device sessions are fully general (bounded active-session
@@ -64,11 +65,17 @@ class HybridWindowOperator(WindowOperator):
             if not isinstance(w, (TumblingWindow, SlidingWindow,
                                   FixedBandWindow)):
                 return False
-            if w.measure != WindowMeasure.Time and not self.assume_inorder:
-                return False            # OOO + count measure: host only
-            if (w.measure == WindowMeasure.Count
-                    and isinstance(w, FixedBandWindow)):
-                return False
+            if w.measure == WindowMeasure.Count:
+                if isinstance(w, FixedBandWindow):
+                    return False
+                has_count = True
+            else:
+                has_time_grid = True
+        if has_count and has_time_grid and not self.assume_inorder:
+            # count-only OOO runs on device (record-buffer rank ranges);
+            # count+time mixes displace records in the reference's ripple
+            # and stay host-only without an in-order declaration
+            return False
         for a in self.aggregations:
             if a.device_spec() is None:
                 return False

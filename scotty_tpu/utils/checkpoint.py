@@ -33,12 +33,15 @@ def _full_state(op):
     buffer (None for pure-session workloads) plus every registered session
     window's active-session array (round 3 — engine/sessions.py)."""
     return {"grid": op._state,
-            "sessions": list(getattr(op, "_session_states", []))}
+            "sessions": list(getattr(op, "_session_states", [])),
+            "records": getattr(op, "_rec", None)}
 
 
 def _set_full_state(op, tree) -> None:
     op._state = tree["grid"]
     op._session_states = list(tree["sessions"])
+    if tree.get("records") is not None:
+        op._rec = tree["records"]
 
 
 def _host_clocks(op) -> dict:

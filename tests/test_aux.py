@@ -51,8 +51,16 @@ def test_hybrid_picks_host_for_count_measure_sessions():
     assert not _decide([SessionWindow(Count, 10)], [SumAggregation()])
 
 
-def test_hybrid_picks_host_for_count_measure():
-    assert not _decide([TumblingWindow(Count, 10)], [SumAggregation()])
+def test_hybrid_picks_device_for_count_only():
+    # round 3: count-only workloads run on device (record-buffer rank
+    # ranges), in- or out-of-order
+    assert _decide([TumblingWindow(Count, 10)], [SumAggregation()])
+
+
+def test_hybrid_picks_host_for_ooo_count_time_mix():
+    # count+time mixes without an in-order declaration stay host-only
+    assert not _decide([TumblingWindow(Count, 10), TumblingWindow(Time, 10)],
+                       [SumAggregation()])
 
 
 def test_hybrid_picks_host_for_host_only_aggregate():

@@ -316,17 +316,56 @@ def test_count_multi_watermark():
              stream, [(3, 40), (5, 55)])
 
 
-def test_count_out_of_order_raises_on_device():
+def test_count_out_of_order_matches_oracle():
+    """Round 3: count-measure OOO runs on device (record-buffer rank
+    ranges — the closed form of the reference ripple,
+    SliceManager.java:77-85). Late tuples across flushed batches must
+    match the simulator."""
+    stream = [(1, 3), (2, 20), (3, 5), (4, 30), (5, 8), (6, 40), (7, 41)]
+    run_both([TumblingWindow(WindowMeasure.Count, 3)], [SumAggregation],
+             stream, [(1, 25), (4, 35), (6, 45)], lateness=1000)
+
+
+def test_count_out_of_order_with_time_mix_still_raises():
     from scotty_tpu.engine import TpuWindowOperator, UnsupportedOnDevice
 
     op = TpuWindowOperator(config=SMALL)
     op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
+    op.add_window_assigner(TumblingWindow(Time, 10))
     op.add_aggregation(SumAggregation())
     op.process_elements([1, 2], [10, 20])
     op.process_watermark(25)             # flushes; max event time now 20
     with pytest.raises(UnsupportedOnDevice):
         op.process_elements([3], [5])    # late across flushed batches
         op.process_watermark(30)
+
+
+@pytest.mark.parametrize("seed", [7, 21, 35])
+def test_count_out_of_order_differential(seed):
+    """Randomized count-only OOO streams (distinct timestamps — the
+    reference's TreeSet record retention drops equal-ts records, a
+    documented quirk not worth reproducing) vs the simulator."""
+    rng = np.random.default_rng(seed)
+    n = 160
+    base = np.sort(rng.choice(np.arange(1, 3000), size=n, replace=False))
+    # bounded local shuffle: distinct timestamps, arrival displaced ≤ ~25
+    # positions; the first arrival stays the global minimum (below-first
+    # inserts crash the reference — out of contract)
+    order = np.argsort(np.arange(n) + rng.uniform(0, 25, size=n),
+                       kind="stable")
+    order = np.concatenate(([0], order[order != 0]))
+    ts = base[order]
+    vals = rng.integers(1, 60, size=n)
+    stream = [(int(v), int(t)) for v, t in zip(vals, ts)]
+    wms = []
+    for p in (n // 3, 2 * n // 3, n - 1):
+        w = int(np.max(ts[:p + 1])) + 1
+        if not wms or w > wms[-1][1]:
+            wms.append((p, w))
+    run_both([TumblingWindow(WindowMeasure.Count, 7),
+              TumblingWindow(WindowMeasure.Count, 3)],
+             [SumAggregation, MaxAggregation, MeanAggregation],
+             stream, wms, lateness=10_000)
 
 
 # ---------------------------------------------------------------------------
@@ -638,6 +677,57 @@ def test_multi_gap_pure_sessions():
     wms = safe_points[3::4] + [safe_points[-1]]
     run_both([SessionWindow(Time, 8), SessionWindow(Time, 20)],
              [SumAggregation, MaxAggregation], stream, wms)
+
+
+def test_count_survives_positive_gc_bound():
+    """Count slices must keep real ts starts so the GC bound cannot drop
+    records of pending count windows (review finding r3: grid_start==0
+    polluted every start, and wall-clock-scale timestamps with a small
+    lateness then GC'd live ranks)."""
+    base = 100_000
+    stream = [(i + 1, base + i * 7) for i in range(12)]
+    stream += [(i + 1, base + 90 + i * 7) for i in range(8)]
+    run_both([TumblingWindow(WindowMeasure.Count, 7),
+              TumblingWindow(WindowMeasure.Count, 3)],
+             [SumAggregation], stream,
+             [(11, base + 80), (19, base + 200)], lateness=50)
+
+
+def test_count_dynamic_time_addition_keeps_record_query():
+    """Dynamic time-window addition on a count workload must rebuild the
+    record-aware query kernel (review finding r3: the rebuild dropped the
+    record_capacity argument and the next watermark raised TypeError)."""
+    eng = TpuWindowOperator(config=SMALL)
+    eng.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
+    eng.add_aggregation(SumAggregation())
+    eng.process_elements([1, 2, 3, 4], [10, 20, 30, 40])
+    assert [float(w.get_agg_values()[0])
+            for w in eng.process_watermark(45) if w.has_value()] == [6.0]
+    eng.add_window_assigner(TumblingWindow(Time, 50))
+    eng.process_elements([5, 6], [60, 70])
+    res = eng.process_watermark(120)
+    vals = {(w.get_start(), w.get_end()): float(w.get_agg_values()[0])
+            for w in res if w.has_value()}
+    assert vals[(3, 6)] == 15.0            # count window [3,6): 4+5+6
+    assert vals[(50, 100)] == 11.0         # added time window: 5+6
+
+
+def test_count_minmax_full_record_buffer():
+    """A count window spanning the ENTIRE record buffer (length == RC, a
+    power of two) must still answer min/max — the log sweep needs the
+    log2(N) level (review finding r3)."""
+    cfg = EngineConfig(capacity=1 << 12, batch_size=64, annex_capacity=256,
+                       min_trigger_pad=32, record_capacity=16)
+    eng = TpuWindowOperator(config=cfg)
+    eng.add_window_assigner(TumblingWindow(WindowMeasure.Count, 16))
+    eng.add_aggregation(MinAggregation())
+    eng.add_aggregation(MaxAggregation())
+    vals = [float(v) for v in range(3, 19)]
+    eng.process_elements(vals, [10 * i for i in range(16)])
+    res = [w for w in eng.process_watermark(1000) if w.has_value()]
+    assert len(res) == 1
+    lo, hi = (float(x) for x in res[0].get_agg_values())
+    assert (lo, hi) == (3.0, 18.0)
 
 
 def _bursty_session_stream(rng, n_bursts, burst_span=100, jitter=300,
