@@ -76,7 +76,13 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         if isinstance(w, FixedBandWindow):
             return int(w.start + w.size)      # its single trigger point
         if isinstance(w, SlidingWindow):
-            return int(w.slide)
+            # a FRESH pipeline's first sliding trigger fires at ~size
+            # (ends <= wm+1 with starts >= 0); only the prefill path has
+            # already warmed past that, so the shorter slide-based horizon
+            # is valid only there (ADVICE r2)
+            if hasattr(pipeline, "prefill"):
+                return int(w.slide)
+            return int(max(w.size, w.slide))
         return int(w.size)
 
     max_period = max(_trigger_horizon(w) for w in pipeline.windows)

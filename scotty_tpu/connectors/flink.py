@@ -43,7 +43,11 @@ class _EngineWatermarks(WatermarkPolicy):
 
     def observe_with_engine(self, ts: int,
                             engine_wm: Optional[int]) -> Optional[int]:
-        wm = engine_wm if engine_wm is not None and engine_wm > 0 else ts
+        # fall back to the element ts only on NEGATIVE engine watermarks
+        # (KeyedScottyWindowOperator.java: currentWatermark()<0 ? ts : wm);
+        # a valid watermark of exactly 0 must be honored or ahead-of-
+        # watermark elements fire windows early (ADVICE r2)
+        wm = engine_wm if engine_wm is not None and engine_wm >= 0 else ts
         if wm > self.current:
             self.current = wm
             return wm

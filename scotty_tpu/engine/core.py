@@ -261,7 +261,8 @@ def _lift(agg: DeviceAggregateSpec, vals: jnp.ndarray, valid: jnp.ndarray):
 
 
 def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
-                 assume_inorder: bool = False):
+                 assume_inorder: bool = False,
+                 with_cut_starts: bool = False):
     """Batched in-order + late-tuple ingest.
 
     Replaces the per-tuple hot loop StreamSlicer.determineSlices →
@@ -274,11 +275,19 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
     ``assume_inorder=True`` compiles out the late/annex machinery — for
     callers that guarantee a fully ascending stream (e.g. the fused pipeline
     whose device generator is ascending by construction).
+
+    ``with_cut_starts=True`` (count-measure workloads) adds a fifth input:
+    per-lane count-cut slice starts precomputed by the host in ARRIVAL
+    order (``max(met, arrival_ts[0..j-1])`` for the lane cutting at count
+    offset ``j``) — the reference appends count-cut slices at its
+    arrival-order ``maxEventTime`` (StreamSlicer.java:37-44), which a
+    ts-sorted batch cannot reconstruct on device.
     """
     C, A = capacity, annex_capacity
 
     def ingest(state: SliceBufferState, ts: jnp.ndarray, vals: jnp.ndarray,
-               valid: jnp.ndarray) -> SliceBufferState:
+               valid: jnp.ndarray,
+               cut_starts: jnp.ndarray = None) -> SliceBufferState:
         B = ts.shape[0]
         s = grid_start(spec, ts)
 
@@ -381,7 +390,15 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
         # they never trigger a spurious edge (they're io_valid-masked),
         # pinned lanes because they genuinely insert there
         io_s = jnp.where(late | pin, open_start, s)
-        io_s = jnp.where(count_flag & ~late, jnp.maximum(io_s, prev_ts), io_s)
+        # count-cut slices start at the RUNNING MAX event time (the
+        # reference appends at maxEventTime, StreamSlicer.java:37-44): a
+        # raw prev_ts would place a cut fired by a late lane BELOW earlier
+        # starts and break the sorted-starts invariant the probe/GC
+        # searchsorted on. For in-order batches cummax(prev_ts) == prev_ts;
+        # disordered count batches pass exact arrival-order cut starts.
+        run_max = cut_starts if with_cut_starts else jax.lax.cummax(prev_ts)
+        io_s = jnp.where(count_flag & ~late, jnp.maximum(io_s, run_max),
+                         io_s)
         prev = jnp.concatenate([open_start[None], io_s[:-1]])
         newflag = ((io_s > prev) | (count_flag & ~late)) & valid
         k = jnp.cumsum(newflag.astype(jnp.int32))
@@ -968,11 +985,19 @@ def build_record_gc(capacity: int, record_capacity: int):
 # ---------------------------------------------------------------------------
 
 
-def build_count_probe(spec: EngineSpec, capacity: int):
+def build_count_probe(spec: EngineSpec, capacity: int,
+                      record_capacity: int = 0):
     """Convert a watermark timestamp to a count bound for count-measure
     triggering (WindowManager.java:110-115): locate the slice covering the
     watermark; if its last observed record is at/after the watermark, step
-    back one slice; the bound is that slice's last count."""
+    back one slice; the bound is that slice's last count.
+
+    With ``record_capacity`` (the out-of-order count path), the slice's
+    "last observed record" comes from the record buffer — after the
+    reference's ripple, slice k's last record is the ts-sorted rank
+    ``c_start_k + counts_k - 1``, whereas the arrival-order ``t_last``
+    field keeps pre-ripple maxima."""
+    RC = record_capacity
 
     def count_at(state: SliceBufferState, wm: jnp.ndarray) -> jnp.ndarray:
         idx = jnp.searchsorted(state.starts, wm, side="right") - 1
@@ -981,7 +1006,23 @@ def build_count_probe(spec: EngineSpec, capacity: int):
         idx = jnp.where(step, idx - 1, idx)
         return state.c_start[idx] + state.counts[idx]
 
-    return count_at
+    if not RC:
+        return count_at
+
+    def count_at_rec(state: SliceBufferState, rec: RecordBuffer,
+                     wm: jnp.ndarray) -> jnp.ndarray:
+        def t_last_of(i):
+            r = jnp.clip(state.c_start[i] + state.counts[i] - 1 - rec.base,
+                         0, RC - 1)
+            return rec.rts[r]
+
+        idx = jnp.searchsorted(state.starts, wm, side="right") - 1
+        idx = jnp.clip(idx, 0, capacity - 1)
+        step = (t_last_of(idx) >= wm) & (idx > 0)
+        idx = jnp.where(step, idx - 1, idx)
+        return state.c_start[idx] + state.counts[idx]
+
+    return count_at_rec
 
 # ---------------------------------------------------------------------------
 # Session sweep (pure-session watermark path)

@@ -97,8 +97,10 @@ def _kernels(spec, capacity: int, annex_capacity: int,
         hit = (
             jax.jit(ec.build_ingest(spec, capacity, annex_capacity),
                     donate_argnums=0),
-            jax.jit(ec.build_query(spec, capacity, annex_capacity,
-                                   record_capacity)),
+            # plain query/probe: exact from slice partials while the
+            # stream is in-order (cheap); record-aware variants take over
+            # permanently once a late count tuple is seen
+            jax.jit(ec.build_query(spec, capacity, annex_capacity, 0)),
             jax.jit(ec.build_gc(spec, capacity, annex_capacity)),
             jax.jit(ec.build_count_probe(spec, capacity)),
             jax.jit(ec.build_annex_merge(spec, capacity, annex_capacity),
@@ -108,6 +110,17 @@ def _kernels(spec, capacity: int, annex_capacity: int,
             jax.jit(ec.build_ingest(spec, capacity, annex_capacity,
                                     assume_inorder=True),
                     donate_argnums=0),
+            jax.jit(ec.build_query(spec, capacity, annex_capacity,
+                                   record_capacity))
+            if record_capacity else None,
+            jax.jit(ec.build_count_probe(spec, capacity, record_capacity))
+            if record_capacity else None,
+            # count ingest with host-supplied arrival-order cut starts
+            jax.jit(ec.build_ingest(spec, capacity, annex_capacity,
+                                    assume_inorder=True,
+                                    with_cut_starts=True),
+                    donate_argnums=0)
+            if record_capacity else None,
         )
         _KERNEL_CACHE[key] = hit
     return hit
@@ -264,8 +277,9 @@ class TpuWindowOperator(WindowOperator):
         C, A = self.config.capacity, self.config.annex_capacity
         RCap = self.config.records if self._has_count else 0
         (self._ingest, self._query, self._gc, self._count_at,
-         self._merge, self._ingest_inorder) = _kernels(self._grid_spec, C, A,
-                                                       RCap)
+         self._merge, self._ingest_inorder, self._query_rec,
+         self._count_at_rec, self._ingest_cut) = _kernels(self._grid_spec,
+                                                          C, A, RCap)
         # the dense fast path closes over the union grid too
         self._dense_runs = self.config.dense_ingest_runs \
             if dense_eligible(self._grid_spec) else 0
@@ -348,8 +362,9 @@ class TpuWindowOperator(WindowOperator):
             RCap = self.config.records if self._has_count else 0
             self._state = ec.init_state(self._grid_spec, C, A)
             (self._ingest, self._query, self._gc, self._count_at,
-             self._merge, self._ingest_inorder) = _kernels(self._grid_spec,
-                                                           C, A, RCap)
+             self._merge, self._ingest_inorder, self._query_rec,
+             self._count_at_rec, self._ingest_cut) = _kernels(
+                 self._grid_spec, C, A, RCap)
             if self._has_count:
                 # count windows aggregate ts-sorted rank ranges — retain
                 # records (the reference's lazy-slice retention)
@@ -388,6 +403,7 @@ class TpuWindowOperator(WindowOperator):
         self._host_min_ts = None        # host mirror of min event time
         self._host_count = 0            # host mirror of current_count
         self._annex_dirty = False       # a late tuple may sit in the annex
+        self._count_late_seen = False   # sticky: rec query/probe from then on
         self._valid_dev = None          # cached all-true lane mask
         self._built = True
 
@@ -446,6 +462,19 @@ class TpuWindowOperator(WindowOperator):
             # boundaries (engine/sessions.py module docstring)
             self._feed_sessions(batch_v[:take], batch_t[:take], met_pre)
 
+        cut_starts = None
+        if self._has_count and not self._grid_spec.has_time_grid and take:
+            # count-cut slice starts = ARRIVAL-order running max event time
+            # (the reference appends at maxEventTime) — computed before the
+            # ts-sort erases arrival order; lane j of the sorted batch cuts
+            # at count offset j, which is arrival j
+            seed = np.int64(met_pre) if met_pre is not None \
+                else np.iinfo(np.int64).min
+            cs = np.maximum.accumulate(
+                np.concatenate(([seed], batch_t[:take - 1])))
+            cut_starts = np.full((B,), cs[-1], np.int64)
+            cut_starts[:take] = cs
+
         if take and not bool((batch_t[:-1] <= batch_t[1:]).all()):
             order = np.argsort(batch_t, kind="stable")
             batch_v, batch_t = batch_v[order], batch_t[order]
@@ -477,14 +506,17 @@ class TpuWindowOperator(WindowOperator):
             valid[take:] = False
         if self._has_count:
             self._rec = self._rec_merge(self._rec, batch_t, batch_v, valid)
-            if has_late:
-                # count-only OOO: the ts-sorted batch through the in-order
-                # kernel IS the ripple's count bookkeeping — every
-                # non-cutting lane folds into the open slice (closed slices
-                # keep their fixed count ranges) and count edges still cut.
-                # Values come from the record buffer at query time.
-                self._state = self._ingest_inorder(self._state, batch_t,
-                                                   batch_v, valid)
+            if cut_starts is not None:
+                # count-only workloads (in- or out-of-order): the ts-sorted
+                # batch through the in-order kernel IS the ripple's count
+                # bookkeeping — every non-cutting lane folds into the open
+                # slice (closed slices keep their fixed count ranges) and
+                # count edges still cut, at arrival-order start positions.
+                # OOO values come from the record buffer at query time.
+                if has_late:
+                    self._count_late_seen = True
+                self._state = self._ingest_cut(self._state, batch_t,
+                                               batch_v, valid, cut_starts)
                 return
         if has_late:
             # Split the sorted batch at the lateness boundary: the late
@@ -746,7 +778,10 @@ class TpuWindowOperator(WindowOperator):
         # workloads only.
         cend = None
         if self._has_count:
-            cend = int(self._count_at(st, np.int64(watermark_ts)))
+            cend = int(self._count_at_rec(st, self._rec,
+                                          np.int64(watermark_ts))
+                       if self._count_late_seen
+                       else self._count_at(st, np.int64(watermark_ts)))
 
         trig_s, trig_e, trig_c = [], [], []
         for w in self.windows:
@@ -779,9 +814,9 @@ class TpuWindowOperator(WindowOperator):
             ic_p = np.zeros((Tp,), bool)
             ws_p[:T], we_p[:T], mask[:T] = ws, we, True
             ic_p[:T] = is_count
-            if self._has_count:
-                cnt_d, results = self._query(st, self._rec, ws_p, we_p,
-                                             mask, ic_p)
+            if self._has_count and self._count_late_seen:
+                cnt_d, results = self._query_rec(st, self._rec, ws_p, we_p,
+                                                 mask, ic_p)
             else:
                 cnt_d, results = self._query(st, ws_p, we_p, mask, ic_p)
 

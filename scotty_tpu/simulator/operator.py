@@ -86,7 +86,38 @@ class AggregateWindowState:
                                self.window_state.has_values())
 
 
-class LazyAggregateStore:
+class AggregationStore:
+    """Slice container contract (aggregationstore/AggregationStore.java:7-87):
+    the seam the reference's README roadmap reserves for checkpointable /
+    engine-backed slice storage. :class:`LazyAggregateStore` is the default
+    implementation; alternatives plug in through
+    :class:`AggregationStoreFactory` on :class:`SlicingWindowOperator`."""
+
+    def get_current_slice(self): raise NotImplementedError
+    def find_slice_index_by_timestamp(self, ts): raise NotImplementedError
+    def find_slice_index_by_count(self, count): raise NotImplementedError
+    def find_slice_by_end(self, end): raise NotImplementedError
+    def get_slice(self, index): raise NotImplementedError
+    def insert_value_to_current_slice(self, element, ts): raise NotImplementedError
+    def insert_value_to_slice(self, index, element, ts): raise NotImplementedError
+    def append_slice(self, new_slice): raise NotImplementedError
+    def add_slice(self, index, new_slice): raise NotImplementedError
+    def merge_slice(self, slice_index): raise NotImplementedError
+    def size(self): raise NotImplementedError
+    def is_empty(self): raise NotImplementedError
+    def aggregate(self, windows, min_ts, max_ts, min_count, max_count):
+        raise NotImplementedError
+    def remove_slices(self, max_timestamp): raise NotImplementedError
+
+
+class AggregationStoreFactory:
+    """Store factory seam (aggregationstore/AggregationStoreFactory.java:3-6)."""
+
+    def create_aggregation_store(self) -> AggregationStore:
+        raise NotImplementedError
+
+
+class LazyAggregateStore(AggregationStore):
     """Slice container: plain list with reverse linear scans and the
     final-merge loop (aggregationstore/LazyAggregateStore.java:19-157)."""
 
@@ -556,9 +587,11 @@ class SlicingWindowOperator(WindowOperator):
     """Composition root (SlicingWindowOperator.java:21-69): wires store +
     window manager + slice factory + slice manager + stream slicer."""
 
-    def __init__(self, state_factory: Optional[StateFactory] = None):
+    def __init__(self, state_factory: Optional[StateFactory] = None,
+                 store_factory: Optional[AggregationStoreFactory] = None):
         self.state_factory = state_factory or MemoryStateFactory()
-        self.store = LazyAggregateStore()
+        self.store = store_factory.create_aggregation_store() \
+            if store_factory is not None else LazyAggregateStore()
         self.window_manager = WindowManager(self.state_factory, self.store)
         self.slice_factory = SliceFactory(self.window_manager, self.state_factory)
         self.slice_manager = SliceManager(self.slice_factory, self.store,

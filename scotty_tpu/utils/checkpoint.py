@@ -54,6 +54,7 @@ def _host_clocks(op) -> dict:
         "host_count": op._host_count,
         "last_count": op._last_count,
         "annex_dirty": op._annex_dirty,
+        "count_late_seen": getattr(op, "_count_late_seen", False),
     }
 
 
@@ -67,6 +68,7 @@ def _restore_meta(op, meta: dict) -> None:
         op._host_count = meta["host_count"]
         op._last_count = meta["last_count"]
         op._annex_dirty = meta["annex_dirty"]
+        op._count_late_seen = meta.get("count_late_seen", False)
 
 
 def save_engine_operator(op, path: str) -> None:
@@ -108,6 +110,12 @@ def restore_engine_operator(op, path: str) -> None:
     full = _full_state(op)
     treedef = jax.tree.structure(full)
     template = jax.tree.flatten(full)[0]
+    if len(leaves) != len(template):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves but this operator "
+            f"revision expects {len(template)} — snapshots from older "
+            "revisions of a count-measure operator cannot be migrated "
+            "(they lack the record buffer); re-run from source data")
     cast = [np.asarray(l, dtype=np.asarray(t).dtype)
             for l, t in zip(leaves, template)]
     _set_full_state(op, jax.tree.unflatten(treedef, cast))

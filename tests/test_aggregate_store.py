@@ -59,3 +59,45 @@ def test_insert_value(env):
     assert store.get_slice(1).agg_state.get_values()[0] == 1
     assert store.get_slice(2).agg_state.get_values()[0] == 2
     assert store.get_slice(3).agg_state.get_values()[0] == 3
+
+
+def test_pluggable_store_factory_seam():
+    """The AggregationStore seam (aggregationstore/AggregationStore.java:7-87
+    + AggregationStoreFactory.java:3-6): a custom store plugs into the
+    operator through the factory and produces identical results."""
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.simulator import SlicingWindowOperator
+    from scotty_tpu.simulator.operator import (
+        AggregationStore,
+        AggregationStoreFactory,
+        LazyAggregateStore,
+    )
+
+    calls = {"aggregate": 0, "append": 0}
+
+    class SpyStore(LazyAggregateStore):
+        def aggregate(self, *a, **k):
+            calls["aggregate"] += 1
+            return super().aggregate(*a, **k)
+
+        def append_slice(self, s):
+            calls["append"] += 1
+            return super().append_slice(s)
+
+    class SpyFactory(AggregationStoreFactory):
+        def create_aggregation_store(self):
+            return SpyStore()
+
+    def drive(op):
+        op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+        op.add_aggregation(SumAggregation())
+        for v, t in [(1, 1), (2, 12), (3, 15), (4, 27)]:
+            op.process_element(v, t)
+        return [(w.get_start(), w.get_end(), w.get_agg_values()[0])
+                for w in op.process_watermark(30) if w.has_value()]
+
+    plugged = drive(SlicingWindowOperator(store_factory=SpyFactory()))
+    default = drive(SlicingWindowOperator())
+    assert plugged == default == [(0, 10, 1), (10, 20, 5), (20, 30, 4)]
+    assert calls["aggregate"] >= 1 and calls["append"] >= 1
+    assert isinstance(SpyStore(), AggregationStore)

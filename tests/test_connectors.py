@@ -236,8 +236,10 @@ def test_flink_adapter_engine_watermarks():
     # engine watermark advances past the first window: [0,10) emits
     rows = op.process_record("a", 3, 12, current_watermark=11)
     assert ("a", 0, 10, (3,)) in rows
-    # element-ts fallback (no engine watermark): ts 25 fires [10,20)
-    rows = op.process_record("a", 4, 25, current_watermark=0)
+    # element-ts fallback (no engine watermark = NEGATIVE, the reference's
+    # currentWatermark()<0 test — watermark 0 is VALID and must not fall
+    # back, ADVICE r2): ts 25 fires [10,20)
+    rows = op.process_record("a", 4, 25, current_watermark=-1)
     assert any(r[1] == 10 and r[2] == 20 and r[3] == (3,) for r in rows)
 
 

@@ -358,9 +358,12 @@ def test_count_out_of_order_differential(seed):
     vals = rng.integers(1, 60, size=n)
     stream = [(int(v), int(t)) for v, t in zip(vals, ts)]
     wms = []
-    for p in (n // 3, 2 * n // 3, n - 1):
-        w = int(np.max(ts[:p + 1])) + 1
-        if not wms or w > wms[-1][1]:
+    for i, p in enumerate((n // 4, n // 2, 3 * n // 4, n - 1)):
+        # alternate watermarks INSIDE the disordered region (probing the
+        # rippled-t_last step-back) and just past the max event time
+        met = int(np.max(ts[:p + 1]))
+        w = met - int(rng.integers(5, 40)) if i % 2 == 0 else met + 1
+        if w > 0 and (not wms or w > wms[-1][1]):
             wms.append((p, w))
     run_both([TumblingWindow(WindowMeasure.Count, 7),
               TumblingWindow(WindowMeasure.Count, 3)],
