@@ -442,9 +442,11 @@ class TpuWindowOperator(WindowOperator):
         self._n_pending -= take
 
         met_pre = self._host_met            # max event time BEFORE this batch
+        intra_ooo = take > 1 and not bool(
+            (batch_t[:take - 1] <= batch_t[1:take]).all())
         if self._has_count and self._grid_spec.has_time_grid and take \
-                and met_pre is not None \
-                and int(batch_t[:take].min()) < met_pre:
+                and (intra_ooo or (met_pre is not None
+                                   and int(batch_t[:take].min()) < met_pre)):
             # Out-of-order count+TIME mixes stay host-only: the reference's
             # ripple (SliceManager.java:77-85) displaces records across time
             # edges, and its containment quirks have no exact closed form.

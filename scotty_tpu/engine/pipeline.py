@@ -422,7 +422,8 @@ class AlignedStreamPipeline:
                  config: Optional[EngineConfig] = None,
                  throughput: int = 200_000_000, wm_period_ms: int = 1000,
                  max_lateness: int = 1000, seed: int = 0, gc_every: int = 32,
-                 max_chunk_elems: int = 1 << 25, value_scale: float = 10_000.0):
+                 max_chunk_elems: int = 1 << 25, value_scale: float = 10_000.0,
+                 out_of_order_pct: float = 0.0):
         import jax
         import jax.numpy as jnp
 
@@ -435,6 +436,8 @@ class AlignedStreamPipeline:
         self.wm_period_ms = wm_period_ms
         self.gc_every = gc_every
         self.seed = seed
+        self.out_of_order_pct = float(out_of_order_pct)
+        self.value_scale = float(value_scale)
 
         max_fixed = 0
         for w in self.windows:
@@ -463,7 +466,21 @@ class AlignedStreamPipeline:
         S = wm_period_ms // g
         self.grid, self.R, self.S = g, R, S
         self.max_fixed = max_fixed
-        self.tuples_per_interval = S * R
+        # Out-of-order mode: per interval, L extra LATE tuples — event times
+        # uniform in [max(0, base - max_lateness), base), arriving at the
+        # START of the interval (so their displacement never exceeds
+        # max_lateness relative to the stream's max event time, the
+        # reference contract WindowOperator.java:31-37). On the aligned
+        # grid every covering slice row is materialized (the base stream
+        # fills every row), so the late fold needs NO annex, NO sort and NO
+        # search: covering rows are affine in the grid start, and the
+        # combines are bounded [L]-lane scatters. t_last is deliberately
+        # NOT updated by late lanes: on the aligned grid every window edge
+        # is a slice edge, so t_last containment (AggregateWindowState.java:
+        # 25-31) is equivalent to start containment — and skipping it
+        # avoids the dominant int64 scatter (~100 ms per 1M lanes on v5e).
+        self.n_late = int(S * R * self.out_of_order_pct)
+        self.tuples_per_interval = S * R + self.n_late
 
         # rows per generation chunk: largest divisor of S within the budget
         # (the budget counts lifted elements, so wide sketch partials shrink
@@ -498,9 +515,65 @@ class AlignedStreamPipeline:
 
         first_lw = max(0, P - max_lateness)   # first-watermark clamp
                                               # (WindowManager.java:43-45)
+        L = self.n_late
+
+        def late_fold(state, key, base):
+            """Fold this interval's late tuples into their covering slices.
+
+            Runs BEFORE the base append: at this point the top slice is the
+            previous interval's last row (start == base - g), so a late
+            tuple with grid start gs sits at row
+            ``n_slices - 1 - (base - g - gs) / g`` — affine, no search.
+            Rows behind the GC horizon cannot occur (the GC bound
+            ``wm - max_lateness - max_fixed`` keeps every row the late span
+            can touch). Interval 0 has no earlier span: all lanes masked.
+            """
+            kl = jax.random.fold_in(key, 0x1a7e)
+            u = jax.random.uniform(kl, (2, L), dtype=jnp.float32)
+            lo_l = jnp.maximum(base - max_lateness, 0).astype(jnp.float64)
+            span_l = base.astype(jnp.float64) - lo_l
+            lts = (lo_l + u[0].astype(jnp.float64) * span_l).astype(jnp.int64)
+            lts = jnp.minimum(lts, base - 1)
+            lvals = u[1] * value_scale
+            ok = base > 0                      # scalar; interval-0 guard
+            gs = lts - jnp.mod(lts, g)
+            row = (state.n_slices.astype(jnp.int64) - 1
+                   - (base - g - gs) // g)
+            # out-of-range sentinel + identity-masked values + mode="drop":
+            # masked lanes can neither combine nor clamp onto a live row
+            pos = jnp.where(ok, row, C).astype(jnp.int32)
+            d32 = jnp.zeros((C,), jnp.int32).at[pos].add(
+                jnp.int32(1), mode="drop")
+            partials = []
+            for aspec, part in zip(spec.aggs, state.partials):
+                if aspec.is_sparse:
+                    col, v = aspec.lift_sparse(lvals)
+                    v = jnp.where(ok, v, aspec.identity)
+                    idx = (pos, col)
+                else:
+                    v = aspec.lift_dense(lvals)
+                    v = jnp.where(ok, v, aspec.identity)
+                    idx = (pos,)
+                if aspec.kind == "sum":
+                    part = part.at[idx].add(v, mode="drop")
+                elif aspec.kind == "min":
+                    part = part.at[idx].min(v, mode="drop")
+                else:
+                    part = part.at[idx].max(v, mode="drop")
+                partials.append(part)
+            n_ok = jnp.where(ok, jnp.int64(L), jnp.int64(0))
+            bad = ok & jnp.any((row < 0)
+                               | (row >= state.n_slices.astype(jnp.int64)))
+            return state._replace(
+                counts=state.counts + d32.astype(jnp.int64),
+                partials=tuple(partials),
+                current_count=state.current_count + n_ok,
+                overflow=state.overflow | bad)
 
         def step(state, key, interval_idx):
             base = interval_idx * P
+            if L:
+                state = late_fold(state, key, base)
 
             def body(_, c):
                 vals, offs = gen_chunk(key, c)
@@ -617,6 +690,29 @@ class AlignedStreamPipeline:
         if bool(jax.device_get(self.state.overflow)):
             raise RuntimeError("slice buffer overflow: raise capacity or "
                                "gc more often")
+
+    def materialize_interval_late(self, i: int):
+        """Regenerate interval i's LATE tuple stream on host (testing):
+        returns (vals[n_late] f32, ts[n_late] i64) — the tuples the fused
+        step folds in at the START of interval i, before that interval's
+        base stream. Empty for interval 0 (no earlier span). Bit-identical
+        to the device late_fold generator."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.n_late == 0 or i == 0:
+            return (np.empty(0, np.float32), np.empty(0, np.int64))
+        if self._root is None:
+            self._root = jax.random.PRNGKey(self.seed)
+        key = jax.random.fold_in(self._interval_key(i), 0x1a7e)
+        u = jax.device_get(jax.random.uniform(
+            key, (2, self.n_late), dtype=jnp.float32))
+        base = i * self.wm_period_ms
+        lo_l = max(base - self.max_lateness, 0)
+        lts = (np.float64(lo_l)
+               + u[0].astype(np.float64) * (base - lo_l)).astype(np.int64)
+        lts = np.minimum(lts, base - 1)
+        return u[1] * np.float32(self.value_scale), lts
 
     def materialize_interval(self, i: int):
         """Regenerate interval i's tuple stream on host (testing): returns
