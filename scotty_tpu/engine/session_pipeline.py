@@ -1,0 +1,468 @@
+"""Fused session-workload pipeline: ONE XLA dispatch per watermark interval
+for session windows (optionally mixed with time-grid windows).
+
+TPU-first observation driving the design: per-lane scatter work is the only
+ingest cost class that scales with the tuple count (f32 scatters ~12 ms per
+1M lanes on v5e, int64 ~135 ms — measured, docs/DESIGN.md), and per-dispatch
+overhead on tunneled devices is ~5-15 ms. A session benchmark stream is a
+constant-rate generator with occasional SILENT SPANS (the reference's
+session-gap mechanism, LoadGeneratorSource.java:60-76): at benchmark rates
+the inter-arrival time between consecutive tuples (~µs) never approaches a
+session gap (~seconds), so sessions can only break at the injected silent
+spans. This pipeline quantizes silent spans to whole watermark intervals,
+which makes each live interval's tuples one contiguous chain segment:
+
+* per interval, ONE shared fold per aggregation covers every registered
+  session window — a dense reduction for sum-kind lifts, a single [B]-lane
+  f32 scatter into the sketch width for sparse lifts (HLL registers,
+  DDSketch buckets);
+* each session window then updates at most ONE row of its bounded
+  active-session array (extend the open session, or close it and open a new
+  one when the preceding silence exceeded that window's gap) — the
+  in-order specialization of SessionContext.updateContext
+  (SessionWindow.java:40-84) at interval granularity;
+* completed sessions emit via the shared sweep kernel
+  (engine/sessions.py:build_session_sweep — trigger semantics
+  SessionWindow.java:107-116);
+* time-grid windows in the mix ride the slice-aligned append of
+  AlignedStreamPipeline (no scatters at all) over the SAME generated
+  tuples; silent intervals append nothing, so grid windows over silence
+  emit empty exactly like the reference (empty windows are not emitted).
+
+Generality note: this execution mode covers the benchmark-shaped session
+workload (in-order stream, silence-separated sessions). Arbitrary
+out-of-order session streams run on TpuWindowOperator's session kernels
+(engine/sessions.py late scan) or the host oracle — the decision tree in
+hybrid.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import jax_config  # noqa: F401
+
+from ..core.aggregates import AggregateFunction
+from ..core.windows import (
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+    WindowMeasure,
+)
+from .config import EngineConfig
+from .pipeline import build_trigger_grid
+
+
+class SessionStreamPipeline:
+    """One fused step per watermark interval for session(-mix) workloads.
+
+    ``session_config``: {"count": N, "minGapMs": a, "maxGapMs": b} — the
+    reference benchmark's silent-span parameters (BenchmarkRunner.java:
+    174-192). Spans are placed by a seeded schedule over a cyclic horizon
+    and quantized to whole intervals (lengths rounded UP, so a span meant
+    to exceed a session gap still does).
+    """
+
+    def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
+                 config: Optional[EngineConfig] = None,
+                 throughput: int = 32_000_000, wm_period_ms: int = 1000,
+                 max_lateness: int = 1000, seed: int = 0,
+                 session_config: Optional[dict] = None, gc_every: int = 32,
+                 max_chunk_elems: int = 1 << 25,
+                 value_scale: float = 10_000.0):
+        import jax
+        import jax.numpy as jnp
+
+        from . import core as ec
+        from . import sessions as es
+
+        self.config = config or EngineConfig()
+        self.windows = list(windows)
+        self.aggregations = list(aggregations)
+        self.max_lateness = max_lateness
+        self.wm_period_ms = wm_period_ms
+        self.gc_every = gc_every
+        self.seed = seed
+        self.value_scale = float(value_scale)
+
+        self.session_windows = [w for w in self.windows
+                                if isinstance(w, SessionWindow)]
+        grid_windows = [w for w in self.windows
+                       if not isinstance(w, SessionWindow)]
+        for w in self.session_windows:
+            if w.measure != WindowMeasure.Time:
+                raise NotImplementedError("count-measure sessions: host only")
+        max_fixed = 0
+        for w in grid_windows:
+            if w.measure != WindowMeasure.Time or not isinstance(
+                    w, (TumblingWindow, SlidingWindow)):
+                raise NotImplementedError(
+                    "session pipeline: time tumbling/sliding mixes only")
+            max_fixed = max(max_fixed, w.clear_delay())
+        aggs = tuple(a.device_spec() for a in self.aggregations)
+        if any(a is None for a in aggs):
+            raise NotImplementedError("device-realizable aggregations only")
+
+        # ---- generator layout (slice-aligned rows, like the aligned
+        # pipeline; for pure-session workloads an artificial row grid keeps
+        # intra-interval inter-arrival far below any session gap) ----------
+        P = wm_period_ms
+        members = [P] + [int(w.size) for w in grid_windows] \
+            + [int(w.slide) for w in grid_windows
+               if isinstance(w, SlidingWindow)]
+        import math
+
+        g = 0
+        for m in members:
+            g = math.gcd(g, m)
+        if self.session_windows:
+            min_gap = min(int(w.gap) for w in self.session_windows)
+            # row span must stay well under the smallest session gap so
+            # rows are never mistaken for silence (inter-arrival <= 2 rows)
+            while g > max(1, min_gap // 4):
+                for dv in range(2, g + 1):
+                    if g % dv == 0:
+                        g //= dv
+                        break
+        R = throughput * g // 1000     # rounded down to whole tuples/row;
+                                       # accounting uses the exact S*R
+        if R < 1:
+            raise NotImplementedError("throughput too low: <1 tuple per row")
+        S = P // g
+        self.grid, self.R, self.S = g, R, S
+        self.tuples_per_interval = S * R
+
+        # ---- silent-span schedule (cyclic, host-precomputed) -------------
+        # No session_config → no silent spans (a constant-rate stream; note
+        # sessions then never complete — callers route such workloads
+        # elsewhere, bench/runner.py Hybrid branch)
+        sc = session_config or {"count": 0}
+        n_gaps = int(sc.get("count", 8))
+        gmin = int(sc.get("minGapMs", 1500))
+        gmax = int(sc.get("maxGapMs", 4000))
+        rng = np.random.default_rng(seed)
+        lens_iv = np.maximum(1, -(-rng.integers(
+            gmin, max(gmin + 1, gmax), size=n_gaps) // P))  # ceil → intervals
+        # cyclic horizon sized so silence is ~40% of intervals — the
+        # reference's pause density in benchmark terms; gap starts random
+        horizon = max(16, int(lens_iv.sum() / 0.4) + 1)
+        silent = np.zeros(horizon, bool)
+        for ln in lens_iv:
+            pos = int(rng.integers(1, horizon))
+            silent[pos:pos + int(ln)] = True
+        if silent.all():
+            silent[0] = False
+        self._silent = silent
+        self._horizon = horizon
+        #: timed regions shorter than this may see zero completed sessions
+        #: (a session only completes after a silent span)
+        self.min_timed_intervals = 16 if self.session_windows else 0
+        self.max_fixed = max_fixed
+
+        # ---- kernels ------------------------------------------------------
+        C, A = self.config.capacity, self.config.annex_capacity
+        self.has_grid = bool(grid_windows)
+        spec = ec.EngineSpec(
+            periods=(g,) if self.has_grid else (), bands=(),
+            count_periods=(), aggs=aggs)
+        self.spec = spec
+        if self.has_grid:
+            query = ec.build_query(spec, C, A)
+            self._gc_kernel = jax.jit(ec.build_gc(spec, C, A),
+                                      donate_argnums=0)
+            make_triggers, self.T = build_trigger_grid(grid_windows, P)
+        self._init_grid = (lambda: ec.init_state(spec, C, A)) \
+            if self.has_grid else (lambda: None)
+        E = self.config.trigger_pad(1024)
+        self._emit_cap = E
+        gaps = [int(w.gap) for w in self.session_windows]
+        self._gaps = gaps
+        # live sessions per window are bounded by open + completed-awaiting-
+        # sweep (swept every interval) — a few rows, not the slice-buffer
+        # capacity; small arrays keep HBM use and per-sweep gather work tiny
+        SC_CAP = min(C, 512)
+        sweeps = [es.build_session_sweep(aggs, gp, SC_CAP, E) for gp in gaps]
+        self._sc_cap = SC_CAP
+        self._init_sessions = lambda: [
+            es.init_session_state(aggs, SC_CAP, orphan_capacity=8)
+            for _ in gaps]
+
+        # rows per generation chunk (divisor of S within the lift budget).
+        # Sparse lifts scatter into flat [d*width] targets — per-lane cost
+        # only — so they count as width 1 here; dense lifts materialize
+        # [d*R, width].
+        max_width = max(1 if a.is_sparse else a.width for a in aggs)
+        d = 1
+        for cand in range(1, S + 1):
+            if S % cand == 0 and cand * R * max_width <= max_chunk_elems:
+                d = cand
+        n_chunks = S // d
+        self._d, self._n_chunks = d, n_chunks
+        first_lw = max(0, P - max_lateness)
+
+        def gen_chunk(key, c):
+            kg = jax.random.fold_in(key, c)
+            u = jax.random.uniform(kg, (2, d, R), dtype=jnp.float32)
+            return u[0] * value_scale, u[1]
+
+        def step(grid_state, sess_states, key, interval_idx, live):
+            """live: i1 scalar — False = silent interval (no tuples)."""
+            base = interval_idx * P
+            wm = base + P
+
+            def gen_and_fold(_):
+                def body(carry, c):
+                    vals, offs = gen_chunk(key, c)
+                    flat = vals.reshape(-1)
+                    parts, folds = [], []
+                    for aspec in spec.aggs:
+                        red = {"sum": jnp.sum, "min": jnp.min,
+                               "max": jnp.max}[aspec.kind]
+                        if aspec.is_sparse:
+                            # per-row sketch partials via ONE flat [B]-lane
+                            # f32 scatter (never a dense [B, width] lift)
+                            col, v = aspec.lift_sparse(flat)
+                            row_id = jnp.arange(
+                                d * R, dtype=jnp.int32) // R
+                            fi = row_id * aspec.width \
+                                + col.astype(jnp.int32)
+                            tgt = jnp.full((d * aspec.width,),
+                                           aspec.identity, jnp.float32)
+                            if aspec.kind == "sum":
+                                tgt = tgt.at[fi].add(v)
+                            elif aspec.kind == "min":
+                                tgt = tgt.at[fi].min(v)
+                            else:
+                                tgt = tgt.at[fi].max(v)
+                            pr = tgt.reshape(d, aspec.width)
+                        else:
+                            lifted = aspec.lift_dense(flat).reshape(d, R, -1)
+                            pr = red(lifted, axis=1)              # [d, w]
+                        parts.append(pr)
+                        # the interval-wide fold shared by every session
+                        # window = the row partials reduced once more
+                        folds.append(red(pr, axis=0))             # [w]
+                    comb = carry
+                    new_comb = []
+                    for aspec, cv, fv in zip(spec.aggs, comb, folds):
+                        if aspec.kind == "sum":
+                            new_comb.append(cv + fv)
+                        elif aspec.kind == "min":
+                            new_comb.append(jnp.minimum(cv, fv))
+                        else:
+                            new_comb.append(jnp.maximum(cv, fv))
+                    return tuple(new_comb), (tuple(parts),
+                                             jnp.min(offs, axis=1),
+                                             jnp.max(offs, axis=1))
+
+                init = tuple(jnp.full((a.width,), a.identity, jnp.float32)
+                             for a in spec.aggs)
+                comb, (parts, omin, omax) = jax.lax.scan(
+                    body, init, jnp.arange(n_chunks))
+                off_lo = jnp.clip(
+                    jnp.floor(omin.reshape(S) * jnp.float32(g)), 0,
+                    g - 1).astype(jnp.int64)
+                off_hi = jnp.clip(
+                    jnp.floor(omax.reshape(S) * jnp.float32(g)), 0,
+                    g - 1).astype(jnp.int64)
+                return comb, parts, off_lo, off_hi
+
+            def no_fold(_):
+                comb = tuple(jnp.full((a.width,), a.identity, jnp.float32)
+                             for a in spec.aggs)
+                parts = tuple(jnp.full((S // d, d, a.width), a.identity,
+                                       jnp.float32) for a in spec.aggs)
+                z = jnp.zeros((S,), jnp.int64)
+                return comb, parts, z, z
+
+            comb, parts, off_lo, off_hi = jax.lax.cond(
+                live, gen_and_fold, no_fold, None)
+            row_starts = base + g * jnp.arange(S, dtype=jnp.int64)
+            t_first_iv = base + off_lo[0]          # first tuple ts
+            t_last_iv = base + (S - 1) * g + off_hi[-1]
+            n_tuples = jnp.where(live, jnp.int64(S * R), 0)
+
+            # ---- grid append (aligned, zero-scatter) ---------------------
+            if self.has_grid:
+                st = grid_state
+                n = st.n_slices
+
+                def app(buf, rows):
+                    idx = (n,) + (jnp.int32(0),) * (buf.ndim - 1)
+                    return jax.lax.dynamic_update_slice(
+                        buf, rows.astype(buf.dtype), idx)
+
+                appended = st._replace(
+                    starts=app(st.starts, row_starts),
+                    ends=app(st.ends, row_starts + g),
+                    t_first=app(st.t_first, row_starts + off_lo),
+                    t_last=app(st.t_last, row_starts + off_hi),
+                    c_start=app(st.c_start, st.current_count
+                                + R * jnp.arange(S, dtype=jnp.int64)),
+                    counts=app(st.counts, jnp.full((S,), R, jnp.int64)),
+                    partials=tuple(
+                        app(p, pr.reshape(S, -1))
+                        for p, pr in zip(st.partials, parts)),
+                    n_slices=n + S,
+                    max_event_time=jnp.maximum(st.max_event_time, t_last_iv),
+                    current_count=st.current_count + S * R,
+                    overflow=st.overflow | (n + S > C),
+                )
+                grid_state = jax.tree.map(
+                    lambda a, b: jnp.where(live, a, b), appended, st)
+                last_wm = jnp.where(interval_idx > 0, base,
+                                    jnp.int64(first_lw))
+                ws, we, tmask = make_triggers(last_wm, wm)
+                cnt, results = query(grid_state, ws, we, tmask,
+                                     jnp.zeros_like(tmask))
+            else:
+                ws = jnp.zeros((0,), jnp.int64)
+                we = jnp.zeros((0,), jnp.int64)
+                cnt = jnp.zeros((0,), jnp.int64)
+                results = tuple(jnp.zeros((0, a.width), jnp.float32)
+                                for a in spec.aggs)
+
+            # ---- session updates: at most one row per window -------------
+            new_states = []
+            ws_parts, we_parts, cnt_parts = [ws], [we], [cnt]
+            res_parts = [results]
+            for gap, sweep, sst in zip(gaps, sweeps, sess_states):
+                n_s = sst.n
+                open_last = jnp.where(
+                    n_s > 0, sst.last[jnp.maximum(n_s - 1, 0)],
+                    jnp.int64(-(1 << 62)))
+                chain = live & (n_s > 0) & (t_first_iv - open_last <= gap)
+                fresh = live & ~chain
+                row = jnp.where(chain, n_s - 1, n_s).astype(jnp.int32)
+                upd = jnp.where(live, row, SC_CAP)   # out of range = drop
+                first = sst.first.at[upd].min(
+                    jnp.where(live, t_first_iv, 1 << 62), mode="drop")
+                last = sst.last.at[upd].max(
+                    jnp.where(live, t_last_iv, -(1 << 62)), mode="drop")
+                counts = sst.counts.at[upd].add(n_tuples, mode="drop")
+                partials = []
+                for aspec, part, fv in zip(spec.aggs, sst.partials, comb):
+                    fv = jnp.where(live, fv, jnp.asarray(
+                        aspec.identity, jnp.float32))
+                    if aspec.kind == "sum":
+                        part = part.at[upd].add(fv, mode="drop")
+                    elif aspec.kind == "min":
+                        part = part.at[upd].min(fv, mode="drop")
+                    else:
+                        part = part.at[upd].max(fv, mode="drop")
+                    partials.append(part)
+                sst = sst._replace(
+                    first=first, last=last, counts=counts,
+                    partials=tuple(partials),
+                    n=(n_s + jnp.where(fresh, 1, 0)).astype(jnp.int32),
+                    overflow=sst.overflow | (fresh & (n_s >= SC_CAP)))
+                sst, m, e_s, e_e, e_c, e_p = sweep(
+                    sst, jnp.int64(wm), jnp.int64(wm - max_lateness))
+                new_states.append(sst)
+                ws_parts.append(e_s)
+                we_parts.append(e_e)
+                cnt_parts.append(e_c)
+                res_parts.append(e_p)
+
+            out = (jnp.concatenate(ws_parts), jnp.concatenate(we_parts),
+                   jnp.concatenate(cnt_parts),
+                   tuple(jnp.concatenate([r[i] for r in res_parts])
+                         for i in range(len(spec.aggs))))
+            return grid_state, new_states, out
+
+        self._step = jax.jit(step, donate_argnums=(0, 1),
+                             static_argnames=()) if self.has_grid else \
+            jax.jit(step, donate_argnums=(1,))
+        self._root = None
+        self.state = None
+        self.sess_states = None
+        self._interval = 0
+
+    # -- driver-facing interface (same shape as the other pipelines) ------
+    def reset(self) -> None:
+        import jax
+
+        self.state = self._init_grid()
+        self.sess_states = self._init_sessions()
+        self._root = jax.random.PRNGKey(self.seed)
+        self._interval = 0
+
+    def live(self, i: int) -> bool:
+        return not bool(self._silent[i % self._horizon])
+
+    def tuples_in_range(self, i0: int, i1: int) -> int:
+        return sum(self.tuples_per_interval
+                   for i in range(i0, i1) if self.live(i))
+
+    def run(self, n_intervals: int, collect: bool = True):
+        import jax
+        import numpy as np
+
+        if self.state is None and self.sess_states is None:
+            self.reset()
+        out = []
+        for _ in range(n_intervals):
+            i = self._interval
+            self.state, self.sess_states, res = self._step(
+                self.state, self.sess_states,
+                jax.random.fold_in(self._root, i), np.int64(i),
+                np.bool_(self.live(i)))
+            self._interval += 1
+            if collect:
+                out.append(res)
+            if self.has_grid and self._interval % self.gc_every == 0:
+                bound = (self._interval * self.wm_period_ms
+                         - self.max_lateness - self.max_fixed)
+                self.state = self._gc_kernel(self.state, np.int64(bound))
+        return out
+
+    def sync(self) -> int:
+        import jax
+
+        anchor = self.state.n_slices if self.has_grid \
+            else self.sess_states[0].n
+        return int(jax.device_get(anchor))
+
+    def check_overflow(self) -> None:
+        import jax
+
+        flags = [s.overflow for s in self.sess_states]
+        if self.has_grid:
+            flags.append(self.state.overflow)
+        if any(bool(v) for v in jax.device_get(flags)):
+            raise RuntimeError(
+                "slice/session buffer overflow: raise capacity")
+
+    def materialize_interval(self, i: int):
+        """Regenerate interval i's tuple stream on host (testing): returns
+        (vals f32, ts i64), row-major by slice row — EMPTY for silent
+        intervals. Bit-identical to the device generator."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.live(i):
+            return np.empty(0, np.float32), np.empty(0, np.int64)
+        if self._root is None:
+            self._root = jax.random.PRNGKey(self.seed)
+        key = jax.random.fold_in(self._root, i)
+        g, d, R, P = self.grid, self._d, self.R, self.wm_period_ms
+        vals_all, ts_all = [], []
+        for c in range(self._n_chunks):
+            kg = jax.random.fold_in(key, jnp.int64(c))
+            u = jax.device_get(jax.random.uniform(
+                kg, (2, d, R), dtype=jnp.float32))
+            vals, offs = u[0] * np.float32(self.value_scale), u[1]
+            row_starts = (i * P + g * (c * d + np.arange(d, dtype=np.int64)))
+            off_ms = np.clip(np.floor(np.asarray(offs, np.float32)
+                                      * np.float32(g)), 0, g - 1)
+            ts = row_starts[:, None] + off_ms.astype(np.int64)
+            vals_all.append(np.asarray(vals).reshape(-1))
+            ts_all.append(ts.reshape(-1))
+        return np.concatenate(vals_all), np.concatenate(ts_all)
+
+    def lowered_results(self, interval_out) -> list:
+        from .pipeline import lower_interval
+
+        return lower_interval(self.aggregations, interval_out)

@@ -1,0 +1,124 @@
+"""Differential tests: the fused SessionStreamPipeline vs the host oracle.
+
+The session pipeline is the benchmark execution mode for session workloads
+(BASELINE config 5): silence-separated sessions at constant rate, one fused
+dispatch per watermark interval. These tests materialize the pipeline's own
+generated stream (bit-exact device RNG replay, silent intervals empty),
+feed it to the reference-semantics simulator, and require identical window
+results at every watermark — sessions, multi-gap sessions, and
+session+sliding mixes.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    MaxAggregation,
+    SessionWindow,
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.session_pipeline import SessionStreamPipeline
+
+Time = WindowMeasure.Time
+
+CFG = EngineConfig(capacity=1 << 12, annex_capacity=8, min_trigger_pad=32)
+SC = {"count": 6, "minGapMs": 1500, "maxGapMs": 4000}
+
+
+def run_diff(windows, agg_factories, n_intervals=20, throughput=4000,
+             seed=7):
+    p = SessionStreamPipeline(
+        windows, [mk() for mk in agg_factories], config=CFG,
+        throughput=throughput, wm_period_ms=1000, max_lateness=1000,
+        seed=seed, session_config=SC)
+    sim = SlicingWindowOperator()
+    for w in windows:
+        sim.add_window_assigner(w)
+    for mk in agg_factories:
+        sim.add_aggregation(mk())
+    sim.set_max_lateness(1000)
+
+    p.reset()
+    n_emitted = 0
+    for i in range(n_intervals):
+        out = p.run(1)[0]
+        vals, ts = p.materialize_interval(i)
+        if ts.size:
+            order = np.argsort(ts, kind="stable")
+            sim.process_elements(vals[order], ts[order])
+        wm = (i + 1) * 1000
+        want = {}
+        for w in sim.process_watermark(wm):
+            if w.has_value():
+                want.setdefault((w.get_start(), w.get_end()),
+                                w.get_agg_values())
+        got = {(s, e): v for (s, e, c, v) in p.lowered_results(out)}
+        assert set(got) == set(want), (i, set(want) ^ set(got))
+        for k in want:
+            for a, b in zip(want[k], got[k]):
+                assert float(a) == pytest.approx(float(b), rel=2e-4), (i, k)
+        n_emitted += len(got)
+    p.check_overflow()
+    return n_emitted
+
+
+def test_session_pipeline_pure_session():
+    n = run_diff([SessionWindow(Time, 1000)],
+                 [SumAggregation, MaxAggregation])
+    assert n > 0          # at least one session completed in the horizon
+
+
+def test_session_pipeline_two_gaps():
+    n = run_diff([SessionWindow(Time, 800), SessionWindow(Time, 2500)],
+                 [SumAggregation])
+    assert n > 0
+
+
+def test_session_pipeline_session_sliding_mix():
+    n = run_diff([SessionWindow(Time, 1000), SlidingWindow(Time, 5000, 500)],
+                 [SumAggregation, MaxAggregation])
+    assert n > 0
+
+
+def test_session_pipeline_hll_matches_device_operator():
+    """HLL oracle is the DEVICE operator path (same device lift/hash — the
+    host HLL hashes differently by design, so host estimates are not
+    comparable): identical tuples through TpuWindowOperator's session
+    kernels must yield the same windows and the same register estimates
+    as the pipeline's shared interval fold."""
+    from scotty_tpu.core.aggregates import HyperLogLogAggregation
+    from scotty_tpu.engine import TpuWindowOperator
+
+    p = SessionStreamPipeline(
+        [SessionWindow(Time, 1000)], [HyperLogLogAggregation(8)], config=CFG,
+        throughput=4000, wm_period_ms=1000, max_lateness=1000, seed=7,
+        session_config=SC)
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=1 << 10, batch_size=256, annex_capacity=8,
+        min_trigger_pad=32))
+    op.add_window_assigner(SessionWindow(Time, 1000))
+    op.add_aggregation(HyperLogLogAggregation(8))
+    op.set_max_lateness(1000)
+    p.reset()
+    total = 0
+    for i in range(20):
+        out = p.run(1)[0]
+        vals, ts = p.materialize_interval(i)
+        if ts.size:
+            order = np.argsort(ts, kind="stable")
+            op.process_elements(vals[order], ts[order])
+        want = [((w.get_start(), w.get_end()), w.get_agg_values()[0])
+                for w in op.process_watermark((i + 1) * 1000)
+                if w.has_value()]
+        got = [((s, e), v[0]) for (s, e, c, v) in p.lowered_results(out)]
+        assert [k for k, _ in want] == [k for k, _ in got], i
+        for (_, a), (_, b) in zip(want, got):
+            # same tuples, same device lift → same registers → same estimate
+            assert float(a) == pytest.approx(float(b), rel=1e-5), i
+        total += len(got)
+    p.check_overflow()
+    assert total > 0
