@@ -59,9 +59,13 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
     sample emit latency with a drained queue."""
     import jax
 
-    max_span = max(w.clear_delay() for w in pipeline.windows)
+    from ..core.windows import SessionWindow
+
+    max_span = max(int(w.gap) if isinstance(w, SessionWindow)
+                   else w.clear_delay() for w in pipeline.windows)
     warmup = -(-max_span // pipeline.wm_period_ms) + 2
-    timed = max(1, cfg.runtime_s)
+    timed = max(1, cfg.runtime_s,
+                getattr(pipeline, "min_timed_intervals", 0))
     if mode == "buckets":
         # the no-sharing baseline is deliberately O(#triggers × ring) per
         # interval — a few deterministic intervals measure it fine
@@ -73,16 +77,18 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
     def _trigger_horizon(w):
         from ..core.windows import FixedBandWindow, SlidingWindow
 
+        if isinstance(w, SessionWindow):
+            return 0                    # emission cadence is gap-driven;
+                                        # min_timed_intervals covers it
         if isinstance(w, FixedBandWindow):
             return int(w.start + w.size)      # its single trigger point
         if isinstance(w, SlidingWindow):
-            # a FRESH pipeline's first sliding trigger fires at ~size
-            # (ends <= wm+1 with starts >= 0); only the prefill path has
-            # already warmed past that, so the shorter slide-based horizon
-            # is valid only there (ADVICE r2)
-            if hasattr(pipeline, "prefill"):
-                return int(w.slide)
-            return int(max(w.size, w.slide))
+            # the warmup phase (prefill or a full run) always advances past
+            # the widest window span before the timed region, so the first
+            # sliding trigger has already fired: one slide per further
+            # trigger is the exact post-warmup horizon (r3 review —
+            # max(size, slide) here only inflated cell wall time)
+            return int(w.slide)
         return int(w.size)
 
     max_period = max(_trigger_horizon(w) for w in pipeline.windows)
@@ -95,6 +101,7 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         pipeline.run(warmup, collect=False)
     pipeline.sync()
 
+    timed_from = getattr(pipeline, "_interval", warmup)
     t0 = time.perf_counter()
     outs = pipeline.run(timed, collect=True)
     pipeline.sync()
@@ -112,7 +119,12 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         lats.append((time.perf_counter() - t1) * 1e3)
     pipeline.check_overflow()
 
-    n_tuples = timed * pipeline.tuples_per_interval
+    if hasattr(pipeline, "tuples_in_range"):
+        # silence-aware accounting (session pipelines: silent intervals
+        # carry no tuples)
+        n_tuples = pipeline.tuples_in_range(timed_from, timed_from + timed)
+    else:
+        n_tuples = timed * pipeline.tuples_per_interval
     return BenchResult(
         name=cfg.name, windows=window_spec, aggregation=agg_name,
         tuples_per_sec=n_tuples / wall,
@@ -127,7 +139,7 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     engine = {"Slicing": "TpuEngine", "Flink": "Buckets"}.get(engine, engine)
 
     if engine == "TpuEngine":
-        if cfg.out_of_order_pct == 0 and not cfg.session_config:
+        if not cfg.session_config:
             from ..engine import EngineConfig
             from ..engine.pipeline import AlignedStreamPipeline, StreamPipeline
 
@@ -142,31 +154,31 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                     windows, [make_aggregation(agg_name)], config=econf,
                     throughput=tp, wm_period_ms=cfg.watermark_period_ms,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
-                    gc_every=32)
+                    gc_every=32, out_of_order_pct=cfg.out_of_order_pct)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
                                           "aligned")
             except NotImplementedError:
                 pass
             try:
-                # fused fallback for in-order specs the aligned pipeline
-                # rejects (fixed-band windows, sketch lifts on bands…):
-                # still one XLA dispatch per watermark interval, via the
-                # general scatter ingest
+                # fused fallback for specs the aligned pipeline rejects
+                # (fixed-band windows, sketch lifts on bands…): still one
+                # XLA dispatch per watermark interval, via the general
+                # scatter ingest (+ per-sub-batch late lanes when OOO)
                 p = StreamPipeline(
                     windows, [make_aggregation(agg_name)], config=econf,
                     throughput=cfg.throughput,
                     wm_period_ms=cfg.watermark_period_ms,
-                    max_lateness=cfg.max_lateness, seed=cfg.seed)
+                    max_lateness=cfg.max_lateness, seed=cfg.seed,
+                    out_of_order_pct=cfg.out_of_order_pct)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
                                           "fused")
             except NotImplementedError:
                 pass
-        # out-of-order / count-measure / session specs: batch-at-a-time
-        # device operator via the classic harness (device-generated streams
-        # with split late sub-batches). A fused OOO StreamPipeline exists
-        # (out_of_order_pct ctor arg, differential-tested) but measured no
-        # faster than the split batch path at a much larger compile, so the
-        # runner doesn't default to it.
+        # count-measure / session specs: batch-at-a-time device operator
+        # via the classic harness (device-generated streams with split
+        # late sub-batches). Anything the fused pipelines reject pays
+        # per-batch dispatch overhead (~5-15 ms each on tunneled devices —
+        # docs/DESIGN.md), so the pipelines above are always preferred.
         return run_benchmark(cfg, window_spec, agg_name, engine="TpuEngine")
 
     if engine == "Buckets":
@@ -187,8 +199,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if engine == "Hybrid":
         # resolve the backend the way HybridWindowOperator would, then use
         # the matching measurement loop: device-realizable workloads take
-        # the async TpuEngine path (the sync loop pays a full tunnel
-        # round-trip per watermark), everything else runs on the host
+        # a fused pipeline (one dispatch per watermark interval) or the
+        # async TpuEngine path; everything else runs on the host
         from ..hybrid import HybridWindowOperator
 
         probe = HybridWindowOperator(
@@ -197,6 +209,24 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             probe.add_window_assigner(w)
         probe.add_aggregation(make_aggregation(agg_name))
         if probe._device_realizable():
+            if cfg.out_of_order_pct == 0 and cfg.session_config:
+                from ..engine import EngineConfig
+                from ..engine.session_pipeline import SessionStreamPipeline
+
+                try:
+                    p = SessionStreamPipeline(
+                        windows, [make_aggregation(agg_name)],
+                        config=EngineConfig(capacity=cfg.capacity,
+                                            annex_capacity=8,
+                                            min_trigger_pad=32),
+                        throughput=cfg.throughput,
+                        wm_period_ms=cfg.watermark_period_ms,
+                        max_lateness=cfg.max_lateness, seed=cfg.seed,
+                        session_config=cfg.session_config)
+                    return _run_pipeline_cell(p, cfg, window_spec,
+                                              agg_name, "session")
+                except NotImplementedError:
+                    pass
             return run_benchmark(cfg, window_spec, agg_name,
                                  engine="TpuEngine")
         return run_benchmark(cfg, window_spec, agg_name, engine="Hybrid")
@@ -217,13 +247,38 @@ def run_keyed_cell(cfg: BenchmarkConfig, window_spec: str,
     KeyedScottyWindowOperator.java:56-66 — there a HashMap of JVM objects,
     here a [K, ...] slice-buffer batch; SURVEY.md §2.8).
 
-    The stream is generated ON DEVICE ([K, B] rounds, row k = key k's
-    tuples, cumulative-gap timestamps so rows are sorted by construction)
-    and fed zero-copy — the keyed analogue of make_device_source. Feeding
-    pre-partitioned per-key rows is the same work split as the reference,
-    where the host engine's keyBy does the partitioning before Scotty sees
-    the tuples; host-side partitioning is measured separately by
-    bench.micro's host_pack phase."""
+    Preferred execution mode: the fused KeyedAlignedPipeline (one dispatch
+    per watermark interval — the round-driven loop below pays ~5-15 ms of
+    dispatch overhead per [K, B] round on tunneled devices, which capped
+    the r2 artifact at 41 M t/s). The stream is generated ON DEVICE and
+    pre-partitioned per key — the same work split as the reference, where
+    the host engine's keyBy partitions before Scotty sees the tuples;
+    host-side partitioning is measured separately by bench.micro's
+    host_pack phase."""
+    from ..parallel.keyed import KeyedAlignedPipeline
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    try:
+        from ..engine import EngineConfig
+
+        p = KeyedAlignedPipeline(
+            windows, [make_aggregation(agg_name)], n_keys=cfg.n_keys,
+            config=EngineConfig(capacity=cfg.capacity, annex_capacity=8,
+                                min_trigger_pad=32),
+            throughput=cfg.throughput, wm_period_ms=cfg.watermark_period_ms,
+            max_lateness=cfg.max_lateness, seed=cfg.seed)
+        return _run_pipeline_cell(p, cfg, window_spec, agg_name, "keyed")
+    except NotImplementedError:
+        pass
+    return _run_keyed_rounds_cell(cfg, windows, window_spec, agg_name)
+
+
+def _run_keyed_rounds_cell(cfg: BenchmarkConfig, windows, window_spec: str,
+                           agg_name: str) -> BenchResult:
+    """Round-driven keyed fallback for specs the fused keyed pipeline
+    rejects: device-generated [K, B] rounds through
+    KeyedTpuWindowOperator.ingest_device_round (pays per-round dispatch
+    overhead — the fused pipeline is preferred)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -231,7 +286,6 @@ def run_keyed_cell(cfg: BenchmarkConfig, window_spec: str,
     from ..engine import EngineConfig
     from ..parallel import KeyedTpuWindowOperator
 
-    windows = parse_window_spec(window_spec, seed=cfg.seed)
     K = cfg.n_keys
     B = max(64, cfg.batch_size // max(1, K))
     econf = EngineConfig(capacity=cfg.capacity, batch_size=B,

@@ -362,3 +362,259 @@ class KeyedTpuWindowOperator:
                         WindowMeasure.Time, int(ws[i]), int(we[i]), values,
                         True)))
         return out
+
+
+class KeyedAlignedPipeline:
+    """Fused keyed benchmark pipeline: one XLA dispatch per watermark
+    interval serving ``n_keys`` independent keyed operators.
+
+    The keyed edition of :class:`..engine.pipeline.AlignedStreamPipeline`:
+    each key's paced generator emits R tuples per slice row (the reference's
+    per-key constant-rate source after keyBy partitioning), so per-key
+    ingest is a dense [K, S, R] row reduction + one contiguous append into
+    the [K, C] slice buffers — no scatters — and every key's triggered
+    windows are answered by ONE vmapped range query. Per-dispatch overhead
+    (~5-15 ms on tunneled devices) amortizes over the whole interval
+    instead of over one [K, B] round, which is what capped the round-driven
+    keyed cell at ~40 M tuples/s (BASELINE.md r2).
+
+    ``mesh``/``axis``: optional Mesh sharding of the key dimension — the
+    program is per-key pointwise, so XLA partitions it collective-free
+    (SURVEY.md §2.8 (b)).
+    """
+
+    def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
+                 n_keys: int, config: Optional[EngineConfig] = None,
+                 throughput: int = 64_000_000, wm_period_ms: int = 1000,
+                 max_lateness: int = 1000, seed: int = 0, gc_every: int = 8,
+                 max_chunk_elems: int = 1 << 24,
+                 value_scale: float = 10_000.0, mesh=None, axis: str = "keys"):
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine import core as ec
+        from ..engine.pipeline import AlignedStreamPipeline, \
+            build_trigger_grid
+
+        self.config = config or EngineConfig()
+        self.windows = list(windows)
+        self.aggregations = list(aggregations)
+        self.n_keys = K = int(n_keys)
+        self.wm_period_ms = P = wm_period_ms
+        self.max_lateness = max_lateness
+        self.gc_every = gc_every
+        self.seed = seed
+        self.mesh, self.axis = mesh, axis
+        self.value_scale = float(value_scale)
+
+        max_fixed = 0
+        for w in self.windows:
+            if w.measure != WindowMeasure.Time or not isinstance(
+                    w, (TumblingWindow, SlidingWindow)):
+                raise NotImplementedError(
+                    "keyed aligned pipeline: time tumbling/sliding only")
+            max_fixed = max(max_fixed, w.clear_delay())
+        aggs = tuple(a.device_spec() for a in self.aggregations)
+        if any(a is None or a.is_sparse for a in aggs):
+            raise NotImplementedError(
+                "keyed aligned pipeline: dense-lift aggregations only")
+        g = AlignedStreamPipeline.slice_grid(self.windows, P)
+        per_key = throughput // K
+        R = per_key * g // 1000
+        if R < 1:
+            raise NotImplementedError("throughput too low: <1 tuple/slice/key")
+        S = P // g
+        self.grid, self.R, self.S = g, R, S
+        self.max_fixed = max_fixed
+        self.tuples_per_interval = K * S * R
+
+        spec = ec.EngineSpec(periods=(g,), bands=(), count_periods=(),
+                             aggs=aggs)
+        self.spec = spec
+        C, A = self.config.capacity, self.config.annex_capacity
+        query1 = ec.build_query(spec, C, A)
+        gc1 = ec.build_gc(spec, C, A)
+        self._gc_kernel = jax.jit(
+            jax.vmap(gc1, in_axes=(0, None)), donate_argnums=0)
+        make_triggers, self.T = build_trigger_grid(self.windows, P)
+
+        # R-chunking keeps the [K, S, Rc, width] lift temporary bounded
+        # (the budget counts LIFTED elements, like the other pipelines)
+        max_width = max(a.width for a in aggs)
+        n_chunks = 1
+        while (K * S * (R // n_chunks) * max_width) > max_chunk_elems \
+                and n_chunks < R:
+            n_chunks += 1
+        while R % n_chunks:
+            n_chunks += 1
+        Rc = R // n_chunks
+        self._n_chunks, self._rc = n_chunks, Rc
+        first_lw = max(0, P - max_lateness)
+        red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
+
+        def step(state, key, interval_idx):
+            base = interval_idx * P
+
+            def body(carry, c):
+                parts_c, omin_c, omax_c = carry
+                kg = jax.random.fold_in(key, c)
+                u = jax.random.uniform(kg, (2, K, S, Rc),
+                                       dtype=jnp.float32)
+                vals, offs = u[0] * value_scale, u[1]
+                new_parts = []
+                for aspec, acc in zip(aggs, parts_c):
+                    lifted = aspec.lift_dense(vals.reshape(-1)) \
+                        .reshape(K, S, Rc, -1)
+                    upd = red[aspec.kind](lifted, axis=2)    # [K, S, w]
+                    if aspec.kind == "sum":
+                        new_parts.append(acc + upd)
+                    elif aspec.kind == "min":
+                        new_parts.append(jnp.minimum(acc, upd))
+                    else:
+                        new_parts.append(jnp.maximum(acc, upd))
+                return (tuple(new_parts),
+                        jnp.minimum(omin_c, jnp.min(offs, axis=2)),
+                        jnp.maximum(omax_c, jnp.max(offs, axis=2))), None
+
+            init = (tuple(jnp.full((K, S, a.width), a.identity, jnp.float32)
+                          for a in aggs),
+                    jnp.ones((K, S), jnp.float32),
+                    jnp.zeros((K, S), jnp.float32))
+            (parts, omin, omax), _ = jax.lax.scan(
+                body, init, jnp.arange(n_chunks))
+
+            row_starts = base + g * jnp.arange(S, dtype=jnp.int64)
+            off_lo = jnp.clip(jnp.floor(omin * jnp.float32(g)), 0,
+                              g - 1).astype(jnp.int64)          # [K, S]
+            off_hi = jnp.clip(jnp.floor(omax * jnp.float32(g)), 0,
+                              g - 1).astype(jnp.int64)
+            n = state.n_slices                                   # [K] i32
+
+            def app1(buf, rows, nn):
+                idx = (nn,) + (jnp.int32(0),) * (buf.ndim - 1)
+                return jax.lax.dynamic_update_slice(
+                    buf, rows.astype(buf.dtype), idx)
+
+            app = jax.vmap(app1)
+            rs_k = jnp.broadcast_to(row_starts, (K, S))
+            state = state._replace(
+                starts=app(state.starts, rs_k, n),
+                ends=app(state.ends, rs_k + g, n),
+                t_first=app(state.t_first, rs_k + off_lo, n),
+                t_last=app(state.t_last, rs_k + off_hi, n),
+                c_start=app(state.c_start, state.current_count[:, None]
+                            + R * jnp.arange(S, dtype=jnp.int64)[None, :],
+                            n),
+                counts=app(state.counts,
+                           jnp.full((K, S), R, jnp.int64), n),
+                partials=tuple(app(p, pr, n)
+                               for p, pr in zip(state.partials, parts)),
+                n_slices=n + S,
+                max_event_time=jnp.maximum(
+                    state.max_event_time, rs_k[:, -1] + off_hi[:, -1]),
+                current_count=state.current_count + S * R,
+                overflow=state.overflow | (n + S > C),
+            )
+            last_wm = jnp.where(interval_idx > 0, base, jnp.int64(first_lw))
+            ws, we, tmask = make_triggers(last_wm, base + P)
+            cnt, results = jax.vmap(
+                query1, in_axes=(0, None, None, None, None))(
+                state, ws, we, tmask, jnp.zeros_like(tmask))
+            return state, (ws, we, cnt, results)
+
+        self._step = jax.jit(step, donate_argnums=0)
+        self._init_state = lambda: self._broadcast(ec.init_state(spec, C, A))
+        self._root = None
+        self.state = None
+        self._interval = 0
+
+    def _broadcast(self, one):
+        import jax
+        import jax.numpy as jnp
+
+        st = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.n_keys,) + x.shape), one)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            st = jax.device_put(st, NamedSharding(self.mesh, P(self.axis)))
+        return st
+
+    def reset(self) -> None:
+        import jax
+
+        self.state = self._init_state()
+        self._root = jax.random.PRNGKey(self.seed)
+        self._interval = 0
+
+    def run(self, n_intervals: int, collect: bool = True):
+        import jax
+
+        if self.state is None:
+            self.reset()
+        out = []
+        for _ in range(n_intervals):
+            i = self._interval
+            self.state, res = self._step(
+                self.state, jax.random.fold_in(self._root, i), np.int64(i))
+            self._interval += 1
+            if collect:
+                out.append(res)
+            if self._interval % self.gc_every == 0:
+                bound = (self._interval * self.wm_period_ms
+                         - self.max_lateness - self.max_fixed)
+                self.state = self._gc_kernel(self.state, np.int64(bound))
+        return out
+
+    def sync(self) -> int:
+        import jax
+
+        return int(jax.device_get(self.state.n_slices[0]))
+
+    def check_overflow(self) -> None:
+        import jax
+
+        if bool(np.any(jax.device_get(self.state.overflow))):
+            raise RuntimeError("slice buffer overflow on some key shard")
+
+    def materialize_interval(self, i: int, key_idx: int):
+        """Regenerate key ``key_idx``'s tuple stream for interval i on host
+        (testing): (vals f32, ts i64), row-major by slice row."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._root is None:
+            self._root = jax.random.PRNGKey(self.seed)
+        key = jax.random.fold_in(self._root, i)
+        g, S, Rc, P = self.grid, self.S, self._rc, self.wm_period_ms
+        vals_all, ts_all = [], []
+        for c in range(self._n_chunks):
+            kg = jax.random.fold_in(key, jnp.int64(c))
+            u = jax.device_get(jax.random.uniform(
+                kg, (2, self.n_keys, S, Rc), dtype=jnp.float32))
+            vals = u[0][key_idx] * np.float32(self.value_scale)
+            offs = u[1][key_idx]
+            row_starts = i * P + g * np.arange(S, dtype=np.int64)
+            off_ms = np.clip(np.floor(np.asarray(offs, np.float32)
+                                      * np.float32(g)), 0, g - 1)
+            ts = row_starts[:, None] + off_ms.astype(np.int64)
+            vals_all.append(vals.reshape(-1))
+            ts_all.append(ts.reshape(-1))
+        return np.concatenate(vals_all), np.concatenate(ts_all)
+
+    def lowered_results_for_key(self, interval_out, key_idx: int) -> list:
+        """Fetch + lower one interval's window results for one key."""
+        import jax
+
+        ws, we, cnt, results = jax.device_get(interval_out)
+        cnt_k = cnt[key_idx]
+        rows = []
+        lowered = []
+        for agg, res in zip(self.aggregations, results):
+            spec = agg.device_spec()
+            lowered.append(np.asarray(spec.lower(res[key_idx], cnt_k)))
+        for i in range(ws.shape[0]):
+            if cnt_k[i] > 0:
+                rows.append((int(ws[i]), int(we[i]), int(cnt_k[i]),
+                             [lw[i] for lw in lowered]))
+        return rows
