@@ -27,7 +27,7 @@ REFERENCE_SCOTTY_RATE = 1_700_000   # tuples/s/core offered load the reference
 THROUGHPUT = 200_000_000            # offered tuples per event-second
 WARMUP_INTERVALS = 62               # fill the 60 s window span (+compile)
 TIMED_INTERVALS = 60
-LATENCY_SAMPLES = 12
+LATENCY_SAMPLES = 100               # ≥100 when the 45 s budget allows
 
 
 def main() -> None:
@@ -61,13 +61,24 @@ def main() -> None:
     # emit latency: drain the queue, then time one full watermark-interval
     # dispatch → results-fetched round trip (upper bound on emit latency —
     # the fused program ingests the interval and answers its triggers).
+    # Every sample pays at least the device→host round-trip floor, which
+    # the tunnel inflates to ~125 ms — reported alongside so the
+    # interval-attributable part is visible.
+    from scotty_tpu.bench.runner import measure_rtt_floor
+
+    rtt_floor = measure_rtt_floor()
     lats = []
+    t_lat = time.perf_counter()
+    n_samples = 0
     for _ in range(LATENCY_SAMPLES):
         p.sync()
         t1 = time.perf_counter()
         out = p.run(1)[0]
         jax.device_get((out[2], out[3]))
         lats.append((time.perf_counter() - t1) * 1e3)
+        n_samples += 1
+        if n_samples >= 5 and time.perf_counter() - t_lat > 45.0:
+            break
     p.check_overflow()
 
     tput = TIMED_INTERVALS * p.tuples_per_interval / wall
@@ -77,9 +88,12 @@ def main() -> None:
         "unit": "tuples/s/chip",
         "vs_baseline": round(tput / REFERENCE_SCOTTY_RATE, 2),
         "p99_window_emit_ms": round(float(np.percentile(lats, 99)), 2),
+        "p50_window_emit_ms": round(float(np.percentile(lats, 50)), 2),
+        "rtt_floor_ms": round(rtt_floor, 2),
+        "latency_samples": n_samples,
         "windows_emitted": windows_emitted,
         "tuples": TIMED_INTERVALS * p.tuples_per_interval,
-        "event_seconds": WARMUP_INTERVALS + TIMED_INTERVALS + LATENCY_SAMPLES,
+        "event_seconds": WARMUP_INTERVALS + TIMED_INTERVALS + n_samples,
         "timed_wall_s": round(wall, 3),
     }))
 

@@ -64,6 +64,11 @@ class DeviceAggregateSpec:
     #: Hashable semantic identity (aggregation type + parameters) — the
     #: callables above are closures, so kernel caches key on this instead.
     token: tuple = ()
+    #: Optional jnp twin of ``lower`` for DEVICE-side finalization: emitting
+    #: lowered values (one float per window) instead of raw partials cuts
+    #: the result payload by ``width``× — decisive for wide sketches on
+    #: bandwidth-limited device→host links (docs/DESIGN.md).
+    lower_device: Callable[[Any, Any], Any] | None = None
 
     @property
     def is_sparse(self) -> bool:
@@ -435,12 +440,23 @@ class DDSketchQuantileAggregation(AggregateFunction):
             vals = np.where(b == 0, 0.0, 2.0 * upper / (1.0 + gamma))
             return np.where(total > 0, vals, np.nan)
 
+        def lower_device(partials, counts):
+            total = jnp.sum(partials, axis=-1)
+            rank = q * jnp.maximum(total - 1, 0)
+            cum = jnp.cumsum(partials, axis=-1)
+            b = jnp.argmax(cum > rank[..., None], axis=-1)
+            upper = min_value * jnp.power(jnp.float32(gamma),
+                                          (b - 1).astype(jnp.float32))
+            vals = jnp.where(b == 0, 0.0, 2.0 * upper / (1.0 + gamma))
+            return jnp.where(total > 0, vals, jnp.nan)
+
         return DeviceAggregateSpec(
             kind="sum",
             width=self.n_buckets,
             identity=0.0,
             lift_sparse=lift_sparse,
             lower=lower,
+            lower_device=lower_device,
             token=("ddsketch", self.quantile, self.alpha, self.n_buckets,
                    self.min_value),
         )
@@ -544,12 +560,23 @@ class HyperLogLogAggregation(AggregateFunction):
         def lower(partials: np.ndarray, counts: np.ndarray) -> np.ndarray:
             return est(np.maximum(partials, 0.0)).astype(np.float64)
 
+        alpha = self.alpha
+
+        def lower_device(partials, counts):
+            regs = jnp.maximum(partials, 0.0)
+            raw = alpha * m * m / jnp.sum(jnp.exp2(-regs), axis=-1)
+            zeros = jnp.sum(regs == 0, axis=-1)
+            lc = m * jnp.log(jnp.where(zeros > 0,
+                                       m / jnp.maximum(zeros, 1), 1.0))
+            return jnp.where((raw <= 2.5 * m) & (zeros > 0), lc, raw)
+
         return DeviceAggregateSpec(
             kind="max",
             width=self.m,
             identity=0.0,
             lift_sparse=lift_sparse,
             lower=lower,
+            lower_device=lower_device,
             token=("hll", self.p),
         )
 

@@ -1,0 +1,96 @@
+"""Host→device ingest pipeline (SURVEY.md §7 stage 7): double-buffered
+transfers of packed tuple batches overlapping the previous batch's ingest.
+
+The reference's LoadGeneratorSource emits tuples in-process
+(benchmark/.../LoadGeneratorSource.java:10-87) — there IS no host→device
+boundary in the reference. On TPU the boundary is real, and this module is
+the framework's story for streams that originate in host memory:
+
+* **Packing**: an in-order batch ships as ``(base i64 scalar, ts-delta
+  u32[B], value f32[B])`` — 8 bytes/tuple instead of 12; deltas are exact
+  while the batch spans < 2^32 ms (~49 days).
+* **Double buffering**: ``feed()`` issues the H2D transfers and the
+  unpack+ingest dispatch WITHOUT any device sync, so batch i+1's transfer
+  overlaps batch i's ingest kernel under the runtime's async dispatch
+  queue. The slice-engine state advances through the same donated-buffer
+  kernels as device-resident sources.
+* **Transport saturation is the design target**: the ingest kernels
+  sustain multi-G tuples/s from device-resident sources (bench.py), so a
+  host-fed stream is transport-bound on any link slower than that.
+  ``measure_link()`` reports the raw ``device_put`` bandwidth of the same
+  packed buffers; an end-to-end rate close to it means the pipeline adds
+  ~nothing on top of the link. (On the tunneled devices this repo
+  benchmarks on, the measured link is ~1 MB/s — see BASELINE.md — so
+  absolute host-fed numbers say nothing about the engine; the saturation
+  ratio does.)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import jax_config  # noqa: F401
+
+from .operator import TpuWindowOperator
+
+
+class HostFeed:
+    """Double-buffered packed feed into a :class:`TpuWindowOperator`.
+
+    Batches must be in-order (ascending ts, each batch at/above the
+    previous batch's max) and exactly ``op.config.batch_size`` long —
+    the operator's zero-copy device-batch contract.
+    """
+
+    def __init__(self, op: TpuWindowOperator):
+        import jax
+        import jax.numpy as jnp
+
+        self.op = op
+        self._unpack = jax.jit(
+            lambda base, d: jnp.int64(base) + d.astype(jnp.int64))
+        self.bytes_per_tuple = 8          # u32 delta + f32 value
+
+    @staticmethod
+    def pack(vals: np.ndarray, ts: np.ndarray):
+        """Host-side packing: (base, deltas u32, vals f32)."""
+        base = np.int64(ts[0])
+        deltas = (ts - base).astype(np.uint32)
+        return base, deltas, np.ascontiguousarray(vals, dtype=np.float32)
+
+    def feed_packed(self, base: np.int64, deltas: np.ndarray,
+                    vals: np.ndarray, ts_min: int, ts_max: int) -> None:
+        """Transfer + dispatch one packed batch; returns without syncing."""
+        import jax
+
+        d_dev = jax.device_put(deltas)
+        v_dev = jax.device_put(vals)
+        ts_dev = self._unpack(base, d_dev)
+        self.op.ingest_device_batch(v_dev, ts_dev, ts_min, ts_max)
+
+    def feed(self, vals: np.ndarray, ts: np.ndarray) -> None:
+        base, deltas, v = self.pack(vals, ts)
+        self.feed_packed(base, deltas, v, int(ts[0]), int(ts[-1]))
+
+
+def measure_link(batch_size: int, n_batches: int = 8) -> float:
+    """Raw host→device bandwidth of the packed layout (MB/s): device_put
+    of (u32, f32) pairs, consumed by a trivial device reduction so the
+    measurement can't complete before the bytes actually land."""
+    import jax
+    import jax.numpy as jnp
+
+    consume = jax.jit(lambda d, v: jnp.sum(d) + jnp.sum(v).astype(jnp.int64))
+    deltas = np.arange(batch_size, dtype=np.uint32)
+    vals = np.random.default_rng(0).random(batch_size).astype(np.float32)
+    int(consume(jax.device_put(deltas), jax.device_put(vals)))  # warm
+    t0 = time.perf_counter()
+    acc = []
+    for _ in range(n_batches):
+        acc.append(consume(jax.device_put(deltas), jax.device_put(vals)))
+    jax.device_get(acc)
+    dt = time.perf_counter() - t0
+    return n_batches * batch_size * 8 / dt / 1e6

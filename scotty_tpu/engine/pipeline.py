@@ -447,13 +447,10 @@ class AlignedStreamPipeline:
                     "aligned pipeline: Time tumbling/sliding only; use "
                     "StreamPipeline")
             max_fixed = max(max_fixed, w.clear_delay())
-        max_width = 1
         for a in self.aggregations:
-            spec = a.device_spec()
-            if spec is None:
+            if a.device_spec() is None:
                 raise NotImplementedError(
                     "aligned pipeline: device-realizable aggregations only")
-            max_width = max(max_width, spec.width)
         g = self.slice_grid(self.windows, wm_period_ms)
         if throughput * g % 1000:
             raise ValueError(
@@ -482,6 +479,22 @@ class AlignedStreamPipeline:
         self.n_late = int(S * R * self.out_of_order_pct)
         self.tuples_per_interval = S * R + self.n_late
 
+        # Sparse-lift strategy per aggregation: the one-hot densify + row
+        # reduce is faster than a [B]-lane scatter when the [R, width]
+        # lift fits the chunk budget (it lowers to tiled reduces — measured
+        # 84 vs 53 M t/s on the 60 k-window quantile cell); past that the
+        # flat [d*width] scatter keeps per-lane cost only (the session
+        # pipeline's regime, R in the millions).
+        onehot_ok = {}
+        max_width = 1
+        for a in self.aggregations:
+            sp = a.device_spec()
+            if sp.is_sparse:
+                onehot_ok[sp.token] = R * sp.width <= max_chunk_elems
+                if onehot_ok[sp.token]:
+                    max_width = max(max_width, sp.width)
+            else:
+                max_width = max(max_width, sp.width)
         # rows per generation chunk: largest divisor of S within the budget
         # (the budget counts lifted elements, so wide sketch partials shrink
         # the chunk rather than exploding the [d*R, width] lift temporary)
@@ -580,20 +593,33 @@ class AlignedStreamPipeline:
                 flat = vals.reshape(-1)
                 parts = []
                 for aspec in spec.aggs:
-                    if aspec.is_sparse:
-                        # sketches: each tuple touches one of `width` columns
-                        # — densify via a one-hot compare (combine identity
-                        # elsewhere); the row reduction then folds the whole
-                        # chunk's histogram/registers at once.
+                    if aspec.is_sparse and onehot_ok[aspec.token]:
+                        # one-hot densify + row reduce (see strategy note
+                        # in __init__)
                         col, v = aspec.lift_sparse(flat)
                         lifted = jnp.where(
                             col[:, None] == jnp.arange(aspec.width)[None, :],
                             v[:, None], jnp.asarray(aspec.identity,
                                                     v.dtype))
+                        lifted = lifted.reshape(d, R, -1)
+                        parts.append(red[aspec.kind](lifted, axis=1))
+                    elif aspec.is_sparse:
+                        # flat [d*width] f32 scatter — per-lane cost only
+                        col, v = aspec.lift_sparse(flat)
+                        row_id = jnp.arange(d * R, dtype=jnp.int32) // R
+                        fi = row_id * aspec.width + col.astype(jnp.int32)
+                        tgt = jnp.full((d * aspec.width,), aspec.identity,
+                                       jnp.float32)
+                        if aspec.kind == "sum":
+                            tgt = tgt.at[fi].add(v)
+                        elif aspec.kind == "min":
+                            tgt = tgt.at[fi].min(v)
+                        else:
+                            tgt = tgt.at[fi].max(v)
+                        parts.append(tgt.reshape(d, aspec.width))
                     else:
-                        lifted = aspec.lift_dense(flat)
-                    lifted = lifted.reshape(d, R, -1)
-                    parts.append(red[aspec.kind](lifted, axis=1))   # [d, w]
+                        lifted = aspec.lift_dense(flat).reshape(d, R, -1)
+                        parts.append(red[aspec.kind](lifted, axis=1))
                 return None, (tuple(parts), jnp.min(offs, axis=1),
                               jnp.max(offs, axis=1))
 

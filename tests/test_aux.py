@@ -323,3 +323,30 @@ def test_engine_checkpoint_preserves_host_clocks(tmp_path):
     got = [(w.get_start(), w.get_end(), float(w.get_agg_values()[0]))
            for w in op2.process_watermark(40) if w.has_value()]
     assert got == expect
+
+
+def test_sketch_lower_device_matches_host():
+    """Device-side finalization (DeviceAggregateSpec.lower_device) must
+    agree with the host lower for both wide sketches — it is what the
+    benchmark latency probes fetch instead of raw [T, width] partials."""
+    import jax
+    import numpy as np
+
+    from scotty_tpu.core.aggregates import (DDSketchQuantileAggregation,
+                                            HyperLogLogAggregation)
+
+    rng = np.random.default_rng(5)
+    for agg in (DDSketchQuantileAggregation(0.5), HyperLogLogAggregation(8)):
+        spec = agg.device_spec()
+        W = spec.width
+        if spec.kind == "sum":          # ddsketch: bucket counts
+            partials = rng.integers(0, 50, size=(16, W)).astype(np.float32)
+        else:                           # hll: register maxima
+            partials = rng.integers(0, 20, size=(16, W)).astype(np.float32)
+        counts = partials.sum(axis=-1).astype(np.int64)
+        want = np.asarray(spec.lower(partials, counts), np.float64)
+        got = np.asarray(jax.device_get(
+            jax.jit(spec.lower_device)(partials, counts)), np.float64)
+        ok = np.isclose(want, got, rtol=1e-3) | (np.isnan(want)
+                                                 & np.isnan(got))
+        assert ok.all(), (spec.token, want, got)
