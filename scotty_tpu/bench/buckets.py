@@ -30,12 +30,13 @@ from ..core.aggregates import AggregateFunction
 from ..core.windows import SlidingWindow, TumblingWindow, WindowMeasure
 from ..engine.pipeline import (
     AlignedStreamPipeline,
+    FusedPipelineDriver,
     build_trigger_grid,
     lower_interval,
 )
 
 
-class BucketWindowPipeline:
+class BucketWindowPipeline(FusedPipelineDriver):
     """Fused per-watermark-interval bucket engine (no aggregate sharing)."""
 
     def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
@@ -178,50 +179,28 @@ class BucketWindowPipeline:
         self._ring = None
         self._interval = 0
 
-    def reset(self) -> None:
-        import jax
+    def _init_pipeline_state(self) -> None:
         import jax.numpy as jnp
 
         self._ring = (jnp.full((self._Npad,), np.int64(1) << 62, jnp.int64),
                       jnp.zeros((self._Npad,), jnp.float32))
-        self._root = jax.random.PRNGKey(self.seed)
-        self._interval = 0
 
-    def run(self, n_intervals: int, collect: bool = True):
-        import jax
-
-        if self._ring is None:
-            self.reset()
-        out = []
-        rt, rv = self._ring
-        for _ in range(n_intervals):
-            i = self._interval
-            rt, rv, res = self._step(rt, rv,
-                                     jax.random.fold_in(self._root, i),
-                                     np.int64(i))
-            self._interval += 1
-            if collect:
-                out.append(res)
+    def _step_interval(self, key, i: int):
+        rt, rv, res = self._step(*self._ring, key, np.int64(i))
         self._ring = (rt, rv)
-        return out
+        return res
+
+    def _sync_anchor(self):
+        return self._ring[0][0]
 
     def prefill(self, n_intervals: int) -> None:
-        import jax
-
-        if self._ring is None:
+        if self._needs_reset():
             self.reset()
-        rt, rv = self._ring
         for _ in range(n_intervals):
             i = self._interval
-            rt, rv = self._fill(rt, rv, jax.random.fold_in(self._root, i),
-                                np.int64(i))
+            self._ring = self._fill(*self._ring, self._interval_key(i),
+                                    np.int64(i))
             self._interval += 1
-        self._ring = (rt, rv)
-
-    def sync(self) -> None:
-        import jax
-
-        jax.device_get(self._ring[0][0])
 
     def check_overflow(self) -> None:
         pass                       # ring overwrites exactly after the span

@@ -190,7 +190,75 @@ def lower_interval(aggregations: Sequence[AggregateFunction], interval_out):
     return rows
 
 
-class StreamPipeline:
+class FusedPipelineDriver:
+    """Shared host driver for the fused per-interval pipelines
+    (:class:`AlignedStreamPipeline`, :class:`StreamPipeline`,
+    :class:`.session_pipeline.SessionStreamPipeline`,
+    :class:`..parallel.keyed.KeyedAlignedPipeline`): stateful interval
+    numbering, per-interval PRNG keying, GC cadence, and the
+    device_get-based sync (``block_until_ready`` is not a reliable
+    barrier on tunneled devices — docs/DESIGN.md). Subclasses set
+    ``wm_period_ms``, ``max_lateness``, ``max_fixed``, ``gc_every``,
+    ``seed``, implement ``_init_pipeline_state()``,
+    ``_step_interval(key, i) -> result`` and ``_sync_anchor()``, and
+    optionally ``_gc(bound)`` for out-of-step GC.
+    """
+
+    def reset(self) -> None:
+        import jax
+
+        self._root = jax.random.PRNGKey(self.seed)
+        self._interval = 0
+        self._init_pipeline_state()
+        self._pipeline_ready = True
+
+    def _interval_key(self, i: int):
+        import jax
+
+        return jax.random.fold_in(self._root, i)
+
+    def _needs_reset(self) -> bool:
+        # NOT keyed on _root: the materialize_* helpers lazily seed _root
+        # on a fresh pipeline, which must not make run() skip state init
+        return not getattr(self, "_pipeline_ready", False)
+
+    def _step_interval(self, key, i: int):
+        self.state, res = self._step(self.state, key, np.int64(i))
+        return res
+
+    def _sync_anchor(self):
+        return self.state.n_slices
+
+    def run(self, n_intervals: int, collect: bool = True):
+        """Advance n watermark intervals (continuing from the last call —
+        interval numbering is stateful, so warmup + timed + latency phases
+        see one continuous stream); returns the per-interval result
+        handles. Dispatch only — no sync."""
+        if self._needs_reset():
+            self.reset()
+        out = []
+        for _ in range(n_intervals):
+            i = self._interval
+            res = self._step_interval(self._interval_key(i), i)
+            self._interval += 1
+            if collect:
+                out.append(res)
+            if self._gc is not None and self._interval % self.gc_every == 0:
+                self._gc(np.int64(self._interval * self.wm_period_ms
+                                  - self.max_lateness - self.max_fixed))
+        return out
+
+    _gc = None                      # subclasses assign when GC is a
+                                    # separate kernel outside the step
+
+    def sync(self) -> int:
+        """Drain all queued device work; returns the anchor scalar."""
+        import jax
+
+        return int(jax.device_get(self._sync_anchor()))
+
+
+class StreamPipeline(FusedPipelineDriver):
     """One fused XLA step per watermark interval.
 
     ``windows``: context-free Time-measure windows (static).
@@ -322,38 +390,8 @@ class StreamPipeline:
         self.state = None
         self._interval = 0
 
-    def reset(self) -> None:
-        import jax
-
+    def _init_pipeline_state(self) -> None:
         self.state = self._init_state()
-        self._root = jax.random.PRNGKey(self.seed)
-        self._interval = 0
-
-    def run(self, n_intervals: int, collect: bool = True):
-        """Advance n watermark intervals (continuing from the last call —
-        interval numbering is stateful, so warmup + timed + latency phases
-        see one continuous stream); returns the per-interval
-        (ws, we, cnt, results) device handles."""
-        import jax
-
-        if self.state is None:
-            self.reset()
-        out = []
-        for _ in range(n_intervals):
-            i = self._interval
-            self.state, res = self._step(self.state,
-                                         jax.random.fold_in(self._root, i),
-                                         np.int64(i))
-            self._interval += 1
-            if collect:
-                out.append(res)
-        return out
-
-    def sync(self) -> int:
-        """Drain all queued device work; returns n_slices."""
-        import jax
-
-        return int(jax.device_get(self.state.n_slices))
 
     def check_overflow(self) -> None:
         import jax
@@ -376,7 +414,7 @@ def _gcd_all(xs):
     return g
 
 
-class AlignedStreamPipeline:
+class AlignedStreamPipeline(FusedPipelineDriver):
     """Slice-aligned fused pipeline — the flagship benchmark execution mode.
 
     TPU-first observation: scatters (especially int64 scatters) are the worst
@@ -672,43 +710,11 @@ class AlignedStreamPipeline:
         self.state = None
         self._interval = 0
 
-    def reset(self) -> None:
-        import jax
-
+    def _init_pipeline_state(self) -> None:
         self.state = self._init_state()
-        self._root = jax.random.PRNGKey(self.seed)
-        self._interval = 0
 
-    def _interval_key(self, i: int):
-        import jax
-
-        return jax.random.fold_in(self._root, i)
-
-    def run(self, n_intervals: int, collect: bool = True):
-        """Advance n watermark intervals (dispatch only — no sync). Returns
-        the per-interval (ws, we, cnt, results) device handles."""
-        if self.state is None:
-            self.reset()
-        out = []
-        for _ in range(n_intervals):
-            i = self._interval
-            self.state, res = self._step(self.state, self._interval_key(i),
-                                         np.int64(i))
-            self._interval += 1
-            if collect:
-                out.append(res)
-            if self._interval % self.gc_every == 0:
-                bound = (self._interval * self.wm_period_ms
-                         - self.max_lateness - self.max_fixed)
-                self.state = self._gc_kernel(self.state, np.int64(bound))
-        return out
-
-    def sync(self) -> int:
-        """Drain all queued device work (device_get — block_until_ready is
-        not a reliable barrier over tunneled devices); returns n_slices."""
-        import jax
-
-        return int(jax.device_get(self.state.n_slices))
+    def _gc(self, bound) -> None:
+        self.state = self._gc_kernel(self.state, bound)
 
     def check_overflow(self) -> None:
         import jax

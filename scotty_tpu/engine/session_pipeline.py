@@ -52,10 +52,10 @@ from ..core.windows import (
     WindowMeasure,
 )
 from .config import EngineConfig
-from .pipeline import build_trigger_grid
+from .pipeline import FusedPipelineDriver, build_trigger_grid
 
 
-class SessionStreamPipeline:
+class SessionStreamPipeline(FusedPipelineDriver):
     """One fused step per watermark interval for session(-mix) workloads.
 
     ``session_config``: {"count": N, "minGapMs": a, "maxGapMs": b} — the
@@ -380,14 +380,24 @@ class SessionStreamPipeline:
         self.sess_states = None
         self._interval = 0
 
-    # -- driver-facing interface (same shape as the other pipelines) ------
-    def reset(self) -> None:
-        import jax
-
+    # -- driver-facing interface (FusedPipelineDriver hooks) ---------------
+    def _init_pipeline_state(self) -> None:
         self.state = self._init_grid()
         self.sess_states = self._init_sessions()
-        self._root = jax.random.PRNGKey(self.seed)
-        self._interval = 0
+
+    def _step_interval(self, key, i: int):
+        self.state, self.sess_states, res = self._step(
+            self.state, self.sess_states, key, np.int64(i),
+            np.bool_(self.live(i)))
+        return res
+
+    def _gc(self, bound) -> None:
+        if self.has_grid:
+            self.state = self._gc_kernel(self.state, bound)
+
+    def _sync_anchor(self):
+        return self.state.n_slices if self.has_grid \
+            else self.sess_states[0].n
 
     def live(self, i: int) -> bool:
         return not bool(self._silent[i % self._horizon])
@@ -395,35 +405,6 @@ class SessionStreamPipeline:
     def tuples_in_range(self, i0: int, i1: int) -> int:
         return sum(self.tuples_per_interval
                    for i in range(i0, i1) if self.live(i))
-
-    def run(self, n_intervals: int, collect: bool = True):
-        import jax
-        import numpy as np
-
-        if self.state is None and self.sess_states is None:
-            self.reset()
-        out = []
-        for _ in range(n_intervals):
-            i = self._interval
-            self.state, self.sess_states, res = self._step(
-                self.state, self.sess_states,
-                jax.random.fold_in(self._root, i), np.int64(i),
-                np.bool_(self.live(i)))
-            self._interval += 1
-            if collect:
-                out.append(res)
-            if self.has_grid and self._interval % self.gc_every == 0:
-                bound = (self._interval * self.wm_period_ms
-                         - self.max_lateness - self.max_fixed)
-                self.state = self._gc_kernel(self.state, np.int64(bound))
-        return out
-
-    def sync(self) -> int:
-        import jax
-
-        anchor = self.state.n_slices if self.has_grid \
-            else self.sess_states[0].n
-        return int(jax.device_get(anchor))
 
     def check_overflow(self) -> None:
         import jax

@@ -27,6 +27,7 @@ from ..core.windows import (
 )
 from ..engine.config import EngineConfig
 from ..engine.operator import UnsupportedOnDevice
+from ..engine.pipeline import FusedPipelineDriver
 
 _KERNEL_CACHE: dict = {}
 
@@ -364,7 +365,7 @@ class KeyedTpuWindowOperator:
         return out
 
 
-class KeyedAlignedPipeline:
+class KeyedAlignedPipeline(FusedPipelineDriver):
     """Fused keyed benchmark pipeline: one XLA dispatch per watermark
     interval serving ``n_keys`` independent keyed operators.
 
@@ -540,36 +541,14 @@ class KeyedAlignedPipeline:
             st = jax.device_put(st, NamedSharding(self.mesh, P(self.axis)))
         return st
 
-    def reset(self) -> None:
-        import jax
-
+    def _init_pipeline_state(self) -> None:
         self.state = self._init_state()
-        self._root = jax.random.PRNGKey(self.seed)
-        self._interval = 0
 
-    def run(self, n_intervals: int, collect: bool = True):
-        import jax
+    def _gc(self, bound) -> None:
+        self.state = self._gc_kernel(self.state, bound)
 
-        if self.state is None:
-            self.reset()
-        out = []
-        for _ in range(n_intervals):
-            i = self._interval
-            self.state, res = self._step(
-                self.state, jax.random.fold_in(self._root, i), np.int64(i))
-            self._interval += 1
-            if collect:
-                out.append(res)
-            if self._interval % self.gc_every == 0:
-                bound = (self._interval * self.wm_period_ms
-                         - self.max_lateness - self.max_fixed)
-                self.state = self._gc_kernel(self.state, np.int64(bound))
-        return out
-
-    def sync(self) -> int:
-        import jax
-
-        return int(jax.device_get(self.state.n_slices[0]))
+    def _sync_anchor(self):
+        return self.state.n_slices[0]        # [K]-batched: one key's scalar
 
     def check_overflow(self) -> None:
         import jax
