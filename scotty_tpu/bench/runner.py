@@ -51,6 +51,14 @@ def _round_throughput(throughput: int, grid: int) -> int:
     return per * 1000 // grid
 
 
+#: drained emit-latency sampling discipline, shared by every cell type:
+#: up to LATENCY_SAMPLES_MAX samples within LATENCY_BUDGET_S seconds,
+#: never fewer than LATENCY_SAMPLES_MIN
+LATENCY_SAMPLES_MAX = 100
+LATENCY_BUDGET_S = 45.0
+LATENCY_SAMPLES_MIN = 5
+
+
 def measure_rtt_floor(n: int = 12) -> float:
     """Drained device→host round-trip floor (ms): device_get of a tiny
     freshly-computed scalar on an idle queue. Every emit-latency sample in
@@ -76,8 +84,8 @@ def measure_rtt_floor(n: int = 12) -> float:
 
 def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
                        agg_name: str, mode: str,
-                       latency_samples: int = 100,
-                       latency_budget_s: float = 45.0) -> BenchResult:
+                       latency_samples: int = LATENCY_SAMPLES_MAX,
+                       latency_budget_s: float = LATENCY_BUDGET_S) -> BenchResult:
     """bench.py's measurement discipline for any fused pipeline object:
     pre-roll past the widest window span, time a steady-state region, then
     sample emit latency with a drained queue (up to ``latency_samples``
@@ -160,7 +168,7 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         out = pipeline.run(1)[0]
         jax.device_get(emit_payload(out[2], out[3]))
         lats.append((time.perf_counter() - t1) * 1e3)
-        if (len(lats) >= 5
+        if (len(lats) >= LATENCY_SAMPLES_MIN
                 and time.perf_counter() - t_lat > latency_budget_s):
             break
     pipeline.check_overflow()
@@ -374,7 +382,7 @@ def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     span0 = hi0 - lo0
     cursor = next_wm
     t_lat = time.perf_counter()
-    for _ in range(100):
+    for _ in range(LATENCY_SAMPLES_MAX):
         jax.device_get(op._state.n_slices)
         t1 = time.perf_counter()
         feed.feed_packed(np.int64(cursor), deltas0, vals0,
@@ -386,7 +394,8 @@ def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
             jax.device_get(op._state.n_slices)
         lats.append((time.perf_counter() - t1) * 1e3)
         cursor += span0 + cfg.watermark_period_ms
-        if len(lats) >= 5 and time.perf_counter() - t_lat > 45.0:
+        if (len(lats) >= LATENCY_SAMPLES_MIN
+                and time.perf_counter() - t_lat > LATENCY_BUDGET_S):
             break
 
     # raw link measured twice (the tunnel varies ±30% run to run) — the

@@ -194,7 +194,8 @@ class FusedPipelineDriver:
     """Shared host driver for the fused per-interval pipelines
     (:class:`AlignedStreamPipeline`, :class:`StreamPipeline`,
     :class:`.session_pipeline.SessionStreamPipeline`,
-    :class:`..parallel.keyed.KeyedAlignedPipeline`): stateful interval
+    :class:`..parallel.keyed.KeyedAlignedPipeline`,
+    :class:`..bench.buckets.BucketWindowPipeline`): stateful interval
     numbering, per-interval PRNG keying, GC cadence, and the
     device_get-based sync (``block_until_ready`` is not a reliable
     barrier on tunneled devices — docs/DESIGN.md). Subclasses set

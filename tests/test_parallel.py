@@ -226,6 +226,40 @@ def test_keyed_bench_cell_smoke():
     assert r.tuples_per_sec > 0
 
 
+def test_keyed_aligned_pipeline_on_mesh():
+    """The fused keyed pipeline sharded over an 8-device mesh produces the
+    same per-key results as unsharded (the program is per-key pointwise —
+    SURVEY.md §2.8 (b); XLA partitions it collective-free)."""
+    from scotty_tpu.parallel.keyed import KeyedAlignedPipeline
+
+    K = 8
+    windows = [TumblingWindow(Time, 100)]
+
+    def make(mesh):
+        p = KeyedAlignedPipeline(
+            windows, [SumAggregation()], n_keys=K, config=CFG,
+            throughput=K * 1000, wm_period_ms=100, max_lateness=100,
+            seed=21, gc_every=4, mesh=mesh)
+        p.reset()
+        return p
+
+    p_mesh = make(make_mesh("keys"))
+    p_solo = make(None)
+    for i in range(6):
+        a = p_mesh.run(1)[0]
+        b = p_solo.run(1)[0]
+        for kk in (0, 3, K - 1):
+            ra = p_mesh.lowered_results_for_key(a, kk)
+            rb = p_solo.lowered_results_for_key(b, kk)
+            assert [(s, e, c) for s, e, c, _ in ra] == \
+                   [(s, e, c) for s, e, c, _ in rb], (i, kk)
+            for (_, _, _, va), (_, _, _, vb) in zip(ra, rb):
+                for x, y in zip(va, vb):
+                    assert float(x) == float(y), (i, kk)
+    p_mesh.check_overflow()
+    p_solo.check_overflow()
+
+
 def test_keyed_aligned_pipeline_matches_simulator():
     """The fused keyed pipeline (one dispatch per interval, [K, S, R]
     slice-grouped generation) must emit, for a sampled key, the same
