@@ -32,24 +32,29 @@ import numpy as np
 
 def _time_phase(fn: Callable[[], None], sync: Callable[[], None],
                 iters: int, warmup: int = 2) -> dict:
+    """Amortized per-dispatch timing: ``iters`` back-to-back dispatches,
+    ONE true sync (``sync`` must be a ``jax.device_get`` of a value the
+    work produced — ``block_until_ready`` is not a reliable barrier on
+    tunneled devices, docs/DESIGN.md). The final sync's round trip is
+    measured on an idle queue and subtracted; the per-dispatch mean
+    still includes per-dispatch overhead."""
     for _ in range(warmup):
         fn()
     sync()
-    samples = []
+    t0 = time.perf_counter()
+    sync()                              # idle-queue sync = pure round trip
+    sync_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
     for _ in range(iters):
-        t0 = time.perf_counter()
         fn()
-        sync()
-        samples.append((time.perf_counter() - t0) * 1e3)
-    return {
-        "mean_ms": float(np.mean(samples)),
-        "min_ms": float(np.min(samples)),
-        "p95_ms": float(np.percentile(samples, 95)),
-        "iters": iters,
-    }
+    sync()
+    total_ms = (time.perf_counter() - t0) * 1e3
+    mean = max(total_ms - sync_ms, 0.0) / iters
+    return {"mean_ms": float(mean), "sync_ms": float(sync_ms),
+            "iters": iters}
 
 
-def run_micro(small: bool = False, iters: int = 5, seed: int = 0) -> dict:
+def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -90,10 +95,10 @@ def run_micro(small: bool = False, iters: int = 5, seed: int = 0) -> dict:
         holder["st"] = ingest(holder["st"], ts0 + off, vals, valid)
 
     def sync():
-        jax.block_until_ready(holder["st"].n_slices)
+        jax.device_get(holder["st"].n_slices)
 
     r = _time_phase(do_ingest, sync, iters)
-    r["tuples_per_s"] = B / (r["min_ms"] / 1e3)
+    r["tuples_per_s"] = B / (r["mean_ms"] / 1e3)
     results["ingest_scatter"] = r
 
     # ---- gc (amortizes the buffer back down) ------------------------------
@@ -119,10 +124,10 @@ def run_micro(small: bool = False, iters: int = 5, seed: int = 0) -> dict:
         out_holder["out"] = query(holder["st"], ws, we, mask, ic)
 
     def sync_q():
-        jax.block_until_ready(out_holder["out"])
+        jax.device_get(out_holder["out"][0][0])
 
     r = _time_phase(do_query, sync_q, iters)
-    r["windows_per_s"] = Tq / (r["min_ms"] / 1e3)
+    r["windows_per_s"] = Tq / (r["mean_ms"] / 1e3)
     results["query"] = r
 
     # ---- annex merge ------------------------------------------------------
@@ -146,7 +151,7 @@ def run_micro(small: bool = False, iters: int = 5, seed: int = 0) -> dict:
         p.run(1, collect=False)
 
     r = _time_phase(do_aligned, lambda: p.sync(), iters)
-    r["tuples_per_s"] = p.tuples_per_interval / (r["min_ms"] / 1e3)
+    r["tuples_per_s"] = p.tuples_per_interval / (r["mean_ms"] / 1e3)
     results["ingest_aligned"] = r
     p.check_overflow()
 
@@ -172,8 +177,37 @@ def run_micro(small: bool = False, iters: int = 5, seed: int = 0) -> dict:
         return ts_b
 
     r = _time_phase(do_pack, lambda: None, iters)
-    r["tuples_per_s"] = Np / (r["min_ms"] / 1e3)
+    r["tuples_per_s"] = Np / (r["mean_ms"] / 1e3)
     results["host_pack"] = r
+
+    # ---- raw scatter costs (the numbers behind docs/DESIGN.md's "no
+    # int64 scatter on the hot path" decisions) ----------------------------
+    Bs = B
+    pos = jnp.asarray(rng.integers(0, C, size=Bs).astype(np.int32))
+    fv = jnp.asarray(rng.random(Bs).astype(np.float32))
+    iv = jnp.asarray(rng.integers(0, 1 << 40, size=Bs).astype(np.int64))
+    sc_holder = {
+        "f32": jnp.zeros((C,), jnp.float32),
+        "i64": jnp.full((C,), np.int64(1) << 60),
+    }
+    scatter_f32 = jax.jit(lambda a: a.at[pos].add(fv), donate_argnums=0)
+    scatter_i64 = jax.jit(lambda a: a.at[pos].min(iv), donate_argnums=0)
+
+    def do_sf():
+        sc_holder["f32"] = scatter_f32(sc_holder["f32"])
+
+    r = _time_phase(do_sf, lambda: jax.device_get(sc_holder["f32"][0]),
+                    iters)
+    r["lanes"] = Bs
+    results["scatter_f32_add"] = r
+
+    def do_si():
+        sc_holder["i64"] = scatter_i64(sc_holder["i64"])
+
+    r = _time_phase(do_si, lambda: jax.device_get(sc_holder["i64"][0]),
+                    iters)
+    r["lanes"] = Bs
+    results["scatter_i64_min"] = r
 
     results["platform"] = jax.devices()[0].platform
     return results
@@ -187,7 +221,7 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--out", default="bench_results/micro.json")
     ap.add_argument("--small", action="store_true",
                     help="CPU-test shapes instead of benchmark shapes")
-    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args(argv)
 
     res = run_micro(small=args.small, iters=args.iters)
@@ -199,8 +233,8 @@ def main(argv: Optional[list] = None) -> int:
             extra = f"  {r['tuples_per_s']:16,.0f} tuples/s"
         elif "windows_per_s" in r:
             extra = f"  {r['windows_per_s']:16,.0f} windows/s"
-        print(f"{phase:16s} mean={r['mean_ms']:9.3f} ms  "
-              f"min={r['min_ms']:9.3f} ms{extra}")
+        print(f"{phase:16s} mean={r['mean_ms']:9.3f} ms/dispatch"
+              f"{extra}")
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(res, f, indent=1)

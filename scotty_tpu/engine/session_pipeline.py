@@ -2,8 +2,9 @@
 for session windows (optionally mixed with time-grid windows).
 
 TPU-first observation driving the design: per-lane scatter work is the only
-ingest cost class that scales with the tuple count (f32 scatters ~12 ms per
-1M lanes on v5e, int64 ~135 ms — measured, docs/DESIGN.md), and per-dispatch
+ingest cost class that scales with the tuple count (f32 scatters ~6-12 ms
+per 1M lanes on v5e, int64 ~15-20× worse — measured, docs/DESIGN.md and
+bench_results/micro.json), and per-dispatch
 overhead on tunneled devices is ~5-15 ms. A session benchmark stream is a
 constant-rate generator with occasional SILENT SPANS (the reference's
 session-gap mechanism, LoadGeneratorSource.java:60-76): at benchmark rates
