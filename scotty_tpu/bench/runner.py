@@ -25,7 +25,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from dataclasses import dataclass
+
 from typing import List, Optional
 
 import numpy as np
@@ -37,12 +37,6 @@ from .harness import (
     parse_window_spec,
     run_benchmark,
 )
-
-
-@dataclass
-class _PipelineCell:
-    result: BenchResult
-    mode: str                        # 'aligned' | 'buckets'
 
 
 def _round_throughput(throughput: int, grid: int) -> int:
