@@ -56,9 +56,20 @@ class HostFeed:
 
     @staticmethod
     def pack(vals: np.ndarray, ts: np.ndarray):
-        """Host-side packing: (base, deltas u32, vals f32)."""
+        """Host-side packing: (base, deltas u32, vals f32).
+
+        Raises ValueError when the in-order / <2^32-ms-span contract is
+        violated — a silent u32 wrap would corrupt timestamps (ADVICE r3).
+        """
         base = np.int64(ts[0])
-        deltas = (ts - base).astype(np.uint32)
+        wide = np.asarray(ts, dtype=np.int64) - base
+        if int(wide.max()) >= 1 << 32 or (wide.size > 1
+                                          and (np.diff(wide) < 0).any()):
+            raise ValueError(
+                "HostFeed.pack: unsorted ts or span >= 2**32 ms — the "
+                "in-order contract is violated and a u32 delta would wrap "
+                "or feed a stale ts_max downstream (ADVICE r3)")
+        deltas = wide.astype(np.uint32)
         return base, deltas, np.ascontiguousarray(vals, dtype=np.float32)
 
     def feed_packed(self, base: np.int64, deltas: np.ndarray,

@@ -592,8 +592,11 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             row = (state.n_slices.astype(jnp.int64) - 1
                    - (base - g - gs) // g)
             # out-of-range sentinel + identity-masked values + mode="drop":
-            # masked lanes can neither combine nor clamp onto a live row
-            pos = jnp.where(ok, row, C).astype(jnp.int32)
+            # masked lanes can neither combine nor clamp onto a live row.
+            # Negative rows (outside the GC invariant) must hit the sentinel
+            # too — JAX normalizes negative indices onto live slices.
+            lane_ok = ok & (row >= 0)
+            pos = jnp.where(lane_ok, row, C).astype(jnp.int32)
             d32 = jnp.zeros((C,), jnp.int32).at[pos].add(
                 jnp.int32(1), mode="drop")
             partials = []

@@ -151,10 +151,13 @@ class SessionStreamPipeline(FusedPipelineDriver):
         horizon = max(16, int(lens_iv.sum() / 0.4) + 1)
         silent = np.zeros(horizon, bool)
         for ln in lens_iv:
-            pos = int(rng.integers(1, horizon))
+            # keep each span's configured length: draw a start that fits
+            # before the horizon end instead of truncating there (ADVICE r3);
+            # interval 0 stays non-silent so the first interval carries tuples
+            hi = max(2, horizon - int(ln) + 1)
+            pos = int(rng.integers(1, hi))
             silent[pos:pos + int(ln)] = True
-        if silent.all():
-            silent[0] = False
+        silent[0] = False
         self._silent = silent
         self._horizon = horizon
         #: timed regions shorter than this may see zero completed sessions
