@@ -155,6 +155,33 @@ def grid_start(spec: EngineSpec, ts: jnp.ndarray) -> jnp.ndarray:
     return functools.reduce(jnp.maximum, cands)
 
 
+def host_grid_start(spec: EngineSpec, ts: np.ndarray) -> np.ndarray:
+    """Numpy mirror of :func:`grid_start` for host-side cut calculus
+    (the out-of-order count+time mixed path precomputes per-lane slice
+    assignments in arrival order — see operator._mixed_cut_calculus)."""
+    ts = np.asarray(ts, dtype=np.int64)
+    best = np.zeros_like(ts)
+    for p in spec.periods:
+        np.maximum(best, ts - ts % np.int64(p), out=best)
+    for (p, r) in spec.offset_periods:
+        np.maximum(best, np.maximum(ts - (ts - r) % np.int64(p), 0),
+                   out=best)
+    for (bs, bsz) in spec.bands:
+        c = np.where(ts >= bs + bsz, np.int64(bs + bsz),
+                     np.where(ts >= bs, np.int64(bs), np.int64(0)))
+        np.maximum(best, c, out=best)
+    return best
+
+
+def host_count_grid(spec: EngineSpec, c: np.ndarray) -> np.ndarray:
+    """Numpy mirror of the ingest kernel's count-grid function ``cgs``."""
+    c2 = np.maximum(np.asarray(c, dtype=np.int64), 0)
+    best = np.zeros_like(c2)
+    for p in spec.count_periods:
+        np.maximum(best, c2 - c2 % np.int64(p), out=best)
+    return best
+
+
 def next_edge(spec: EngineSpec, s: jnp.ndarray) -> jnp.ndarray:
     """Earliest union-grid point strictly > s — the closing edge of a slice
     opened at s (SliceManager.appendSlice end bookkeeping)."""
@@ -630,6 +657,71 @@ def build_ingest_dense(spec: EngineSpec, capacity: int, runs: int):
     return ingest
 
 
+def build_ingest_rows(spec: EngineSpec, capacity: int):
+    """Arrival-order ingest with host-precomputed slice assignment — the
+    out-of-order count+time MIXED path.
+
+    The reference handles a late tuple under a count measure by inserting
+    it into its ts-covering slice and rippling the ts-max record of every
+    later slice forward (SliceManager.java:64-86). The ripple is an
+    insertion-sort step: after it, slice k holds exactly the ts-sorted
+    ranks ``[c_start_k, c_start_k + counts_k)`` — for count+time mixes
+    too, because ripples move ts-max records forward only, preserving the
+    global content ordering, while the grid ``tStart`` edges stay put.
+    The net slice-metadata effect of ANY tuple (late or in-order) is
+    therefore: +1 record to the slice that is OPEN at its arrival, plus
+    whatever new slices its arrival cuts (count edges for every tuple,
+    StreamSlicer.java:37-44; time edges for in-order tuples only,
+    StreamSlicer.java:47-82). The host computes those cuts in arrival
+    order (operator._mixed_cut_calculus — it knows the running max event
+    time, the open-slice start, and the running count); this kernel just
+    scatters them. Aggregate VALUES are answered from the record buffer's
+    rank ranges from then on (``build_query(..., mix_rec=True)``), so the
+    partial-aggregate matrices are deliberately left stale.
+
+    Inputs (arrival order, NOT ts-sorted): per-lane assigned row offset
+    ``row_off`` (inclusive cut count — lane's row = n_slices-1+row_off),
+    ``is_cut``, cut ``start`` values and the cutting lane's pre-insert
+    global count ``cut_c``.
+    """
+    C = capacity
+
+    def ingest(state: SliceBufferState, ts: jnp.ndarray,
+               valid: jnp.ndarray, row_off: jnp.ndarray,
+               is_cut: jnp.ndarray, cut_start: jnp.ndarray,
+               cut_c: jnp.ndarray) -> SliceBufferState:
+        # values are NOT taken: they live in the record buffer and every
+        # answer on this path is a rank-range query — no point paying the
+        # H2D transfer of a [B] float array that would only be discarded
+        n = state.n_slices
+        row = (n - 1).astype(jnp.int32) + row_off
+        pos = jnp.clip(row, 0, C - 1)
+        pos = jnp.where(valid, pos, C).astype(jnp.int32)  # sentinel + drop
+        cut = valid & is_cut
+        one = jnp.where(valid, jnp.int64(1), jnp.int64(0))
+        counts = state.counts.at[pos].add(one, mode="drop")
+        starts = state.starts.at[pos].min(
+            jnp.where(cut, cut_start, I64_MAX), mode="drop")
+        ends = state.ends.at[pos].min(
+            jnp.where(cut, next_edge(spec, cut_start), I64_MAX),
+            mode="drop")
+        c_start = state.c_start.at[pos].min(
+            jnp.where(cut, cut_c, I64_MAX), mode="drop")
+        k_last = jnp.max(jnp.where(valid, row_off, 0))
+        return state._replace(
+            starts=starts, ends=ends, counts=counts, c_start=c_start,
+            n_slices=(n + k_last).astype(jnp.int32),
+            max_event_time=jnp.maximum(
+                state.max_event_time,
+                jnp.max(jnp.where(valid, ts, I64_MIN))),
+            current_count=state.current_count
+            + jnp.sum(valid.astype(jnp.int64)),
+            overflow=state.overflow | (((n - 1) + k_last) >= C),
+        )
+
+    return ingest
+
+
 # ---------------------------------------------------------------------------
 # Query kernel (watermark final-merge)
 # ---------------------------------------------------------------------------
@@ -663,7 +755,7 @@ def _range_combine(tbl: jnp.ndarray, lo: jnp.ndarray, length: jnp.ndarray,
 
 
 def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
-                record_capacity: int = 0):
+                record_capacity: int = 0, mix_rec: bool = False):
     """All triggered windows answered at once.
 
     Replaces LazyAggregateStore.aggregate's O(#slices × #windows) nested
@@ -677,6 +769,21 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
     closed form of the reference's out-of-order ripple (see
     :class:`RecordBuffer`); slice counts still provide containment and
     emptiness.
+
+    With ``mix_rec`` (count+time mixed workloads after a late tuple), TIME
+    windows also answer from record rank ranges: the ripple re-aligns slice
+    CONTENT to ts-sorted rank ranges (so the partial matrices are stale),
+    and each slice's post-ripple ``tLast`` — what the reference's
+    containment reads, AggregateWindowState.java:25-31 — is the ts of its
+    last rank, ``rts[c_start + counts - 1 - base]``. The mix query also
+    takes the trigger batch's scan bounds ``(min_ts, max_ts, min_count,
+    max_count)``: the reference's final-merge loop only walks slices in
+    ``[findSliceIndexByTimestamp(minTs) ∧ findSliceByCount(minCount),
+    findSliceIndexByTimestamp(maxTs) ∨ findSliceByCount(maxCount)]``
+    (LazyAggregateStore.java:83-92, WindowManager.java:98-118), and find*
+    returns the LAST slice at a duplicated edge — so a non-empty slice
+    whose start duplicates ``min_ts`` (count cut + time cut at one point)
+    is SHADOWED out of every window of that batch. Reproduced exactly.
     """
     C, A = capacity, annex_capacity
     # levels must include log2(N) itself: a range spanning the WHOLE table
@@ -685,9 +792,11 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
     RC = record_capacity
     use_rec = RC > 0 and bool(spec.count_periods)
     Lr = max(1, RC.bit_length()) if use_rec else 0
+    assert not (mix_rec and not use_rec), "mix_rec needs the record buffer"
 
     def answer(state: SliceBufferState, rec, ws: jnp.ndarray,
-               we: jnp.ndarray, tmask: jnp.ndarray, is_count: jnp.ndarray):
+               we: jnp.ndarray, tmask: jnp.ndarray, is_count: jnp.ndarray,
+               scan=None):
         lo_t = jnp.searchsorted(state.starts, ws, side="left")
         # Upper containment bound per the reference: a slice is covered iff
         # window.end > slice.tLast (AggregateWindowState.java:25-31).
@@ -699,8 +808,17 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
         # t_last is nondecreasing over live rows (t_last[i] < starts[i+1]
         # <= t_last[i+1]); pad rows are masked to LONG_MAX to keep the
         # array sorted for searchsorted.
-        live_t_last = jnp.where(jnp.arange(C) < state.n_slices,
-                                state.t_last, I64_MAX)
+        live = jnp.arange(C) < state.n_slices
+        if mix_rec:
+            # post-ripple tLast, derived from the record buffer (stored
+            # t_last is pre-ripple). Live rows always hold >= 1 record
+            # (every cut lane lands in its own new row), so the derived
+            # array is nondecreasing like rts itself.
+            last_rank = jnp.clip(state.c_start + state.counts - 1 - rec.base,
+                                 0, RC - 1)
+            live_t_last = jnp.where(live, rec.rts[last_rank], I64_MAX)
+        else:
+            live_t_last = jnp.where(live, state.t_last, I64_MAX)
         hi_t = jnp.searchsorted(live_t_last, we, side="left")
         # Count containment (AggregateWindowState.java:25-31 Count branch):
         # window [ws, we] covers slices with c_start >= ws and
@@ -712,6 +830,27 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
         hi_c = jnp.searchsorted(cs_end, we, side="right")
         lo = jnp.where(is_count, jnp.minimum(lo_c, hi_c), lo_t)
         hi = jnp.where(is_count, hi_c, hi_t)
+        if mix_rec:
+            # the reference's batch scan bounds (see docstring): find* walk
+            # from the END, so duplicated edges resolve to the LAST slice
+            # — searchsorted(side='right') - 1
+            (min_ts, max_ts, min_count, max_count) = scan
+            n1 = jnp.maximum(state.n_slices - 1, 0)
+            si = jnp.minimum(
+                jnp.maximum(
+                    jnp.searchsorted(state.starts, min_ts, side="right") - 1,
+                    0),
+                jnp.searchsorted(state.c_start, min_count,
+                                 side="right") - 1)
+            si = jnp.maximum(si, 0)
+            ei = jnp.maximum(
+                jnp.minimum(
+                    n1,
+                    jnp.searchsorted(state.starts, max_ts, side="right") - 1),
+                jnp.searchsorted(state.c_start, max_count,
+                                 side="right") - 1)
+            lo = jnp.maximum(lo, si)
+            hi = jnp.minimum(hi, ei + 1)
         # a coarse pre-addition slice spanning the whole window gives
         # hi < lo (start < ws and t_last >= we): the window covers nothing
         hi = jnp.maximum(hi, lo)
@@ -731,13 +870,16 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
             # slice (absolute counts) → buffer row; extent = covered count
             rlo = jnp.clip(state.c_start[jnp.clip(lo, 0, C - 1)] - rec.base,
                            0, RC)
-            rlen = jnp.where(is_count, jnp.clip(cnt, 0, RC - rlo), 0)
+            rec_rows = (jnp.ones_like(is_count) if mix_rec else is_count)
+            rlen = jnp.where(rec_rows, jnp.clip(cnt, 0, RC - rlo), 0)
 
         results = []
         for agg, part in zip(spec.aggs, state.partials):
             op = jnp.minimum if agg.kind == "min" else jnp.maximum
             ident = jnp.asarray(agg.identity, part.dtype)
-            if agg.kind == "sum":
+            if mix_rec:
+                res = None          # partials are stale; records only
+            elif agg.kind == "sum":
                 P = jnp.concatenate(
                     [jnp.zeros((1, part.shape[1]), part.dtype),
                      jnp.cumsum(part, axis=0)])
@@ -764,12 +906,18 @@ def build_query(spec: EngineSpec, capacity: int, annex_capacity: int,
                 else:
                     rres = _range_combine(lifted, rlo, rlen, op,
                                           agg.identity, Lr)
-                res = jnp.where(is_count[:, None], rres, res)
+                res = rres if mix_rec \
+                    else jnp.where(is_count[:, None], rres, res)
             results.append(jnp.where(tmask[:, None], res, ident))
 
         return jnp.where(tmask, cnt, 0), tuple(results)
 
-    if use_rec:
+    if mix_rec:
+        def query(state, rec, ws, we, tmask, is_count,
+                  min_ts, max_ts, min_count, max_count):
+            return answer(state, rec, ws, we, tmask, is_count,
+                          (min_ts, max_ts, min_count, max_count))
+    elif use_rec:
         def query(state, rec, ws, we, tmask, is_count):
             return answer(state, rec, ws, we, tmask, is_count)
     else:

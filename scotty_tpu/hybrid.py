@@ -37,11 +37,9 @@ class HybridWindowOperator(WindowOperator):
         self.state_factory = state_factory
         self.engine_config = engine_config
         self.force_backend = force_backend
-        #: the caller declares the stream in-order: workloads whose device
-        #: path exists only for in-order streams (pure-session, count
-        #: measure) route to the engine instead of the host — without the
-        #: declaration they stay on the host, because the engine rejects a
-        #: late tuple for those mixes only once data is already in HBM.
+        #: r1-r3 gated count+time mixes on this in-order declaration; since
+        #: r4 those mixes run on device in- and out-of-order, so the flag
+        #: no longer affects routing. Kept for caller compatibility.
         self.assume_inorder = assume_inorder
         self.windows: List[Window] = []
         self.aggregations: List[AggregateFunction] = []
@@ -52,7 +50,6 @@ class HybridWindowOperator(WindowOperator):
     def _device_realizable(self) -> bool:
         from .core.windows import SessionWindow
 
-        has_count = has_time_grid = False
         for w in self.windows:
             if isinstance(w, SessionWindow):
                 # device sessions are fully general (bounded active-session
@@ -65,17 +62,14 @@ class HybridWindowOperator(WindowOperator):
             if not isinstance(w, (TumblingWindow, SlidingWindow,
                                   FixedBandWindow)):
                 return False
-            if w.measure == WindowMeasure.Count:
-                if isinstance(w, FixedBandWindow):
-                    return False
-                has_count = True
-            else:
-                has_time_grid = True
-        if has_count and has_time_grid and not self.assume_inorder:
-            # count-only OOO runs on device (record-buffer rank ranges);
-            # count+time mixes displace records in the reference's ripple
-            # and stay host-only without an in-order declaration
-            return False
+            if w.measure == WindowMeasure.Count \
+                    and isinstance(w, FixedBandWindow):
+                return False
+        # count+time mixes run on device in- AND out-of-order since r4:
+        # the reference's ripple (SliceManager.java:64-86) is realized as
+        # record-buffer rank ranges + the arrival-order cut calculus
+        # (engine/operator._mixed_cut_calculus), so no in-order declaration
+        # is needed any more.
         for a in self.aggregations:
             if a.device_spec() is None:
                 return False

@@ -31,9 +31,18 @@ SMALL = EngineConfig(capacity=1 << 12, batch_size=64, annex_capacity=256,
 
 
 def run_both(windows, agg_factories, stream, watermarks, lateness=1000,
-             config=SMALL):
+             config=SMALL, allow_ghosts=False):
     """Drive simulator + engine with the same scripted stream; compare
-    results at every watermark."""
+    results at every watermark.
+
+    ``allow_ghosts`` (OOO count+time mixes): tolerate the reference's
+    ghost-window artifact — see PARITY.md deviation 7. A ripple transiting
+    records through an empty slice leaves invertible aggregate state at
+    the combine identity with ``hasValue`` stuck true, so the reference
+    emits spurious ``sum=0`` windows that contain no records; the engine
+    emits ``has_value=False`` for them, consistent with its own (and the
+    reference's own) in-order empty-window behavior.
+    """
     sim = SlicingWindowOperator()
     eng = TpuWindowOperator(config=config)
     for op in (sim, eng):
@@ -54,17 +63,29 @@ def run_both(windows, agg_factories, stream, watermarks, lateness=1000,
             pos += 1
         r_sim = sim.process_watermark(wm)
         r_eng = eng.process_watermark(wm)
-        compare(r_sim, r_eng, wm)
+        compare(r_sim, r_eng, wm, allow_ghosts=allow_ghosts)
     return sim, eng
 
 
-def compare(r_sim, r_eng, wm):
+def _is_ghost(sim_w, eng_w) -> bool:
+    """Reference ghost window: hasValue true but every aggregate value is
+    an identity artifact of add-then-invert (0 or None); the engine
+    reports it empty."""
+    if eng_w.has_value() or not sim_w.has_value():
+        return False
+    return all(v is None or (isinstance(v, (int, float)) and v == 0)
+               for v in sim_w.get_agg_values())
+
+
+def compare(r_sim, r_eng, wm, allow_ghosts=False):
     assert len(r_sim) == len(r_eng), (
         f"@wm={wm}: simulator emitted {len(r_sim)} windows, engine "
         f"{len(r_eng)}:\n sim={r_sim}\n eng={r_eng}")
     for i, (a, b) in enumerate(zip(r_sim, r_eng)):
         assert a.get_start() == b.get_start(), (i, wm, a, b)
         assert a.get_end() == b.get_end(), (i, wm, a, b)
+        if allow_ghosts and _is_ghost(a, b):
+            continue
         assert a.has_value() == b.has_value(), (i, wm, a, b)
         if a.has_value():
             va, vb = a.get_agg_values(), b.get_agg_values()
@@ -326,18 +347,68 @@ def test_count_out_of_order_matches_oracle():
              stream, [(1, 25), (4, 35), (6, 45)], lateness=1000)
 
 
-def test_count_out_of_order_with_time_mix_still_raises():
-    from scotty_tpu.engine import TpuWindowOperator, UnsupportedOnDevice
+def test_count_time_mix_out_of_order_matches_oracle():
+    """Round 4: OOO count+time mixes run on device (r3 raised here). The
+    reference ripple (SliceManager.java:64-86) is realized as record-buffer
+    rank ranges + the arrival-order host cut calculus; ALL window values
+    come from record rank ranges once a late tuple was seen (mix_rec
+    query, engine/core.py::build_query)."""
+    stream = [(1, 3), (2, 20), (3, 5), (4, 30), (5, 8), (6, 40), (7, 41),
+              (8, 33), (9, 55)]
+    run_both([TumblingWindow(WindowMeasure.Count, 3),
+              TumblingWindow(Time, 10)],
+             [SumAggregation, MaxAggregation], stream,
+             [(1, 25), (4, 35), (6, 45), (8, 60)], lateness=1000)
 
-    op = TpuWindowOperator(config=SMALL)
-    op.add_window_assigner(TumblingWindow(WindowMeasure.Count, 3))
-    op.add_window_assigner(TumblingWindow(Time, 10))
-    op.add_aggregation(SumAggregation())
-    op.process_elements([1, 2], [10, 20])
-    op.process_watermark(25)             # flushes; max event time now 20
-    with pytest.raises(UnsupportedOnDevice):
-        op.process_elements([3], [5])    # late across flushed batches
-        op.process_watermark(30)
+
+@pytest.mark.parametrize("seed", [3, 11, 29])
+def test_count_time_mix_ooo_differential(seed):
+    """Randomized OOO count+time mixed streams (distinct timestamps — the
+    reference's TreeSet record retention drops equal-ts records, a
+    documented quirk not worth reproducing) vs the simulator: the last
+    workload class that was host-only in r3 (VERDICT r3 item 1).
+
+    Window sizes are multiples of their slides so the engine's union grid
+    equals the reference's window-start grid: for size-not-multiple-of-
+    slide sliding windows the engine's exact offset-residue grid (the
+    documented r1 deviation, EngineSpec.offset_periods) composes with the
+    ripple's rank semantics into answers that differ from the reference's
+    straddling-slice drops — see PARITY.md."""
+    rng = np.random.default_rng(seed)
+    n = 150
+    base = np.sort(rng.choice(np.arange(1, 2500), size=n, replace=False))
+    # unconstrained bounded shuffle: with a time grid the bootstrap slices
+    # cover [0, first ts), so below-first late inserts are in contract
+    # (unlike the count-only fuzz above, where they crash the reference)
+    order = np.argsort(np.arange(n) + rng.uniform(0, 20, size=n),
+                       kind="stable")
+    ts = base[order]
+    vals = rng.integers(1, 60, size=n)
+    stream = [(int(v), int(t)) for v, t in zip(vals, ts)]
+    wms = []
+    for i, p in enumerate((n // 4, n // 2, 3 * n // 4, n - 1)):
+        met = int(np.max(ts[:p + 1]))
+        w = met - int(rng.integers(5, 40)) if i % 2 == 0 else met + 1
+        if w > 0 and (not wms or w > wms[-1][1]):
+            wms.append((p, w))
+    run_both([TumblingWindow(WindowMeasure.Count, 7),
+              TumblingWindow(Time, 40),
+              SlidingWindow(Time, 50, 25)],
+             [SumAggregation, MaxAggregation, MeanAggregation],
+             stream, wms, lateness=10_000, allow_ghosts=True)
+
+
+def test_count_time_mix_first_watermark_clamp():
+    """A mixed stream starting well above 0: the reference's first-watermark
+    clamp reads the FIRST-INSERTED slice, which with a count measure is the
+    count bootstrap cut at the first arrival's ts (WindowManager.java:51-55,
+    StreamSlicer.java:37-44) — no leading time windows below it (r4 review
+    finding)."""
+    stream = [(1, 74), (2, 136), (3, 90), (4, 150)]
+    run_both([TumblingWindow(WindowMeasure.Count, 3),
+              TumblingWindow(Time, 40)],
+             [SumAggregation], stream, [(2, 140), (3, 160)],
+             lateness=10_000, allow_ghosts=True)
 
 
 @pytest.mark.parametrize("seed", [7, 21, 35])
