@@ -39,6 +39,7 @@ from .core import (
     MinAggregation,
     QuantileAggregation,
     ReduceAggregateFunction,
+    CappedSessionWindow,
     SessionWindow,
     SlidingWindow,
     SumAggregation,
@@ -81,7 +82,7 @@ __all__ = [
     "DDSketchQuantileAggregation", "FixedBandWindow", "HyperLogLogAggregation",
     "InvertibleReduceAggregateFunction", "MaxAggregation", "MeanAggregation",
     "MinAggregation", "QuantileAggregation", "ReduceAggregateFunction",
-    "SessionWindow", "SlidingWindow", "SumAggregation", "TimeMeasure",
+    "CappedSessionWindow", "SessionWindow", "SlidingWindow", "SumAggregation", "TimeMeasure",
     "TumblingWindow", "Window", "WindowMeasure", "WindowOperator",
     "SlicingWindowOperator", "MemoryStateFactory", "StateFactory",
     "HybridWindowOperator", "TpuWindowOperator", "EngineConfig",

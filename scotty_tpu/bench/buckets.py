@@ -87,35 +87,23 @@ class BucketWindowPipeline(FusedPipelineDriver):
         Npad = n_ring_chunks * chunk
         self.hbm_bytes = Npad * 12
 
-        # byte-identical generator chunking to AlignedStreamPipeline (same
-        # per-chunk fold_in keys and shapes → same tuple stream)
-        max_width = max([1] + [a.width for a in self.aspecs])
-        d = 1
-        for cand in range(1, S + 1):
-            if S % cand == 0 and cand * R * max_width <= max_chunk_elems:
-                d = cand
-        n_chunks = S // d
-
         make_triggers, self.T = build_trigger_grid(self.windows, wm_period_ms)
         P = wm_period_ms
 
         def gen_and_write(ring_ts, ring_vals, key, interval_idx):
             """Generate one interval's tuples (byte-identical RNG stream to
-            AlignedStreamPipeline) and write them into the ring — the shared
-            body of step() and fill()."""
+            AlignedStreamPipeline: per-ROW fold_in keys, so it matches the
+            aligned pipeline at ANY chunk shape) and write them into the
+            ring — the shared body of step() and fill()."""
             base = interval_idx * P
 
-            def gbody(_, c):
-                kg = jax.random.fold_in(key, c)
-                u = jax.random.uniform(kg, (2, d, R), dtype=jnp.float32)
-                return None, (u[0] * value_scale, u[1])
-
-            _, (vals2d, offs2d) = jax.lax.scan(gbody, None,
-                                               jnp.arange(n_chunks))
-            vals = vals2d.reshape(-1)
-            row_starts = base + g * jnp.arange(S, dtype=jnp.int64)
-            off = jnp.clip(jnp.floor(offs2d.reshape(S, R) * jnp.float32(g)),
-                           0, g - 1)
+            rows = jnp.arange(S, dtype=jnp.int64)
+            keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
+            u = jax.vmap(lambda k: jax.random.uniform(
+                k, (2, R), dtype=jnp.float32))(keys)     # [S, 2, R]
+            vals = (u[:, 0] * value_scale).reshape(-1)
+            row_starts = base + g * rows
+            off = jnp.clip(jnp.floor(u[:, 1] * jnp.float32(g)), 0, g - 1)
             ts = (row_starts[:, None] + off.astype(jnp.int64)).reshape(-1)
 
             slot = (interval_idx % intervals_needed) * n_new

@@ -12,6 +12,7 @@ from .windows import (
     ForwardContextFree,
     TumblingWindow,
     SlidingWindow,
+    CappedSessionWindow,
     SessionWindow,
     FixedBandWindow,
     WindowContext,
@@ -43,7 +44,7 @@ from .time_measure import TimeMeasure
 __all__ = [
     "Window", "WindowMeasure", "TIME", "COUNT",
     "ContextFreeWindow", "ForwardContextAware", "ForwardContextFree",
-    "TumblingWindow", "SlidingWindow", "SessionWindow", "FixedBandWindow",
+    "TumblingWindow", "SlidingWindow", "CappedSessionWindow", "SessionWindow", "FixedBandWindow",
     "WindowContext", "ActiveWindow", "TupleContext",
     "AddModification", "DeleteModification", "ShiftModification",
     "AggregateFunction", "CommutativeAggregateFunction", "ReduceAggregateFunction",

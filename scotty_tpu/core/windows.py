@@ -382,6 +382,16 @@ class ForwardContextAware(Window):
     def create_context(self) -> WindowContext:
         raise NotImplementedError
 
+    def device_context_spec(self):
+        """Device face of the context calculus — a
+        :class:`scotty_tpu.engine.context.DeviceContextSpec`, or None when
+        the window is host-only (the hybrid operator then routes it to the
+        simulator). The same dual-face pattern as
+        ``AggregateFunction.device_spec``: coherence between
+        ``create_context()`` and the device spec is the implementor's
+        contract, pinned by differential tests."""
+        return None
+
 
 class ForwardContextFree(Window):
     """Context windows whose edges do not depend on tuple values
@@ -389,6 +399,9 @@ class ForwardContextFree(Window):
 
     def create_context(self) -> WindowContext:
         raise NotImplementedError
+
+    def device_context_spec(self):
+        return None
 
 
 @dataclass(frozen=True)
@@ -473,5 +486,138 @@ class SessionWindow(ForwardContextAware):
                     return
                 session = self.get_window(0)
 
+    def device_context_spec(self):
+        from ..engine.context import SessionDecider
+
+        return SessionDecider(self.gap)
+
     def __str__(self) -> str:
         return f"SessionWindow{{measure={self.measure.value}, gap={self.gap}}}"
+
+
+@dataclass(frozen=True)
+class CappedSessionWindow(ForwardContextAware):
+    """Gap session that refuses to grow beyond ``max_span``: an extension
+    that would stretch a session's ``[first, last]`` extent past
+    ``max_span`` opens a fresh session instead, and merges whose combined
+    extent would exceed the cap are declined (so capped sessions, unlike
+    plain ones, may sit closer than ``gap`` to a neighbor).
+
+    The shipped example of a USER-DEFINED forward-context-aware window
+    with both faces: this host context runs through the reference
+    calculus + slice repair on the simulator; the device face
+    (`engine/context.py::CappedSessionDecider`) expresses the same
+    decisions over bounded active-window arrays. No reference
+    counterpart — it exists to prove the context API is open
+    (ForwardContextAware.java:6-9, WindowContext.java:9-107).
+    """
+
+    measure: WindowMeasure
+    gap: int
+    max_span: int
+
+    def create_context(self) -> "CappedSessionWindow.CappedContext":
+        return CappedSessionWindow.CappedContext(self.gap, self.max_span,
+                                                 self.measure)
+
+    def device_context_spec(self):
+        from ..engine.context import CappedSessionDecider
+
+        return CappedSessionDecider(self.gap, self.max_span)
+
+    class CappedContext(WindowContext):
+        """SessionContext's calculus with span-cap checks; inserts at the
+        sorted position (a declined extension may target a spot past an
+        adjacent capped session)."""
+
+        def __init__(self, gap: int, max_span: int, measure: WindowMeasure):
+            super().__init__()
+            self.gap = gap
+            self.max_span = max_span
+            self.measure = measure
+
+        def _add_sorted(self, position: int):
+            k = 0
+            while (k < self.number_of_active_windows()
+                   and self.get_window(k).start <= position):
+                k += 1
+            return self.add_new_window(k, position, position)
+
+        def update_context(self, tuple_, position: int):
+            gap, cap = self.gap, self.max_span
+            if self.has_no_active_windows():
+                self.add_new_window(0, position, position)
+                return self.get_window(0)
+            i = self.get_session(position)
+            if i == -1:
+                self.add_new_window(0, position, position)
+                return None
+            s = self.get_window(i)
+            if s.start - gap > position:
+                return self.add_new_window(i, position, position)
+            elif s.start > position and s.start - gap < position:
+                if s.end - position > cap:      # declined start-extension
+                    return self._add_sorted(position)
+                self.shift_start(s, position)
+                if i > 0:
+                    pre = self.get_window(i - 1)
+                    if pre.end + gap >= s.start \
+                            and s.end - pre.start <= cap:
+                        return self.merge_with_pre(i)
+                return s
+            elif s.end < position and s.end + gap >= position:
+                if position - s.start > cap:    # declined end-extension
+                    return self._add_sorted(position)
+                self.shift_end(s, position)
+                if i < self.number_of_active_windows() - 1:
+                    nxt = self.get_window(i + 1)
+                    if s.end + gap >= nxt.start \
+                            and nxt.end - s.start <= cap:
+                        return self.merge_with_pre(i + 1)
+                return s
+            elif s.end + gap < position:
+                return self.add_new_window(i + 1, position, position)
+            return None
+
+        def get_session(self, position: int) -> int:
+            # earliest live session in reach (SessionWindow.java:86-98)
+            i = 0
+            while i < self.number_of_active_windows():
+                s = self.get_window(i)
+                if s.start - self.gap <= position \
+                        and s.end + self.gap >= position:
+                    return i
+                elif s.start - self.gap > position:
+                    return i - 1
+                i += 1
+            return i - 1
+
+        def assign_next_window_start(self, position: int) -> int:
+            # the slicer cuts a flexible slice edge when a tuple reaches
+            # this boundary (StreamSlicer.java:118-130): for capped
+            # sessions that is the usual gap expiry OR the newest
+            # session's span cap — announcing the cap keeps slice edges
+            # aligned with declined-extension boundaries, so window
+            # values stay exact on the host path too
+            nxt = position + self.gap
+            if not self.has_no_active_windows():
+                s = self.get_window(self.number_of_active_windows() - 1)
+                if s.start <= position <= s.end + self.gap:
+                    nxt = min(nxt, s.start + self.max_span + 1)
+            return nxt
+
+        def trigger_windows(self, collector, last_watermark: int,
+                            current_watermark: int) -> None:
+            i = 0
+            while i < self.number_of_active_windows():
+                s = self.get_window(i)
+                if s.end + self.gap < current_watermark:
+                    collector.trigger(s.start, s.end + self.gap,
+                                      self.measure)
+                    self.remove_window(i)
+                else:
+                    i += 1
+
+    def __str__(self) -> str:
+        return (f"CappedSessionWindow{{measure={self.measure.value}, "
+                f"gap={self.gap}, maxSpan={self.max_span}}}")

@@ -1,0 +1,446 @@
+"""Generic device path for forward-context-aware windows.
+
+The reference accepts ANY user window implementing the per-tuple
+``WindowContext`` calculus (core/.../ForwardContextAware.java:6-9,
+windowContext/WindowContext.java:9-107): ``updateContext`` edits a sorted
+list of active ``[start, end]`` windows (shift edges, insert, merge,
+delete), the recorded Shift/Add/Delete modifications drive slice repair
+(SliceManager.java:89-166), and ``triggerWindows`` emits completed windows
+at each watermark.
+
+The TPU-first redesign keeps the session engine's shape (engine/sessions.py:
+bounded active-window arrays owning their own partial aggregates — no
+data-dependent slice topology to repair) and factors the WINDOW-SPECIFIC
+part behind :class:`DeviceContextSpec`: per tuple, the spec's ``decide``
+inspects the active-window arrays with pure jax ops and returns a
+:class:`ContextDecision` — fold into a row (with optional edge shifts),
+merge two adjacent rows, insert a fresh window, or drop (orphan) — which
+the generic apply kernel executes as masked array updates inside one
+``lax.scan``. This is the same dual-face pattern as
+``DeviceAggregateSpec``: the host face (``Window.create_context()``) runs
+on the reference-semantics simulator, the device face here, and coherence
+between the two is the implementor's contract, pinned by differential
+tests (tests/test_context_windows.py).
+
+Sequential per-tuple application is deliberate: the reference calculus is
+arrival-order-dependent (same argument as the session late scan,
+engine/sessions.py module docstring), and a user-defined decision function
+has no general batched form. Windows that admit one (sessions: the
+in-order chain) keep their vectorized fast paths; the generic path is the
+capability floor, fused into one device program per chunk with no host
+round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.aggregates import DeviceAggregateSpec
+from .core import I64_MAX, I64_MIN
+from .sessions import SessionState, init_session_state  # noqa: F401 (re-export)
+
+
+class ContextDecision(NamedTuple):
+    """One tuple's effect on the active-window arrays — the device
+    analogue of one ``updateContext`` call. All fields are 0-d arrays.
+
+    Exactly one of ``touch``/``insert``/``drop`` may hold (or none: the
+    tuple vanishes from this window family, like the reference's
+    fall-through-returning-null); ``merge`` may accompany ``touch``.
+    """
+
+    touch: jnp.ndarray      # bool — fold the tuple into row ``row``
+    row: jnp.ndarray        # i32 — target row of the fold
+    set_first: jnp.ndarray  # i64 — new first for ``row`` (I64_MAX: keep)
+    set_last: jnp.ndarray   # i64 — new last for ``row`` (I64_MIN: keep)
+    merge: jnp.ndarray      # i32 — merge rows (merge, merge+1); -1: none
+    insert: jnp.ndarray     # bool — open a fresh window
+    ins_first: jnp.ndarray  # i64
+    ins_last: jnp.ndarray   # i64
+    drop: jnp.ndarray       # bool — park the tuple in the orphan buffer
+
+
+class DeviceContextSpec:
+    """Device face of a ForwardContextAware/ForwardContextFree window.
+
+    Implementations must be pure jax-traceable functions of their array
+    arguments (they run inside jit/scan). ``token`` keys the kernel cache,
+    so two windows with equal tokens MUST have identical behavior.
+    """
+
+    def token(self):
+        raise NotImplementedError
+
+    def decide(self, first: jnp.ndarray, last: jnp.ndarray,
+               n: jnp.ndarray, pos: jnp.ndarray) -> ContextDecision:
+        """Per-tuple decision over the live rows ``[0, n)`` of the sorted
+        (by ``first``) active-window arrays."""
+        raise NotImplementedError
+
+    def trigger_done(self, first: jnp.ndarray, last: jnp.ndarray,
+                     n: jnp.ndarray, wm: jnp.ndarray) -> jnp.ndarray:
+        """bool[K] mask of live rows complete at watermark ``wm``
+        (need not be a prefix)."""
+        raise NotImplementedError
+
+    def emit_bounds(self, first: jnp.ndarray, last: jnp.ndarray):
+        """
+
+        Emitted window bounds ``(ws, we)`` of completed rows (vectorized
+        over rows; e.g. sessions emit ``[first, last + gap)``)."""
+        raise NotImplementedError
+
+    def orphan_reach(self) -> int:
+        """How far below the GC bound an orphaned tuple may still be
+        claimed by a future window (sessions: the gap)."""
+        raise NotImplementedError
+
+    def clear_delay(self) -> int:
+        """GC-bound participation, mirroring ``Window.clear_delay``."""
+        raise NotImplementedError
+
+
+class SessionDecider(DeviceContextSpec):
+    """SessionWindow's calculus through the generic contract — the
+    coherence proof that the generic path reproduces the tuned session
+    path (pinned by tests), and the template for user windows.
+    Decision logic mirrors engine/sessions.py::build_session_late
+    (itself replaying SessionWindow.java:40-98)."""
+
+    def __init__(self, gap: int):
+        self.gap = int(gap)
+
+    def token(self):
+        return ("session", self.gap)
+
+    def decide(self, first, last, n, pos):
+        S = first.shape[0]
+        gap = jnp.int64(self.gap)
+        idx = jnp.arange(S)
+        live = idx < n
+        reach = live & (first - gap <= pos) & (pos <= last + gap)
+        has = reach.any()
+        j = jnp.argmax(reach).astype(jnp.int32)
+        fj, lj = first[j], last[j]
+        inside = has & (fj <= pos) & (pos <= lj)
+        ext_s = has & (fj > pos) & (fj - gap < pos)
+        ext_e = has & (lj < pos) & (pos <= lj + gap)
+        touch = inside | ext_s | ext_e
+        jm1 = jnp.maximum(j - 1, 0)
+        jp1 = jnp.minimum(j + 1, S - 1)
+        merge_pre = ext_s & (j > 0) & (last[jm1] + gap >= pos)
+        merge_nxt = ext_e & (j + 1 < n) & (pos + gap >= first[jp1])
+        merge = jnp.where(merge_pre, jm1,
+                          jnp.where(merge_nxt, j, -1)).astype(jnp.int32)
+        return ContextDecision(
+            touch=touch, row=j,
+            set_first=jnp.where(ext_s, pos, I64_MAX),
+            set_last=jnp.where(ext_e, pos, I64_MIN),
+            merge=merge,
+            insert=~has, ins_first=pos, ins_last=pos,
+            drop=has & ~touch)
+
+    def trigger_done(self, first, last, n, wm):
+        live = jnp.arange(first.shape[0]) < n
+        return live & (last + jnp.int64(self.gap) < wm)
+
+    def emit_bounds(self, first, last):
+        return first, last + jnp.int64(self.gap)
+
+    def orphan_reach(self) -> int:
+        return self.gap
+
+    def clear_delay(self) -> int:
+        return self.gap
+
+
+class CappedSessionDecider(DeviceContextSpec):
+    """Device face of :class:`scotty_tpu.core.windows.CappedSessionWindow`
+    (sessions that refuse to grow beyond ``max_span``) — the shipped
+    example of a USER-DEFINED context-aware window with both faces."""
+
+    def __init__(self, gap: int, max_span: int):
+        self.gap = int(gap)
+        self.max_span = int(max_span)
+
+    def token(self):
+        return ("capped-session", self.gap, self.max_span)
+
+    def decide(self, first, last, n, pos):
+        S = first.shape[0]
+        gap = jnp.int64(self.gap)
+        cap = jnp.int64(self.max_span)
+        idx = jnp.arange(S)
+        live = idx < n
+        reach = live & (first - gap <= pos) & (pos <= last + gap)
+        has = reach.any()
+        j = jnp.argmax(reach).astype(jnp.int32)
+        fj, lj = first[j], last[j]
+        inside = has & (fj <= pos) & (pos <= lj)
+        want_s = has & (fj > pos) & (fj - gap < pos)
+        want_e = has & (lj < pos) & (pos <= lj + gap)
+        fit_s = want_s & (lj - pos <= cap)       # span after start-extension
+        fit_e = want_e & (pos - fj <= cap)       # span after end-extension
+        touch = inside | fit_s | fit_e
+        jm1 = jnp.maximum(j - 1, 0)
+        jp1 = jnp.minimum(j + 1, S - 1)
+        merge_pre = fit_s & (j > 0) & (last[jm1] + gap >= pos) \
+            & (lj - first[jm1] <= cap)           # merged span within cap
+        merge_nxt = fit_e & (j + 1 < n) & (pos + gap >= first[jp1]) \
+            & (last[jp1] - fj <= cap)
+        merge = jnp.where(merge_pre, jm1,
+                          jnp.where(merge_nxt, j, -1)).astype(jnp.int32)
+        # a declined extension opens a fresh [pos, pos] window instead —
+        # capped windows may therefore sit closer than gap to a neighbor
+        insert = ~has | (want_s & ~fit_s) | (want_e & ~fit_e)
+        return ContextDecision(
+            touch=touch, row=j,
+            set_first=jnp.where(fit_s, pos, I64_MAX),
+            set_last=jnp.where(fit_e, pos, I64_MIN),
+            merge=merge,
+            insert=insert, ins_first=pos, ins_last=pos,
+            drop=has & ~touch & ~insert)
+
+    def trigger_done(self, first, last, n, wm):
+        live = jnp.arange(first.shape[0]) < n
+        return live & (last + jnp.int64(self.gap) < wm)
+
+    def emit_bounds(self, first, last):
+        return first, last + jnp.int64(self.gap)
+
+    def orphan_reach(self) -> int:
+        return self.gap
+
+    def clear_delay(self) -> int:
+        return self.gap + self.max_span
+
+
+def build_context_apply(aggs: tuple[DeviceAggregateSpec, ...],
+                        spec: DeviceContextSpec, capacity: int):
+    """Arrival-order application of a tuple chunk to one context window's
+    active arrays: one ``lax.scan``, each step = ``spec.decide`` + the
+    generic masked-array application (fold / edge shifts / merge / insert
+    / orphan) transplanted from the session late kernel
+    (engine/sessions.py::build_session_late)."""
+    S = capacity
+    idx = jnp.arange(S)
+
+    def _bcast(mask, arr):
+        return mask if arr.ndim == 1 else mask[:, None]
+
+    def shift_left(arr, b, flag, fill):
+        nxt = jnp.concatenate([arr[1:], jnp.full_like(arr[:1], fill)])
+        return jnp.where(_bcast(flag & (idx >= b), arr), nxt, arr)
+
+    def shift_right(arr, p, flag, fill):
+        prv = jnp.concatenate([jnp.full_like(arr[:1], fill), arr[:-1]])
+        return jnp.where(_bcast(flag & (idx > p), arr), prv, arr)
+
+    def step(st: SessionState, x):
+        pos, valid, lifts = x
+        d = spec.decide(st.first, st.last, st.n, pos)
+        touch = valid & d.touch
+        new = valid & d.insert
+        dropped = valid & d.drop
+        j = jnp.clip(d.row, 0, S - 1)
+        onej = idx == j
+        first = jnp.where(onej & touch & (d.set_first < I64_MAX),
+                          d.set_first, st.first)
+        last = jnp.where(onej & touch & (d.set_last > I64_MIN),
+                         d.set_last, st.last)
+        counts = st.counts + jnp.where(onej & touch, 1, 0)
+        partials = []
+        for agg, part, lift in zip(aggs, st.partials, lifts):
+            if agg.is_sparse:
+                col, v = lift
+                m2 = (onej & touch)[:, None] \
+                    & (jnp.arange(part.shape[1]) == col)[None, :]
+            else:
+                v = lift
+                m2 = (onej & touch)[:, None]
+            if agg.kind == "sum":
+                part = jnp.where(m2, part + v, part)
+            elif agg.kind == "min":
+                part = jnp.where(m2, jnp.minimum(part, v), part)
+            else:
+                part = jnp.where(m2, jnp.maximum(part, v), part)
+            partials.append(part)
+
+        # -- merge (at most one per tuple, like the reference) -------------
+        do_merge = valid & (d.merge >= 0)
+        a = jnp.clip(jnp.where(do_merge, d.merge, 0), 0, S - 1)
+        b = a + 1
+        onea = idx == a
+        last = jnp.where(onea & do_merge, last[jnp.minimum(b, S - 1)], last)
+        counts = jnp.where(onea & do_merge,
+                           counts[a] + counts[jnp.minimum(b, S - 1)], counts)
+        merged = []
+        for agg, part in zip(aggs, partials):
+            pa = part[a]
+            pb = part[jnp.minimum(b, S - 1)]
+            comb = (pa + pb if agg.kind == "sum"
+                    else jnp.minimum(pa, pb) if agg.kind == "min"
+                    else jnp.maximum(pa, pb))
+            merged.append(jnp.where((onea & do_merge)[:, None], comb, part))
+        first = shift_left(first, b, do_merge, I64_MAX)
+        last = shift_left(last, b, do_merge, I64_MIN)
+        counts = shift_left(counts, b, do_merge, 0)
+        merged = [shift_left(p, b, do_merge, ag.identity)
+                  for ag, p in zip(aggs, merged)]
+
+        # -- insert at the sorted position ---------------------------------
+        p = jnp.searchsorted(first, d.ins_first,
+                             side="left").astype(idx.dtype)
+        first = shift_right(first, p, new, I64_MAX)
+        last = shift_right(last, p, new, I64_MIN)
+        counts = shift_right(counts, p, new, 0)
+        inserted = []
+        for agg, part, lift in zip(aggs, merged, lifts):
+            part = shift_right(part, p, new, agg.identity)
+            if agg.is_sparse:
+                col, v = lift
+                m2 = (idx == p)[:, None] \
+                    & (jnp.arange(part.shape[1]) == col)[None, :] & new
+                base = jnp.where((idx == p)[:, None] & new,
+                                 jnp.asarray(agg.identity, part.dtype), part)
+                part = jnp.where(m2, v, base)
+            else:
+                part = jnp.where((idx == p)[:, None] & new, lift, part)
+            inserted.append(part)
+        onep = idx == p
+        first = jnp.where(onep & new, d.ins_first, first)
+        last = jnp.where(onep & new, d.ins_last, last)
+        counts = jnp.where(onep & new, 1, counts)
+
+        # -- orphan append --------------------------------------------------
+        O = st.o_pos.shape[0]
+        oidx = jnp.arange(O)
+        oneo = (oidx == st.o_n) & dropped
+        o_pos = jnp.where(oneo, pos, st.o_pos)
+        o_partials = []
+        for agg, part, lift in zip(aggs, st.o_partials, lifts):
+            if agg.is_sparse:
+                col, v = lift
+                m2 = oneo[:, None] \
+                    & (jnp.arange(part.shape[1]) == col)[None, :]
+                base = jnp.where(oneo[:, None],
+                                 jnp.asarray(agg.identity, part.dtype), part)
+                part = jnp.where(m2, v, base)
+            else:
+                part = jnp.where(oneo[:, None], lift, part)
+            o_partials.append(part)
+
+        n2 = st.n + jnp.where(new, 1, 0) - jnp.where(do_merge, 1, 0)
+        o_n2 = st.o_n + jnp.where(dropped, 1, 0)
+        overflow = st.overflow | (new & (st.n >= S)) \
+            | (dropped & (st.o_n >= O))
+        return SessionState(first=first, last=last, counts=counts,
+                            partials=tuple(inserted),
+                            n=n2.astype(jnp.int32),
+                            o_pos=o_pos, o_partials=tuple(o_partials),
+                            o_n=o_n2.astype(jnp.int32),
+                            overflow=overflow), None
+
+    def apply(st: SessionState, ts: jnp.ndarray, vals: jnp.ndarray,
+              valid: jnp.ndarray) -> SessionState:
+        lifts = []
+        for agg in aggs:
+            if agg.is_sparse:
+                col, v = agg.lift_sparse(vals)
+                lifts.append((col.astype(jnp.int32),
+                              jnp.where(valid, v, agg.identity)))
+            else:
+                lifted = agg.lift_dense(vals)
+                lifts.append(jnp.where(valid[:, None], lifted, agg.identity))
+        out, _ = jax.lax.scan(step, st, (ts, valid, tuple(lifts)))
+        return out
+
+    return apply
+
+
+def build_context_sweep(aggs: tuple[DeviceAggregateSpec, ...],
+                        spec: DeviceContextSpec, capacity: int,
+                        emit_cap: int):
+    """Watermark trigger for one context window: emit rows the spec marks
+    complete (NOT necessarily a prefix — capped windows can interleave),
+    recover covered orphans, compact survivors. Same output contract as
+    the session sweep: ``(new_state, m, starts[E], ends[E], counts[E],
+    partials…[E])``."""
+    S, E = capacity, emit_cap
+
+    def sweep(st: SessionState, wm: jnp.ndarray, gc_bound: jnp.ndarray):
+        done = spec.trigger_done(st.first, st.last, st.n, wm)
+        m = jnp.sum(done.astype(jnp.int32))
+        order = jnp.argsort(~done, stable=True)        # done rows first,
+        idx = jnp.arange(E)                            # in row (start) order
+        sel = order[jnp.clip(idx, 0, S - 1)]
+        b_ws, b_we = spec.emit_bounds(st.first[sel], st.last[sel])
+        e_starts = jnp.where(idx < m, b_ws, I64_MAX)
+        e_ends = jnp.where(idx < m, b_we, I64_MAX)
+        e_counts = jnp.where(idx < m, st.counts[sel], 0)
+        e_partials = [p[sel] for p in st.partials]
+        em_overflow = m > E
+
+        # -- orphan recovery (first covering window claims the orphan) -----
+        O = st.o_pos.shape[0]
+        o_live = jnp.arange(O) < st.o_n
+        cov = (o_live[None, :] & (e_starts[:, None] <= st.o_pos[None, :])
+               & (st.o_pos[None, :] < e_ends[:, None]))        # [E, O]
+        first_cov = (jnp.cumsum(cov, axis=0) == 1) & cov
+        e_counts = e_counts + jnp.sum(first_cov, axis=1)
+        for i, (agg, op_) in enumerate(zip(aggs, st.o_partials)):
+            if agg.kind == "sum":
+                e_partials[i] = e_partials[i] \
+                    + first_cov.astype(op_.dtype) @ op_        # [E, w] MXU
+            else:
+                ident = jnp.asarray(agg.identity, op_.dtype)
+                masked = jnp.where(first_cov[:, :, None], op_[None, :, :],
+                                   ident)
+                red = (jnp.min if agg.kind == "min" else jnp.max)(masked,
+                                                                 axis=1)
+                e_partials[i] = (jnp.minimum if agg.kind == "min"
+                                 else jnp.maximum)(e_partials[i], red)
+        consumed = jnp.any(first_cov, axis=0)
+        live_mask = (jnp.arange(S) < st.n) & ~done
+        cov_live = jnp.any(
+            live_mask[:, None] & (st.first[:, None] <= st.o_pos[None, :])
+            & (st.o_pos[None, :] < st.last[:, None]
+               + jnp.int64(spec.orphan_reach())), axis=0)
+        keep_o = o_live & ~consumed \
+            & (cov_live | (st.o_pos >= gc_bound - spec.orphan_reach()))
+        oorder = jnp.argsort(~keep_o, stable=True)
+        o_n2 = jnp.sum(keep_o.astype(jnp.int32)).astype(jnp.int32)
+        o_pos2 = jnp.where(jnp.arange(O) < o_n2, st.o_pos[oorder], I64_MAX)
+        o_partials2 = tuple(
+            jnp.where((jnp.arange(O) < o_n2)[:, None], p[oorder],
+                      jnp.asarray(a.identity, p.dtype))
+            for a, p in zip(aggs, st.o_partials))
+
+        # -- compact survivors (order-preserving) --------------------------
+        keep = (jnp.arange(S) < st.n) & ~done
+        korder = jnp.argsort(~keep, stable=True)
+        n2 = (st.n - m).astype(jnp.int32)
+        krows = jnp.arange(S) < n2
+
+        def compact(a, fill):
+            g = a[korder]
+            if a.ndim == 1:
+                return jnp.where(krows, g, fill)
+            return jnp.where(krows[:, None], g, fill)
+
+        new_state = SessionState(
+            first=compact(st.first, I64_MAX),
+            last=compact(st.last, I64_MIN),
+            counts=compact(st.counts, 0),
+            partials=tuple(compact(p, a.identity)
+                           for a, p in zip(aggs, st.partials)),
+            n=n2,
+            o_pos=o_pos2, o_partials=o_partials2, o_n=o_n2,
+            overflow=st.overflow | em_overflow,
+        )
+        return new_state, m, e_starts, e_ends, e_counts, tuple(e_partials)
+
+    return sweep

@@ -31,6 +31,8 @@ from ..core.windows import (
     LONG_MAX,
     ContextFreeWindow,
     FixedBandWindow,
+    ForwardContextAware,
+    ForwardContextFree,
     SessionWindow,
     SlidingWindow,
     TumblingWindow,
@@ -155,6 +157,27 @@ def _record_kernels(record_capacity: int, capacity: int):
     return hit
 
 
+def _context_kernels(aggs, spec, capacity: int, emit_cap: int):
+    """Jitted generic context-window kernels (apply scan + sweep), cached
+    by the spec's token — see engine/context.py."""
+    import jax
+    from . import context as ectx
+
+    key = ("context", spec.token(), tuple(a.token for a in aggs), capacity,
+           emit_cap)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        hit = (
+            jax.jit(ectx.build_context_apply(aggs, spec, capacity),
+                    donate_argnums=0),
+            jax.jit(ectx.build_context_sweep(aggs, spec, capacity,
+                                             emit_cap),
+                    donate_argnums=0),
+        )
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
 def _dense_kernel(spec, capacity: int, runs: int):
     """Jitted scatter-free in-order ingest (build_ingest_dense), cached."""
     import jax
@@ -229,6 +252,17 @@ class TpuWindowOperator(WindowOperator):
                 raise UnsupportedOnDevice("count-measure sessions: host only")
             self.windows.append(window)
             return
+        if isinstance(window, (ForwardContextAware, ForwardContextFree)):
+            # user-defined context-aware windows run on the generic
+            # active-window-array engine (engine/context.py) when they
+            # provide a device face; host-only contexts fall back
+            if window.device_context_spec() is None:
+                raise UnsupportedOnDevice(
+                    f"{type(window).__name__} has no device context spec "
+                    "(device_context_spec() is None); use "
+                    "SlicingWindowOperator or HybridWindowOperator")
+            self.windows.append(window)
+            return
         if not isinstance(window, (TumblingWindow, SlidingWindow,
                                    FixedBandWindow)):
             raise UnsupportedOnDevice(
@@ -268,10 +302,12 @@ class TpuWindowOperator(WindowOperator):
         see them; results are identical from the first old-grid edge after
         the addition onward.
         """
-        if self._session_windows or isinstance(window, SessionWindow):
+        if self._session_windows or getattr(self, "_ctx_windows", None) \
+                or isinstance(window, (SessionWindow, ForwardContextAware,
+                                       ForwardContextFree)):
             raise UnsupportedOnDevice(
-                "dynamic addition with session windows needs the host "
-                "operator")
+                "dynamic addition with session/context windows needs the "
+                "host operator")
         if not isinstance(window, (TumblingWindow, SlidingWindow,
                                    FixedBandWindow)):
             raise UnsupportedOnDevice(
@@ -321,6 +357,8 @@ class TpuWindowOperator(WindowOperator):
         for w in self.windows:
             if isinstance(w, SessionWindow):
                 session_gaps.append(int(w.gap))
+            elif isinstance(w, (ForwardContextAware, ForwardContextFree)):
+                pass        # generic context windows own their arrays
             elif w.measure == WindowMeasure.Count:
                 count_periods.append(int(w.slide)
                                      if isinstance(w, SlidingWindow)
@@ -361,12 +399,18 @@ class TpuWindowOperator(WindowOperator):
         # kernel-cache keys and the dense fast path independent of sessions.
         self._session_windows = [w for w in self.windows
                                  if isinstance(w, SessionWindow)]
+        self._ctx_windows = [
+            w for w in self.windows
+            if isinstance(w, (ForwardContextAware, ForwardContextFree))
+            and not isinstance(w, SessionWindow)]
         import dataclasses
 
         self._grid_spec = dataclasses.replace(self._spec, session_gaps=())
         self._has_grid = (self._grid_spec.has_time_grid
                           or bool(self._grid_spec.count_periods))
-        self._pure_session = bool(self._session_windows) and not self._has_grid
+        self._pure_session = bool(self._session_windows
+                                  or self._ctx_windows) \
+            and not self._has_grid
         self._has_count = bool(self._grid_spec.count_periods)
         self._rec = None
         if self._has_grid:
@@ -405,6 +449,32 @@ class TpuWindowOperator(WindowOperator):
             self._session_dense = [None] * len(self._session_windows)
         else:
             self._session_states = []
+        if self._ctx_windows:
+            if not self._session_windows:
+                self._emit_cap = self.config.trigger_pad(1024)
+            specs = [w.device_context_spec() for w in self._ctx_windows]
+            pairs = [_context_kernels(self._spec.aggs, sp, C, self._emit_cap)
+                     for sp in specs]
+            self._ctx_applies = tuple(p[0] for p in pairs)
+            self._ctx_sweeps = tuple(p[1] for p in pairs)
+            self._ctx_states = [
+                es.init_session_state(self._spec.aggs, C,
+                                      orphan_capacity=max(64, A))
+                for _ in specs]
+        else:
+            self._ctx_states = []
+        # per-watermark emission order among context windows follows their
+        # REGISTRATION order (the simulator iterates contexts in that
+        # order, WindowManager.java:98-118)
+        self._ctx_order = []
+        si = gi = 0
+        for w in self.windows:
+            if isinstance(w, SessionWindow):
+                self._ctx_order.append(("s", si))
+                si += 1
+            elif isinstance(w, (ForwardContextAware, ForwardContextFree)):
+                self._ctx_order.append(("g", gi))
+                gi += 1
         self._dense_runs = self.config.dense_ingest_runs \
             if (self._has_grid and dense_eligible(self._grid_spec)) else 0
         self._min_grid = min_grid_period(self._grid_spec)
@@ -475,6 +545,10 @@ class TpuWindowOperator(WindowOperator):
             # session calculus is arrival-order-dependent at exact-gap
             # boundaries (engine/sessions.py module docstring)
             self._feed_sessions(batch_v[:take], batch_t[:take], met_pre)
+        if self._ctx_states and take:
+            # generic context windows replay the whole batch in arrival
+            # order through their scan kernels (engine/context.py)
+            self._feed_contexts(batch_v[:take], batch_t[:take])
 
         if mixed and take:
             # arrival-order cut calculus: maintains the open-slice mirror on
@@ -731,6 +805,21 @@ class TpuWindowOperator(WindowOperator):
                     self._session_states[i] = kern(
                         self._session_states[i], pt, pv, m)
 
+    def _feed_contexts(self, vals: np.ndarray, tss: np.ndarray) -> None:
+        """Apply this batch to every generic context window's active
+        arrays, in arrival order, one fused scan dispatch per chunk."""
+        B = self.config.batch_size
+        for lo in range(0, tss.size, B):
+            ct, cv = tss[lo:lo + B], vals[lo:lo + B]
+            k = ct.size
+            pt = np.full((B,), ct[-1], np.int64)
+            pv = np.zeros((B,), np.float32)
+            pt[:k], pv[:k] = ct, cv
+            m = np.zeros((B,), bool)
+            m[:k] = True
+            for i, kern in enumerate(self._ctx_applies):
+                self._ctx_states[i] = kern(self._ctx_states[i], pt, pv, m)
+
     def _pick_inorder_kernel(self, ts_lo: int, ts_hi: int):
         """Scatter-free dense kernel when the batch's slice-run count is
         provably under the bound; general in-order kernel otherwise."""
@@ -773,10 +862,10 @@ class TpuWindowOperator(WindowOperator):
             m = np.zeros((B,), bool)
             m[:n] = True
             valid = jax.device_put(m)
-        if self._session_states:
+        if self._session_states or self._ctx_states:
             raise UnsupportedOnDevice(
-                "device-resident batches with session windows: use "
-                "process_elements (host-fed) for session workloads")
+                "device-resident batches with session/context windows: use "
+                "process_elements (host-fed) for context workloads")
         if self._has_count and self._grid_spec.has_time_grid:
             # the host cut mirror can't see device-resident timestamps; a
             # later late host batch must fall back (see _launch_batch)
@@ -907,8 +996,9 @@ class TpuWindowOperator(WindowOperator):
 
         trig_s, trig_e, trig_c = [], [], []
         for w in self.windows:
-            if isinstance(w, SessionWindow):
-                continue              # sessions emit via their own sweeps
+            if isinstance(w, (SessionWindow, ForwardContextAware,
+                              ForwardContextFree)):
+                continue              # context windows emit via their sweeps
             if w.measure == WindowMeasure.Count:
                 s_arr, e_arr = w.trigger_arrays(self._last_count, cend + 1)
                 trig_c.append(np.ones(s_arr.shape[0], bool))
@@ -974,22 +1064,29 @@ class TpuWindowOperator(WindowOperator):
                                 watermark_ts)
 
     def _wrap_mixed(self, grid, watermark_ts: int):
-        """Append session sweeps to a grid watermark result when session
-        windows are registered (emission order matches the simulator:
-        context-free windows first, then context-aware —
+        """Append context-window sweeps to a grid watermark result when
+        session/context windows are registered (emission order matches
+        the simulator: context-free windows first, then context-aware —
         WindowManager.java:98-118)."""
-        if not self._session_states:
+        if not (self._session_states or self._ctx_states):
             return grid
         return ("mixed", grid, self._sweep_sessions(watermark_ts))
 
     def _sweep_sessions(self, watermark_ts: int):
+        """Sweep every context window (tuned session paths and generic
+        device-context paths) in registration order."""
         outs = []
         wm = np.int64(watermark_ts)
         gc_bound = np.int64(watermark_ts - self.max_lateness)
-        for i, sweep in enumerate(self._session_sweeps):
-            new_s, m_d, e_s, e_e, e_c, e_p = sweep(self._session_states[i],
-                                                   wm, gc_bound)
-            self._session_states[i] = new_s
+        for kind, i in self._ctx_order:
+            if kind == "s":
+                new_s, m_d, e_s, e_e, e_c, e_p = self._session_sweeps[i](
+                    self._session_states[i], wm, gc_bound)
+                self._session_states[i] = new_s
+            else:
+                new_s, m_d, e_s, e_e, e_c, e_p = self._ctx_sweeps[i](
+                    self._ctx_states[i], wm, gc_bound)
+                self._ctx_states[i] = new_s
             outs.append((m_d, e_s, e_e, e_c, e_p))
         return outs
 
@@ -1055,6 +1152,8 @@ class TpuWindowOperator(WindowOperator):
             self._raise_if_overflow(self._rec.overflow)
         for st in getattr(self, "_session_states", ()):
             self._raise_if_overflow(st.overflow)
+        for st in getattr(self, "_ctx_states", ()):
+            self._raise_if_overflow(st.overflow)
 
     def _fetch_sessions(self, outs):
         """Fetch per-session-window sweep outputs; emission follows window
@@ -1062,7 +1161,8 @@ class TpuWindowOperator(WindowOperator):
         import jax
 
         fetched = jax.device_get(
-            (outs, tuple(s.overflow for s in self._session_states)))
+            (outs, tuple(s.overflow for s in (list(self._session_states)
+                                              + list(self._ctx_states)))))
         gap_outs, ovfs = fetched
         for ovf in ovfs:
             self._raise_if_overflow(ovf)
@@ -1097,4 +1197,6 @@ class TpuWindowOperator(WindowOperator):
             total += int(self._state.n_slices)
         for st in getattr(self, "_session_states", ()):
             total += int(st.n)              # live sessions
+        for st in getattr(self, "_ctx_states", ()):
+            total += int(st.n)              # live context windows
         return total

@@ -20,6 +20,8 @@ from .core.aggregates import AggregateFunction
 from .core.operator import AggregateWindow, WindowOperator
 from .core.windows import (
     FixedBandWindow,
+    ForwardContextAware,
+    ForwardContextFree,
     SlidingWindow,
     TumblingWindow,
     Window,
@@ -57,6 +59,12 @@ class HybridWindowOperator(WindowOperator):
                 # windows — engine/sessions.py); only the Count measure
                 # stays host-only
                 if w.measure != WindowMeasure.Time:
+                    return False
+                continue
+            if isinstance(w, (ForwardContextAware, ForwardContextFree)):
+                # user context windows: device when they provide the
+                # device face (engine/context.py), host otherwise
+                if w.device_context_spec() is None:
                     return False
                 continue
             if not isinstance(w, (TumblingWindow, SlidingWindow,
