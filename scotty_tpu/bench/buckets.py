@@ -42,7 +42,6 @@ class BucketWindowPipeline(FusedPipelineDriver):
     def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
                  throughput: int = 1_000_000, wm_period_ms: int = 1000,
                  seed: int = 0, chunk: int = 1 << 18,
-                 max_chunk_elems: int = 1 << 25,
                  value_scale: float = 10_000.0, max_lateness: int = 1000):
         import jax
         import jax.numpy as jnp

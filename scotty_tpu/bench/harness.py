@@ -134,6 +134,10 @@ class BenchmarkConfig:
     out_of_order_pct: float = 0.0
     max_lateness: int = 1000
     seed: int = 42
+    #: record-buffer rows for count-measure cells (0 = EngineConfig's
+    #: 4x capacity default); live records span
+    #: (lateness + count clear-delays + period) x throughput
+    record_capacity: int = 0
     #: {"count": N, "minGapMs": a, "maxGapMs": b} — N silent spans at random
     #: event-time positions (the reference's session gaps,
     #: LoadGeneratorSource.java:60-76, generated BenchmarkRunner.java:174-192).
@@ -154,6 +158,7 @@ class BenchmarkConfig:
             watermark_period_ms=raw.get("watermarkPeriodMs", 1000),
             batch_size=raw.get("batchSize", 1 << 15),
             capacity=raw.get("capacity", 1 << 17),
+            record_capacity=raw.get("recordCapacity", 0),
             n_keys=raw.get("nKeys", 1),
             out_of_order_pct=raw.get("outOfOrderPct", 0.0),
             max_lateness=raw.get("maxLateness", 1000),
@@ -360,7 +365,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         from ..engine import EngineConfig, TpuWindowOperator
 
         op = TpuWindowOperator(config=EngineConfig(
-            capacity=cfg.capacity, batch_size=cfg.batch_size))
+            capacity=cfg.capacity, batch_size=cfg.batch_size,
+            record_capacity=cfg.record_capacity))
     elif engine == "Simulator":
         from ..simulator import SlicingWindowOperator
 
@@ -386,7 +392,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         from ..engine import EngineConfig, TpuWindowOperator
 
         twin = TpuWindowOperator(config=EngineConfig(
-            capacity=cfg.capacity, batch_size=cfg.batch_size))
+            capacity=cfg.capacity, batch_size=cfg.batch_size,
+            record_capacity=cfg.record_capacity))
         for w in windows:
             twin.add_window_assigner(w)
         twin.add_aggregation(make_aggregation(agg_name))

@@ -298,6 +298,9 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if engine == "HostFed":
         return run_host_fed_cell(cfg, window_spec, agg_name)
 
+    if engine == "KeyedHostFed":
+        return run_keyed_host_fed_cell(cfg, window_spec, agg_name)
+
     raise ValueError(f"unknown engine {engine!r}")
 
 
@@ -416,6 +419,119 @@ def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     res.link_saturation = res.link_mbps_achieved / max(link_mbps, 1e-9)
     res.n_lat_samples = len(lats)
     res.p50_emit_ms = float(np.percentile(lats, 50))
+    return res
+
+
+def run_keyed_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
+                            agg_name: str) -> BenchResult:
+    """Keyed host-fed cell (VERDICT r3 item 7): (key, value, ts) records
+    originate in HOST memory, pack into padded ``[K, Bk]`` rounds
+    (``KeyedHostFeed`` — one vectorized argsort per round) and cross the
+    real link; the timed region covers transfer + unpack + keyed ingest +
+    watermarks, double-buffered. This is the reference benchmark's
+    keyBy → operator boundary end to end
+    (flinkBenchmark/BenchmarkJob.java:84-102). As with the single-stream
+    host-fed cell, the honest score is the SATURATION RATIO against the
+    raw link measured on the same byte volume — the tunneled link is
+    orders of magnitude below the device-resident ingest rate."""
+    import jax
+
+    from ..engine import EngineConfig
+    from ..engine.host_ingest import KeyedHostFeed, measure_link
+    from ..parallel.keyed import KeyedTpuWindowOperator
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    K, Bk = cfg.n_keys, cfg.batch_size
+    N = K * Bk * 3 // 4          # 75% round fill: binomial overflow of a
+    #                              uniform key draw is negligible at Bk>=1k
+    n_rounds = max(4, int(-(-cfg.throughput * cfg.runtime_s // N)))
+
+    rng = np.random.default_rng(cfg.seed)
+    span = cfg.runtime_s * 1000 / n_rounds
+
+    op = KeyedTpuWindowOperator(K, config=EngineConfig(
+        capacity=cfg.capacity, batch_size=Bk))
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(make_aggregation(agg_name))
+    op.set_max_lateness(cfg.max_lateness)
+    feed = KeyedHostFeed(op)
+
+    packed = []
+    for i in range(n_rounds):
+        lo = int(i * span)
+        ts = np.sort(rng.integers(lo, max(lo + 1, int((i + 1) * span)),
+                                  size=N)).astype(np.int64)
+        keys = rng.integers(0, K, size=N).astype(np.int64)
+        vals = (rng.random(N) * 10_000).astype(np.float32)
+        packed.append(feed.pack(keys, vals, ts)
+                      + (int(ts[0]), int(ts[-1])))
+
+    feed.feed_packed(*packed[0])
+    feed.feed_packed(*packed[1])
+    warm_wm = packed[1][5] + 1
+    op.process_watermark_async(warm_wm)
+    jax.device_get(op._state.n_slices)
+
+    next_wm = (warm_wm // cfg.watermark_period_ms + 1) \
+        * cfg.watermark_period_ms
+    pending = []
+    t0 = time.perf_counter()
+    for (base, deltas, vb, counts, lo, hi) in packed[2:]:
+        feed.feed_packed(base, deltas, vb, counts, lo, hi)
+        while hi >= next_wm:
+            out = op.process_watermark_async(next_wm)
+            if out[3] is not None:
+                pending.append((out[0].shape[0], out[2]))
+            next_wm += cfg.watermark_period_ms
+    out = op.process_watermark_async(next_wm)
+    if out[3] is not None:
+        pending.append((out[0].shape[0], out[2]))
+    fetched = jax.device_get([c for _, c in pending])
+    emitted = 0
+    for (T, _), cnt in zip(pending, fetched):
+        emitted += int((np.asarray(cnt)[:, :T] > 0).sum())
+    op.check_overflow()
+    wall = time.perf_counter() - t0
+    n_tuples = (n_rounds - 2) * N
+
+    # drained emit-latency samples (transfer included — that IS the
+    # keyed host-fed delivery path); first round replayed time-shifted
+    lats = []
+    base0, deltas0, vb0, counts0, lo0, hi0 = packed[0]
+    span0 = hi0 - lo0
+    cursor = next_wm
+    t_lat = time.perf_counter()
+    for _ in range(LATENCY_SAMPLES_MAX):
+        jax.device_get(op._state.n_slices)
+        t1 = time.perf_counter()
+        feed.feed_packed(np.int64(cursor), deltas0, vb0, counts0,
+                         int(cursor), int(cursor) + span0)
+        out = op.process_watermark_async(cursor + span0 + 1)
+        if out[3] is not None:
+            jax.device_get(out[2])
+        else:
+            jax.device_get(op._state.n_slices)
+        lats.append((time.perf_counter() - t1) * 1e3)
+        cursor += span0 + cfg.watermark_period_ms
+        if (len(lats) >= LATENCY_SAMPLES_MIN
+                and time.perf_counter() - t_lat > LATENCY_BUDGET_S):
+            break
+
+    link_mbps = max(measure_link(K * Bk, n_batches=8),
+                    measure_link(K * Bk, n_batches=8))
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=float(np.percentile(lats, 99)) if lats else 0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    # the transfer moves the PADDED [K, Bk] rounds — that is the achieved
+    # byte rate the saturation ratio must use
+    res.link_mbps_raw = link_mbps
+    res.link_mbps_achieved = (n_rounds - 2) * K * Bk * 8 / wall / 1e6
+    res.link_saturation = res.link_mbps_achieved / max(link_mbps, 1e-9)
+    res.n_lat_samples = len(lats)
+    res.p50_emit_ms = float(np.percentile(lats, 50)) if lats else 0.0
     return res
 
 

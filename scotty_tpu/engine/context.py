@@ -291,9 +291,11 @@ def build_context_apply(aggs: tuple[DeviceAggregateSpec, ...],
         merged = [shift_left(p, b, do_merge, ag.identity)
                   for ag, p in zip(aggs, merged)]
 
-        # -- insert at the sorted position ---------------------------------
+        # -- insert at the sorted position (AFTER equal starts — matching
+        # the host face's _add_sorted walk; duplicate-start inserts happen
+        # for cap-declined extensions at repeated timestamps) -------------
         p = jnp.searchsorted(first, d.ins_first,
-                             side="left").astype(idx.dtype)
+                             side="right").astype(idx.dtype)
         first = shift_right(first, p, new, I64_MAX)
         last = shift_right(last, p, new, I64_MIN)
         counts = shift_right(counts, p, new, 0)

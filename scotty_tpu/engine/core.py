@@ -1100,6 +1100,42 @@ def build_record_merge(record_capacity: int):
     return merge
 
 
+def build_record_append(record_capacity: int):
+    """In-order record append: a ts-sorted batch at/above the stream's max
+    event time lands as one contiguous ``dynamic_update_slice`` — O(B),
+    versus the general rank merge's O(RC) int64 scatters (~113 ms per M
+    lanes on v5e), which made every in-order count batch pay the whole
+    buffer (r4). Pad lanes are written beyond ``n + nb`` and are dead:
+    every record reader masks by ``rec.n``. The write block must fit —
+    ``overflow`` is raised with one batch of headroom, since a clamped
+    ``dynamic_update_slice`` would land misaligned."""
+    RC = record_capacity
+
+    def append(rec: RecordBuffer, ts: jnp.ndarray, vals: jnp.ndarray,
+               valid: jnp.ndarray) -> RecordBuffer:
+        B = ts.shape[0]
+        nb = jnp.sum(valid.astype(jnp.int32))
+        if B > RC:
+            # tiny buffers (tests): the contiguous block can't fit the
+            # operand — fall back to a [B]-lane drop-mode scatter
+            pos = rec.n + jnp.arange(B, dtype=jnp.int32)
+            pos = jnp.where(valid, pos, RC)
+            rts = rec.rts.at[pos].set(ts, mode="drop")
+            rvals = rec.rvals.at[pos].set(vals.astype(rec.rvals.dtype),
+                                          mode="drop")
+            ovf = rec.n + nb > RC
+        else:
+            rts = jax.lax.dynamic_update_slice(rec.rts, ts, (rec.n,))
+            rvals = jax.lax.dynamic_update_slice(
+                rec.rvals, vals.astype(rec.rvals.dtype), (rec.n,))
+            ovf = rec.n + B > RC
+        return RecordBuffer(
+            rts=rts, rvals=rvals, n=(rec.n + nb).astype(jnp.int32),
+            base=rec.base, overflow=rec.overflow | ovf)
+
+    return append
+
+
 def build_record_gc(capacity: int, record_capacity: int):
     """Drop records behind the slice-GC bound, keeping ranks aligned with
     the surviving slices: the new base is the first surviving slice's

@@ -152,6 +152,8 @@ def _record_kernels(record_capacity: int, capacity: int):
                     donate_argnums=0),
             jax.jit(ec.build_record_gc(capacity, record_capacity),
                     donate_argnums=1),
+            jax.jit(ec.build_record_append(record_capacity),
+                    donate_argnums=0),
         )
         _KERNEL_CACHE[key] = hit
     return hit
@@ -424,7 +426,8 @@ class TpuWindowOperator(WindowOperator):
                 # count windows aggregate ts-sorted rank ranges — retain
                 # records (the reference's lazy-slice retention)
                 self._rec = ec.init_records(RCap)
-                self._rec_merge, self._rec_gc = _record_kernels(RCap, C)
+                (self._rec_merge, self._rec_gc,
+                 self._rec_append) = _record_kernels(RCap, C)
         else:
             self._state = None
         if self._session_windows:
@@ -637,7 +640,10 @@ class TpuWindowOperator(WindowOperator):
                 [batch_v, np.zeros((B - take,), np.float32)])
             valid[take:] = False
         if self._has_count:
-            self._rec = self._rec_merge(self._rec, batch_t, batch_v, valid)
+            # in-order batches append (O(B)); late-containing batches pay
+            # the rank merge (O(RC) scatters) — see build_record_append
+            rec_kern = self._rec_merge if has_late else self._rec_append
+            self._rec = rec_kern(self._rec, batch_t, batch_v, valid)
             if cut_starts is not None:
                 # count-only workloads (in- or out-of-order): the ts-sorted
                 # batch through the in-order kernel IS the ripple's count
@@ -807,15 +813,20 @@ class TpuWindowOperator(WindowOperator):
 
     def _feed_contexts(self, vals: np.ndarray, tss: np.ndarray) -> None:
         """Apply this batch to every generic context window's active
-        arrays, in arrival order, one fused scan dispatch per chunk."""
+        arrays, in arrival order, one fused scan dispatch per chunk. The
+        tail chunk pads to a small power-of-two bucket, NOT the full batch
+        size — the scan is sequential per lane, so a trickle flush at
+        batch_size-length would pay thousands of wasted device steps (the
+        kernels retrace per padded length; bucketing bounds the variants)."""
         B = self.config.batch_size
         for lo in range(0, tss.size, B):
             ct, cv = tss[lo:lo + B], vals[lo:lo + B]
             k = ct.size
-            pt = np.full((B,), ct[-1], np.int64)
-            pv = np.zeros((B,), np.float32)
+            L = B if k == B else min(B, 1 << max(6, (k - 1).bit_length()))
+            pt = np.full((L,), ct[-1], np.int64)
+            pv = np.zeros((L,), np.float32)
             pt[:k], pv[:k] = ct, cv
-            m = np.zeros((B,), bool)
+            m = np.zeros((L,), bool)
             m[:k] = True
             for i, kern in enumerate(self._ctx_applies):
                 self._ctx_states[i] = kern(self._ctx_states[i], pt, pv, m)
@@ -891,7 +902,8 @@ class TpuWindowOperator(WindowOperator):
             kern = self._pick_inorder_kernel(ts_min, ts_max)
         self._state = kern(self._state, ts, vals, valid)
         if self._has_count:
-            self._rec = self._rec_merge(self._rec, ts, vals, valid)
+            # device batches with count windows are in-order by contract
+            self._rec = self._rec_append(self._rec, ts, vals, valid)
 
     def ingest_device_late(self, ts, vals, valid, n: int, ts_min: int,
                            ts_max: int) -> None:
@@ -903,10 +915,10 @@ class TpuWindowOperator(WindowOperator):
         disorder from the in-order base stream."""
         if not self._built:
             self._build()
-        if self._has_count or self._session_states:
+        if self._has_count or self._session_states or self._ctx_states:
             raise UnsupportedOnDevice(
-                "out-of-order device batches with count-measure or session "
-                "windows need the host operator")
+                "out-of-order device batches with count-measure, session "
+                "or context windows need the host operator")
         self._annex_dirty = True
         self._host_met = ts_max if self._host_met is None \
             else max(self._host_met, ts_max)

@@ -166,7 +166,16 @@ class SessionStreamPipeline(FusedPipelineDriver):
         self.max_fixed = max_fixed
 
         # ---- kernels ------------------------------------------------------
-        C, A = self.config.capacity, self.config.annex_capacity
+        # the grid buffer only ever holds rows younger than the GC horizon
+        # (widest window + lateness + gc cadence); the query's log-sweep
+        # sparse table scales with the BUFFER capacity, so clamping it to
+        # the live span (instead of inheriting the generic config default,
+        # sized for 60k-window suites) removes almost all query cost on
+        # session-mix shapes (r4 — the hll mix cell was sweep-bound)
+        need_rows = (max_fixed + max_lateness) // g + S * (gc_every + 2) + 8
+        C = min(self.config.capacity,
+                1 << max(4, (need_rows - 1).bit_length()))
+        A = self.config.annex_capacity
         self.has_grid = bool(grid_windows)
         spec = ec.EngineSpec(
             periods=(g,) if self.has_grid else (), bands=(),
@@ -206,6 +215,24 @@ class SessionStreamPipeline(FusedPipelineDriver):
         self._d, self._n_chunks = d, n_chunks
         first_lw = max(0, P - max_lateness)
 
+        # Narrow sparse sketches (HLL's 256 registers) take a sub-batched
+        # one-hot segment reduce instead of the flat [B]-lane scatter: the
+        # scatter costs ~7 ms per M lanes on v5e regardless of target size
+        # (the r3 hll cell's ceiling), while a [q, width] masked reduce is
+        # bandwidth/VPU-bound — ~6× cheaper at width<=512 (VERDICT r3
+        # item 4). Wide sketches (DDSketch 2048) keep the scatter: their
+        # one-hot would blow the traffic up past the scatter cost.
+        onehot_q = {}
+        for a in aggs:
+            if a.is_sparse and a.width <= 512:
+                qmax = min(R, max(1, max_chunk_elems // a.width))
+                for q in range(qmax, 0, -1):
+                    if R % q == 0:
+                        break
+                if q >= 1024:          # too-small sub-batches can't amortize
+                    onehot_q[a.token] = q
+        self._onehot_q = onehot_q
+
         def gen_chunk(key, c):
             kg = jax.random.fold_in(key, c)
             u = jax.random.uniform(kg, (2, d, R), dtype=jnp.float32)
@@ -224,7 +251,52 @@ class SessionStreamPipeline(FusedPipelineDriver):
                     for aspec in spec.aggs:
                         red = {"sum": jnp.sum, "min": jnp.min,
                                "max": jnp.max}[aspec.kind]
-                        if aspec.is_sparse:
+                        if aspec.is_sparse \
+                                and aspec.token in onehot_q:
+                            # sub-batched one-hot segment reduce (see the
+                            # strategy note in __init__): q tuples at a
+                            # time, [q, width] masked reduce, one-row
+                            # combine into the [d, width] row partials
+                            q = onehot_q[aspec.token]
+                            per_row = R // q
+                            ident = jnp.asarray(aspec.identity,
+                                                jnp.float32)
+
+                            def sub(acc, j, _a=aspec, _q=q, _pr=per_row,
+                                    _ident=ident, _flat=flat):
+                                seg = jax.lax.dynamic_slice(
+                                    _flat, (j * _q,), (_q,))
+                                col, v = _a.lift_sparse(seg)
+                                oh = col[:, None] == jnp.arange(
+                                    _a.width, dtype=col.dtype)[None, :]
+                                row = j // _pr
+                                if _a.kind == "sum":
+                                    upd = jnp.sum(
+                                        jnp.where(oh, v[:, None], 0),
+                                        axis=0)
+                                    return acc.at[row].add(upd), None
+                                # min/max sketch values are small exact
+                                # integers (HLL rho <= 32): the [q, width]
+                                # masked reduce runs in bf16 — half the
+                                # VPU/HBM traffic of f32, no precision loss
+                                vb = v.astype(jnp.bfloat16)
+                                ib = _ident.astype(jnp.bfloat16)
+                                if _a.kind == "min":
+                                    upd = jnp.min(
+                                        jnp.where(oh, vb[:, None], ib),
+                                        axis=0).astype(jnp.float32)
+                                    return acc.at[row].min(upd), None
+                                upd = jnp.max(
+                                    jnp.where(oh, vb[:, None], ib),
+                                    axis=0).astype(jnp.float32)
+                                return acc.at[row].max(upd), None
+
+                            init_pr = jnp.full((d, aspec.width),
+                                               aspec.identity, jnp.float32)
+                            pr, _ = jax.lax.scan(
+                                sub, init_pr,
+                                jnp.arange((d * R) // q, dtype=jnp.int32))
+                        elif aspec.is_sparse:
                             # per-row sketch partials via ONE flat [B]-lane
                             # f32 scatter (never a dense [B, width] lift)
                             col, v = aspec.lift_sparse(flat)
