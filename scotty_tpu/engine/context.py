@@ -99,7 +99,10 @@ class DeviceContextSpec:
         raise NotImplementedError
 
     def clear_delay(self) -> int:
-        """GC-bound participation, mirroring ``Window.clear_delay``."""
+        """GC-bound participation, mirroring ``Window.clear_delay``:
+        retention beyond ``orphan_reach()`` is applied by the operator as
+        extra slack on the sweep's gc_bound, so orphans survive down to
+        ``wm - max_lateness - clear_delay()``."""
         raise NotImplementedError
 
 

@@ -126,6 +126,10 @@ class KeyedHostFeed:
                              "2**32 ms violates the in-order contract")
         order = np.argsort(keys, kind="stable")
         k2 = np.asarray(keys, np.int64)[order]
+        if k2.size and (k2[-1] >= K or k2[0] < 0):
+            raise ValueError(
+                f"KeyedHostFeed.pack: key {int(k2[-1] if k2[-1] >= K else k2[0])} "
+                f"out of range [0, {K})")
         counts = np.bincount(k2, minlength=K)
         if counts.max(initial=0) > Bk:
             raise ValueError(

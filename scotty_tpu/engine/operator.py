@@ -257,7 +257,15 @@ class TpuWindowOperator(WindowOperator):
         if isinstance(window, (ForwardContextAware, ForwardContextFree)):
             # user-defined context-aware windows run on the generic
             # active-window-array engine (engine/context.py) when they
-            # provide a device face; host-only contexts fall back
+            # provide a device face; host-only contexts fall back.
+            # The device calculus runs over event TIMESTAMPS, while the
+            # host face (and the reference, TupleContext.getTs(measure))
+            # runs count-measure contexts over arrival positions — so a
+            # non-Time measure must not silently reach the device.
+            if window.window_measure != WindowMeasure.Time:
+                raise UnsupportedOnDevice(
+                    "count-measure context windows: host only (the device "
+                    "context calculus runs over event time)")
             if window.device_context_spec() is None:
                 raise UnsupportedOnDevice(
                     f"{type(window).__name__} has no device context spec "
@@ -460,6 +468,14 @@ class TpuWindowOperator(WindowOperator):
                      for sp in specs]
             self._ctx_applies = tuple(p[0] for p in pairs)
             self._ctx_sweeps = tuple(p[1] for p in pairs)
+            # clear_delay participates in the GC bound (mirroring
+            # Window.clear_delay / WindowManager.java:121-127): retention
+            # beyond what orphan_reach already grants is applied as a
+            # per-window slack on the sweep's gc_bound, so a user decider
+            # declaring a long clear_delay actually keeps its orphans.
+            self._ctx_gc_slack = tuple(
+                max(0, int(sp.clear_delay()) - int(sp.orphan_reach()))
+                for sp in specs)
             self._ctx_states = [
                 es.init_session_state(self._spec.aggs, C,
                                       orphan_capacity=max(64, A))
@@ -1097,7 +1113,8 @@ class TpuWindowOperator(WindowOperator):
                 self._session_states[i] = new_s
             else:
                 new_s, m_d, e_s, e_e, e_c, e_p = self._ctx_sweeps[i](
-                    self._ctx_states[i], wm, gc_bound)
+                    self._ctx_states[i], wm,
+                    gc_bound - np.int64(self._ctx_gc_slack[i]))
                 self._ctx_states[i] = new_s
             outs.append((m_d, e_s, e_e, e_c, e_p))
         return outs

@@ -257,3 +257,41 @@ def test_hybrid_routes_context_windows():
     host.add_aggregation(SumAggregation())
     host._resolve()
     assert host.backend == "host"
+
+
+def test_count_measure_context_window_routes_to_host():
+    """ADVICE r4 (medium): the device context calculus runs over event
+    TIMESTAMPS; count-measure context windows (whose host face — and the
+    reference, TupleContext.getTs(measure) — runs over arrival positions)
+    must fall back to the host, never silently reach the device."""
+    from scotty_tpu.engine.operator import UnsupportedOnDevice
+    from scotty_tpu.hybrid import HybridWindowOperator
+
+    Count = WindowMeasure.Count
+    w = CappedSessionWindow(Count, 3, 10)
+    assert w.device_context_spec() is not None  # spec exists, measure gates
+
+    dev = TpuWindowOperator(config=SMALL)
+    with pytest.raises(UnsupportedOnDevice):
+        dev.add_window_assigner(w)
+
+    hyb = HybridWindowOperator(engine_config=SMALL)
+    hyb.add_window_assigner(CappedSessionWindow(Count, 3, 10))
+    hyb.add_aggregation(SumAggregation())
+    hyb._resolve()
+    assert hyb.backend == "host"
+
+
+def test_ctx_clear_delay_extends_orphan_retention():
+    """ADVICE r4 (low): DeviceContextSpec.clear_delay() participates in
+    the sweep's GC bound — retention beyond orphan_reach() is applied as
+    slack, so a decider declaring a long clear_delay keeps its orphans
+    past wm - max_lateness."""
+    op = TpuWindowOperator(config=SMALL)
+    op.add_window_assigner(CappedSessionWindow(Time, 10, 30))
+    op.add_aggregation(SumAggregation())
+    # CappedSessionDecider.clear_delay() = gap + max_span = 40,
+    # orphan_reach() = gap = 10 → slack 30
+    op.process_element(1.0, 5)
+    op.process_watermark(4)            # force build
+    assert op._ctx_gc_slack == (30,)

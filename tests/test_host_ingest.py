@@ -136,3 +136,26 @@ def test_keyed_host_feed_matches_per_key_results():
                for s, e, c, v in zip(ws, we, cnt[k], lowered[0][k])
                if c > 0}
         assert got == pytest.approx(want), (k, want, got)
+
+
+def test_keyed_host_feed_rejects_out_of_range_keys():
+    """ADVICE r4 (low): keys outside [0, K) get a clear contract error,
+    not an opaque broadcast failure from bincount."""
+    import pytest
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.host_ingest import KeyedHostFeed
+    from scotty_tpu.parallel.keyed import KeyedTpuWindowOperator
+
+    op = KeyedTpuWindowOperator(4, config=EngineConfig(
+        capacity=1 << 8, batch_size=8, min_trigger_pad=32))
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 100))
+    op.add_aggregation(SumAggregation())
+    feed = KeyedHostFeed(op)
+    ts = np.arange(3, dtype=np.int64)
+    vals = np.ones(3, np.float32)
+    with pytest.raises(ValueError, match="out of range"):
+        feed.pack(np.array([0, 1, 4]), vals, ts)
+    with pytest.raises(ValueError, match="out of range"):
+        feed.pack(np.array([-1, 1, 2]), vals, ts)
