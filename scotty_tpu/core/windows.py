@@ -544,53 +544,60 @@ class CappedSessionWindow(ForwardContextAware):
             return self.add_new_window(k, position, position)
 
         def update_context(self, tuple_, position: int):
+            # Priority calculus (capped sessions may sit CLOSER than gap
+            # to a neighbor, so the plain session rule "act on the first
+            # window in reach" degenerates — a capped-out session keeps
+            # winning the reach walk and every later tuple re-inserts a
+            # point window. Instead: (1) fold into a CONTAINING window;
+            # (2) else take the first FITTING extension; (3) else a
+            # cap-declined reach inserts a fresh point window at the
+            # sorted position; exact-gap reach (position == start - gap,
+            # the strict/non-strict asymmetry inherited from
+            # SessionWindow.java:86-98) orphans, as in plain sessions.
             gap, cap = self.gap, self.max_span
-            if self.has_no_active_windows():
+            n = self.number_of_active_windows()
+            if n == 0:
                 self.add_new_window(0, position, position)
                 return self.get_window(0)
-            i = self.get_session(position)
-            if i == -1:
-                self.add_new_window(0, position, position)
-                return None
-            s = self.get_window(i)
-            if s.start - gap > position:
-                return self.add_new_window(i, position, position)
-            elif s.start > position and s.start - gap < position:
-                if s.end - position > cap:      # declined start-extension
-                    return self._add_sorted(position)
-                self.shift_start(s, position)
-                if i > 0:
-                    pre = self.get_window(i - 1)
-                    if pre.end + gap >= s.start \
-                            and s.end - pre.start <= cap:
-                        return self.merge_with_pre(i)
-                return s
-            elif s.end < position and s.end + gap >= position:
-                if position - s.start > cap:    # declined end-extension
-                    return self._add_sorted(position)
+            exact_gap = declined = False
+            fit_i = -1
+            for k in range(n):
+                s = self.get_window(k)
+                if s.start <= position <= s.end:
+                    return s                        # (1) inside
+                if s.start - gap <= position <= s.end + gap:
+                    if position == s.start - gap:
+                        exact_gap = True
+                    elif fit_i < 0 and (
+                            (s.start > position
+                             and s.end - position <= cap)
+                            or (s.end < position
+                                and position - s.start <= cap)):
+                        fit_i = k
+                    else:
+                        declined = True
+            if fit_i >= 0:                          # (2) fitting extension
+                i, s = fit_i, self.get_window(fit_i)
+                if s.start > position:
+                    self.shift_start(s, position)
+                    if i > 0:
+                        pre = self.get_window(i - 1)
+                        if pre.end + gap >= s.start \
+                                and s.end - pre.start <= cap:
+                            return self.merge_with_pre(i)
+                    return s
                 self.shift_end(s, position)
-                if i < self.number_of_active_windows() - 1:
+                if i < n - 1:
                     nxt = self.get_window(i + 1)
                     if s.end + gap >= nxt.start \
                             and nxt.end - s.start <= cap:
                         return self.merge_with_pre(i + 1)
                 return s
-            elif s.end + gap < position:
-                return self.add_new_window(i + 1, position, position)
-            return None
-
-        def get_session(self, position: int) -> int:
-            # earliest live session in reach (SessionWindow.java:86-98)
-            i = 0
-            while i < self.number_of_active_windows():
-                s = self.get_window(i)
-                if s.start - self.gap <= position \
-                        and s.end + self.gap >= position:
-                    return i
-                elif s.start - self.gap > position:
-                    return i - 1
-                i += 1
-            return i - 1
+            if declined:                            # (3) cap-declined
+                return self._add_sorted(position)
+            if exact_gap:                           # exact-gap fall-through
+                return None
+            return self._add_sorted(position)       # out of all reach
 
         def assign_next_window_start(self, position: int) -> int:
             # the slicer cuts a flexible slice edge when a tuple reaches

@@ -105,6 +105,20 @@ class DeviceContextSpec:
         ``wm - max_lateness - clear_delay()``."""
         raise NotImplementedError
 
+    def inorder_chain_params(self):
+        """Optional batched fast path — the certification that on a
+        SORTED (in-order) stream this window's calculus reduces to the
+        greedy gap/span chain: a tuple folds into the newest window
+        unless its gap to the previous tuple exceeds ``gap`` or the
+        window's span would exceed ``span_cap``, in which case a fresh
+        window opens at the tuple. Return ``(gap, span_cap)`` (span_cap
+        may be None for uncapped) to enable the vectorized chunk kernel
+        (:func:`build_context_chunk` — one device program per chunk, no
+        per-tuple scan), or None (default) to stay on the sequential
+        scan. Correctness of the certification is the implementor's
+        contract, pinned by the differential tests."""
+        return None
+
 
 class SessionDecider(DeviceContextSpec):
     """SessionWindow's calculus through the generic contract — the
@@ -159,6 +173,11 @@ class SessionDecider(DeviceContextSpec):
     def clear_delay(self) -> int:
         return self.gap
 
+    def inorder_chain_params(self):
+        # sorted streams only ever extend the newest session or open a
+        # new one after a gap — the uncapped chain
+        return (self.gap, None)
+
 
 class CappedSessionDecider(DeviceContextSpec):
     """Device face of :class:`scotty_tpu.core.windows.CappedSessionWindow`
@@ -173,39 +192,54 @@ class CappedSessionDecider(DeviceContextSpec):
         return ("capped-session", self.gap, self.max_span)
 
     def decide(self, first, last, n, pos):
+        # Priority calculus, mirroring the host face
+        # (CappedSessionWindow.CappedContext.update_context): capped
+        # windows may sit CLOSER than gap to a neighbor, so "act on the
+        # first window in reach" (the plain-session rule) degenerates —
+        # a capped-out session keeps winning the reach walk and every
+        # later tuple re-inserts a point window. Priority instead:
+        # (1) fold into a CONTAINING row; (2) first FITTING extension;
+        # (3) cap-declined reach inserts a fresh point window; exact-gap
+        # reach (pos == first - gap) orphans, as in plain sessions.
         S = first.shape[0]
         gap = jnp.int64(self.gap)
         cap = jnp.int64(self.max_span)
         idx = jnp.arange(S)
         live = idx < n
-        reach = live & (first - gap <= pos) & (pos <= last + gap)
-        has = reach.any()
-        j = jnp.argmax(reach).astype(jnp.int32)
+        inside_k = live & (first <= pos) & (pos <= last)
+        start_side = live & (first > pos) & (first - gap <= pos)
+        exact_k = start_side & (first - gap == pos)
+        fit_s_k = start_side & ~exact_k & (last - pos <= cap)
+        end_side = live & (last < pos) & (pos <= last + gap)
+        fit_e_k = end_side & (pos - first <= cap)
+        fit_k = fit_s_k | fit_e_k
+        has_inside = inside_k.any()
+        has_fit = fit_k.any()
+        has_decl = ((start_side & ~exact_k & ~fit_s_k)
+                    | (end_side & ~fit_e_k)).any()
+        has_exact = exact_k.any()
+        j = jnp.where(has_inside, jnp.argmax(inside_k),
+                      jnp.argmax(fit_k)).astype(jnp.int32)
+        touch = has_inside | has_fit
+        fs = fit_s_k[j] & ~has_inside
+        fe = fit_e_k[j] & ~has_inside
         fj, lj = first[j], last[j]
-        inside = has & (fj <= pos) & (pos <= lj)
-        want_s = has & (fj > pos) & (fj - gap < pos)
-        want_e = has & (lj < pos) & (pos <= lj + gap)
-        fit_s = want_s & (lj - pos <= cap)       # span after start-extension
-        fit_e = want_e & (pos - fj <= cap)       # span after end-extension
-        touch = inside | fit_s | fit_e
         jm1 = jnp.maximum(j - 1, 0)
         jp1 = jnp.minimum(j + 1, S - 1)
-        merge_pre = fit_s & (j > 0) & (last[jm1] + gap >= pos) \
+        merge_pre = fs & (j > 0) & (last[jm1] + gap >= pos) \
             & (lj - first[jm1] <= cap)           # merged span within cap
-        merge_nxt = fit_e & (j + 1 < n) & (pos + gap >= first[jp1]) \
+        merge_nxt = fe & (j + 1 < n) & (pos + gap >= first[jp1]) \
             & (last[jp1] - fj <= cap)
         merge = jnp.where(merge_pre, jm1,
                           jnp.where(merge_nxt, j, -1)).astype(jnp.int32)
-        # a declined extension opens a fresh [pos, pos] window instead —
-        # capped windows may therefore sit closer than gap to a neighbor
-        insert = ~has | (want_s & ~fit_s) | (want_e & ~fit_e)
+        insert = ~touch & (has_decl | ~has_exact)
         return ContextDecision(
             touch=touch, row=j,
-            set_first=jnp.where(fit_s, pos, I64_MAX),
-            set_last=jnp.where(fit_e, pos, I64_MIN),
+            set_first=jnp.where(fs, pos, I64_MAX),
+            set_last=jnp.where(fe, pos, I64_MIN),
             merge=merge,
             insert=insert, ins_first=pos, ins_last=pos,
-            drop=has & ~touch & ~insert)
+            drop=~touch & ~insert)
 
     def trigger_done(self, first, last, n, wm):
         live = jnp.arange(first.shape[0]) < n
@@ -219,6 +253,14 @@ class CappedSessionDecider(DeviceContextSpec):
 
     def clear_delay(self) -> int:
         return self.gap + self.max_span
+
+    def inorder_chain_params(self):
+        # on a sorted stream the priority calculus reduces to the greedy
+        # chain: the newest session extends while within gap AND span;
+        # a cap-decline opens the next session at the declining tuple
+        # (older rows can never fit when the newest declines — their
+        # spans are larger and their reach smaller)
+        return (self.gap, self.max_span)
 
 
 def build_context_apply(aggs: tuple[DeviceAggregateSpec, ...],
@@ -449,3 +491,168 @@ def build_context_sweep(aggs: tuple[DeviceAggregateSpec, ...],
         return new_state, m, e_starts, e_ends, e_counts, tuple(e_partials)
 
     return sweep
+
+
+def build_context_chunk(aggs: tuple, spec: DeviceContextSpec,
+                        capacity: int, chunk_len: int, max_segments: int = 64):
+    """Vectorized in-order chunk application for specs certifying the
+    greedy gap/span chain (``DeviceContextSpec.inorder_chain_params``):
+    the whole sorted chunk is segmented into its chain windows in ONE
+    device program — gap breaks via a reverse running-min of break
+    indices, span-cap splits via a bounded split loop (``max_segments``
+    iterations, each one searchsorted — which XLA lowers to an O(B)
+    broadcast compare on TPU, so ``max_segments`` is a real cost knob:
+    64 iterations over a 2 M chunk measure ~19 ms, 256 measure ~1.6 s) —
+    then each segment folds with one prefix-sum / log-sweep range
+    reduction, and the new windows append as one block write. Replaces
+    ``max_segments``-bounded stretches of the per-tuple scan with ~O(B)
+    total work: the difference between ~10 K t/s and >100 M t/s on the
+    capped-session bench cell. More than ``max_segments`` chain windows
+    in one chunk sets the overflow flag (feed smaller batches).
+
+    Precondition (checked by the caller): the chunk is sorted and starts
+    at/after every prior tuple, and the orphan set is empty of future
+    claims only the scan could service (in-order chains never orphan).
+    """
+    from .core import _range_combine
+
+    gap_i, cap_i = spec.inorder_chain_params()
+    S, B, M = capacity, chunk_len, max_segments
+    gap = jnp.int64(gap_i)
+    cap = None if cap_i is None else jnp.int64(cap_i)
+    levels = max(1, B.bit_length())
+    red = {"min": jnp.minimum, "max": jnp.maximum}
+
+    def apply_chunk(st: SessionState, ts: jnp.ndarray, vals: jnp.ndarray,
+                    valid: jnp.ndarray) -> SessionState:
+        nv = jnp.sum(valid.astype(jnp.int32))
+        idx32 = jnp.arange(B, dtype=jnp.int32)
+
+        # next gap-break at/after each lane (reverse running min of
+        # breaking lane indices; lane 0's break is the continuation test)
+        brk_at = jnp.where(
+            jnp.concatenate([jnp.asarray([False]),
+                             ts[1:] - ts[:-1] > gap]),
+            idx32, jnp.int32(B))
+        nxt_brk = jax.lax.cummin(brk_at, reverse=True)
+
+        # continuation of the newest live window?
+        top = jnp.maximum(st.n - 1, 0)
+        f_top, l_top = st.first[top], st.last[top]
+        t0 = ts[0]
+        cont = (st.n > 0) & (t0 <= l_top + gap) & (nv > 0)
+        if cap is not None:
+            cont = cont & (t0 - f_top <= cap)
+        anchor0 = jnp.where(cont, f_top, t0)
+
+        def body(k, carry):
+            cur, anchor, count, starts, ends = carry
+            active = cur < nv
+            nb = nxt_brk[jnp.clip(cur + 1, 0, B - 1)]
+            nb = jnp.where(cur + 1 < B, nb, B)
+            if cap is not None:
+                capi = jnp.searchsorted(
+                    ts, anchor + cap, side="right").astype(jnp.int32)
+            else:
+                capi = jnp.int32(B)
+            nxt = jnp.minimum(jnp.minimum(nb, capi), nv.astype(jnp.int32))
+            nxt = jnp.maximum(nxt, cur + 1)        # always progress
+            starts = starts.at[k].set(jnp.where(active, cur, B))
+            ends = ends.at[k].set(jnp.where(active, nxt, B))
+            count = count + active.astype(jnp.int32)
+            anchor = jnp.where(active, ts[jnp.clip(nxt, 0, B - 1)], anchor)
+            return (jnp.where(active, nxt, cur), anchor, count,
+                    starts, ends)
+
+        cur, _, n_seg, seg_s, seg_e = jax.lax.fori_loop(
+            0, M, body,
+            (jnp.int32(0), anchor0, jnp.int32(0),
+             jnp.full((M,), B, jnp.int32), jnp.full((M,), B, jnp.int32)))
+        unfinished = cur < nv                      # > M chain windows
+
+        seg_cnt = (seg_e - seg_s).astype(jnp.int64)
+        sc = jnp.clip(seg_s, 0, B - 1)
+        se = jnp.clip(seg_e - 1, 0, B - 1)
+        seg_first = ts[sc]
+        seg_last = ts[se]
+
+        seg_parts = []
+        for agg in aggs:
+            if agg.is_sparse:
+                col, v = agg.lift_sparse(vals)
+                lifted = jnp.full((B, agg.width), agg.identity,
+                                  jnp.float32)
+                # one column per lane: segment-combine via the same
+                # range machinery over a dense [B, width] table
+                lifted = jnp.where(
+                    (jnp.arange(agg.width)[None, :] == col[:, None])
+                    & valid[:, None], v[:, None], lifted)
+            else:
+                lifted = agg.lift_dense(vals)
+                lifted = jnp.where(valid[:, None], lifted,
+                                   jnp.asarray(agg.identity, lifted.dtype))
+            if agg.kind == "sum":
+                Pr = jnp.concatenate(
+                    [jnp.zeros((1, lifted.shape[1]), lifted.dtype),
+                     jnp.cumsum(lifted, axis=0)])
+                seg_parts.append(Pr[jnp.clip(seg_e, 0, B)]
+                                 - Pr[jnp.clip(seg_s, 0, B)])
+            else:
+                seg_parts.append(_range_combine(
+                    lifted, seg_s, jnp.maximum(seg_e - seg_s, 0),
+                    red[agg.kind], agg.identity, levels))
+
+        # -- fold segment 0 into the continued top row ---------------------
+        has0 = n_seg > 0
+        fold_top = cont & has0
+        onetop = (jnp.arange(S) == top) & fold_top
+        last = jnp.where(onetop, jnp.maximum(st.last, seg_last[0]),
+                         st.last)
+        counts = st.counts + jnp.where(onetop, seg_cnt[0], 0)
+        partials = []
+        for agg, part, sp in zip(aggs, st.partials, seg_parts):
+            upd = sp[0][None, :]
+            if agg.kind == "sum":
+                comb = part + upd
+            else:
+                comb = red[agg.kind](part, upd.astype(part.dtype))
+            partials.append(jnp.where(onetop[:, None], comb, part))
+
+        # -- append the remaining segments as new rows ---------------------
+        # The write block is anchored at min(n, S - Mb) so rows near the
+        # capacity edge stay writable (the block never hangs past S); the
+        # block-row → segment mapping shifts by the anchor displacement d,
+        # so usable capacity is NOT reduced by the block length — overflow
+        # means exactly n + k_new > S, same as the scan kernel.
+        Mb = min(M, S)
+        shift = jnp.where(cont, 1, 0)              # segment→block offset
+        bidx = jnp.arange(Mb)
+        k_new = jnp.maximum(n_seg - shift, 0)
+        start = jnp.clip(st.n, 0, S - Mb)
+        d = st.n - start                           # 0 unless n > S - Mb
+        src = jnp.clip(bidx - d + shift, 0, M - 1)
+        newrow = (bidx >= d) & (bidx - d < k_new)
+
+        def write_block(arr, rows, fill_mask):
+            curb = jax.lax.dynamic_slice(
+                arr, (start,) + (jnp.int32(0),) * (arr.ndim - 1),
+                (Mb,) + arr.shape[1:])
+            m = fill_mask if arr.ndim == 1 else fill_mask[:, None]
+            return jax.lax.dynamic_update_slice(
+                arr, jnp.where(m, rows.astype(arr.dtype), curb),
+                (start,) + (jnp.int32(0),) * (arr.ndim - 1))
+
+        first = write_block(st.first, seg_first[src], newrow)
+        last = write_block(last, seg_last[src], newrow)
+        counts = write_block(counts, seg_cnt[src], newrow)
+        partials = [write_block(p, sp[src], newrow)
+                    for p, sp in zip(partials, seg_parts)]
+
+        overflow = st.overflow | unfinished | (st.n + k_new > S)
+        return st._replace(
+            first=first, last=last, counts=counts,
+            partials=tuple(partials),
+            n=(st.n + k_new).astype(jnp.int32),
+            overflow=overflow)
+
+    return apply_chunk

@@ -1,0 +1,506 @@
+"""Fused per-interval pipeline for count-measure workloads.
+
+The r4 count cells drove the batch-at-a-time operator: a NumPy cut
+calculus per batch, an O(RC) record rank merge per late batch, and a
+device→host count probe per watermark — 0.2–1.2 M t/s against a 21 G
+headline (VERDICT r4 weak #1). This module is the count analogue of
+:class:`.pipeline.AlignedStreamPipeline`: the whole watermark interval
+(generate → rank bookkeeping → trigger → range query) is ONE XLA
+program, built from three observations:
+
+1. **The count bound is static.** The reference converts a watermark ts
+   to a count bound by probing the slice covering the watermark
+   (WindowManager.java:110-115); with a paced generator every tuple of
+   interval ``i`` has ``ts < wm_i``, so the probe always answers "the
+   whole stream" — a closed form of the interval index, and count-window
+   trigger enumeration (TumblingWindow.java:34-39 over counts,
+   ``trigger_windows(last_count, cend+1)``) compiles to a static grid
+   with a validity mask, exactly like ``build_trigger_grid``'s time grid.
+   The per-watermark device→host count probe disappears entirely.
+
+2. **Millisecond rows ARE the rank order.** Out-of-order count windows
+   aggregate ts-sorted rank ranges (the closed form of the reference's
+   ripple, SliceManager.java:64-86), with equal-ts ties in arrival order
+   (build_record_merge's stable sides). Event time is integral ms — so
+   bucketing records into one row per ms, appending within a row in
+   arrival order, IS the global rank order (rows ascending, columns in
+   append order): ties only ever happen inside a row. No sort, no
+   scatter, no searchsorted over tuples — the formulations that need
+   them measure 100–150 ms per 800 K lanes on TPU (scatters with runtime
+   indices serialize; XLA sort is ~43 ns/elem), while this layout is
+   pure block writes.
+
+3. **Stratified late lanes make appends static.** Late tuples are
+   generated pre-grouped per ms row — ``E`` per row over the lateness
+   span, the same stratified rendering of the uniform late load the
+   aligned pipeline uses (`late_fold_segment`). A row of age ``a``
+   intervals receives its append at column ``u + E·(a-1)`` — a fixed
+   column per age — so the whole late fold is ``q`` masked block writes
+   of ``[P, E]``, and every row's capacity is exactly ``u + E·q``
+   (overflow is impossible by construction).
+
+Window values are range queries over ranks: rank → (row, offset) via a
+``[W]``-row count prefix (W is a few thousand — negligible), whole rows
+from per-row maintained aggregates (prefix sums for sum-like, a log-sweep
+sparse table for min/max), boundary rows from a ``[T, cap]`` gather +
+masked fold — T triggers and cap columns are both small.
+
+Reproduced reference cadence quirks (pinned by the oracle differential
+tests in tests/test_count_pipeline.py):
+
+* **ends ≤ cend+1** — the off-by-one in WindowManager's count bound
+  triggers the top window one tuple early with a PARTIAL value (ranks
+  ``[a, N_i)``) and re-emits it complete at the next watermark.
+* **last_count jumps to the total** (simulator/operator.py:265) — count
+  windows whose trigger was deferred past a watermark are lost.
+
+Time windows in a count+time mix use the reference's ARRIVAL-cut rank
+semantics in closed form: a time edge ``e`` is cut by the first in-order
+tuple with ``ts >= e``, and the number of arrivals before that cut is a
+pure function of ``e`` under the paced generator — so a time window
+``[ws, we)`` is the rank range ``[c_cut(ws), c_cut(we))``, matching the
+engine's mix_rec slice walk (post-ripple tLast containment,
+AggregateWindowState.java:25-31). The duplicated-edge shadowing of the
+reference's batch scan (a count cut whose start equals the batch's
+min_ts shadows earlier same-start slices out of that window —
+LazyAggregateStore.java:83-92 find-from-END, reproduced by
+``build_query(mix_rec=True)``) is reproduced in closed form in the
+step's ``mstar`` calculus. One artifact at a measure-zero boundary is
+deliberately NOT reproduced (it needs an entire post-cut slice's rank
+range re-filled by late content): the hi-bound slice extension — the
+OOO-mix differential fuzz bounds the observable effect. The simulator's
+TreeSet record dedup at equal ts (StreamRecord equals-ignores-element,
+a mirrored reference artifact) is likewise not reproduced — the DEVICE
+engine is the tie-semantics oracle (tests/test_count_pipeline.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from .. import jax_config  # noqa: F401
+
+from ..core.aggregates import AggregateFunction
+from ..core.windows import (
+    SlidingWindow,
+    TumblingWindow,
+    WindowMeasure,
+)
+from .config import EngineConfig
+from .pipeline import FusedPipelineDriver, build_trigger_grid
+
+
+class CountRowState(NamedTuple):
+    rows: object             # f32 [W, cap] — per-ms rows, append order
+    row_aggs: tuple          # per agg: [W, width] maintained row combines
+    overflow: object         # bool — a query reached below the window
+
+
+class CountStreamPipeline(FusedPipelineDriver):
+    """Fused count-measure benchmark pipeline (count tumbling windows,
+    optionally mixed with time tumbling/sliding windows), in-order and
+    out-of-order. One XLA dispatch per watermark interval; no host sync
+    anywhere in the steady state."""
+
+    def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
+                 config: Optional[EngineConfig] = None,
+                 throughput: int = 5_000_000, wm_period_ms: int = 1000,
+                 max_lateness: int = 1000, seed: int = 0, gc_every: int = 32,
+                 value_scale: float = 10_000.0,
+                 out_of_order_pct: float = 0.0):
+        import jax
+        import jax.numpy as jnp
+
+        from . import core as ec
+
+        self.config = config or EngineConfig()
+        self.windows = list(windows)
+        self.aggregations = list(aggregations)
+        self.wm_period_ms = int(wm_period_ms)
+        self.max_lateness = int(max_lateness)
+        self.gc_every = gc_every
+        self.seed = seed
+        self.value_scale = float(value_scale)
+        self.out_of_order_pct = float(out_of_order_pct)
+        self.max_fixed = 0                     # no out-of-step GC
+
+        count_windows, time_windows = [], []
+        for w in self.windows:
+            if w.measure == WindowMeasure.Count:
+                if not isinstance(w, TumblingWindow):
+                    raise NotImplementedError(
+                        "count pipeline: count-tumbling windows only")
+                count_windows.append(w)
+            elif isinstance(w, (TumblingWindow, SlidingWindow)):
+                time_windows.append(w)
+            else:
+                raise NotImplementedError(
+                    f"count pipeline: {type(w).__name__} has no rank-range "
+                    "realization")
+        if not count_windows:
+            raise NotImplementedError(
+                "count pipeline: needs >= 1 count-measure window (use "
+                "AlignedStreamPipeline for pure time grids)")
+        specs = [a.device_spec() for a in self.aggregations]
+        if any(s is None or s.is_sparse for s in specs):
+            raise NotImplementedError(
+                "count pipeline: dense device aggregations only")
+
+        P = self.wm_period_ms
+        SR = throughput * P // 1000
+        u = SR // P                            # in-order tuples per ms row
+        if u < 1:
+            raise NotImplementedError(
+                "count pipeline: needs >= 1 tuple per ms (throughput >= "
+                "1000); the batch operator covers trickle rates")
+        SR = u * P
+        lateness = self.max_lateness
+        q = lateness // P                      # late reach in intervals
+        L_req = int(SR * self.out_of_order_pct)
+        if L_req and q < 1:
+            raise NotImplementedError(
+                "count pipeline: out-of-order needs max_lateness >= the "
+                "watermark period (sub-interval lateness rides the batch "
+                "operator)")
+        span = q * P                           # late rows per interval
+                                               # (<= lateness: stratified
+                                               # lates are never older
+                                               # than the contract allows)
+        E = -(-L_req // span) if L_req else 0  # late appends per row
+        L = E * span
+        q = q if E else 0
+        self.R_total = SR + L                  # steady-state (i >= q)
+        self.SR, self.L, self.E, self.q, self.u = SR, L, E, q, u
+        self.tuples_per_interval = self.R_total
+        self.n_late = L
+        cap = u + E * q                        # exact row capacity
+
+        # Row-window coverage: deepest ms any trigger can reach below the
+        # watermark — count windows reach c_max + R_total ranks
+        # (≈ that many / u ms), time windows reach t_max ms, late appends
+        # reach `lateness` ms. W is a multiple of P so an interval's row
+        # block never straddles the ring seam.
+        c_max = max(int(w.size) for w in count_windows)
+        t_max = max([int(w.size) for w in time_windows], default=0)
+        need = max(t_max, -(-(c_max + self.R_total) // u)) \
+            + (lateness if E else 0) + 2 * P
+        W = -(-need // P) * P
+        self.row_window = W
+        self.row_capacity = cap
+
+        # -- trigger layout: count windows first, then the time grid ------
+        count_layout = [(int(w.size), self.R_total // int(w.size) + 2)
+                        for w in count_windows]
+        Tc = sum(k for _, k in count_layout)
+        if time_windows:
+            make_time_triggers, Tt = build_trigger_grid(time_windows, P)
+        else:
+            make_time_triggers, Tt = None, 0
+        self.T = Tc + Tt
+        first_lw = max(0, P - lateness)
+
+        red = {"min": jnp.minimum, "max": jnp.maximum}
+        row_levels = max(1, W.bit_length())
+        n_blocks = W // P
+
+        def lift_rows(sp, block):
+            """[rows, n] values → [rows, width] combined row partials."""
+            rows_n = block.shape[0]
+            lifted = sp.lift_dense(block.reshape(-1)).reshape(
+                rows_n, block.shape[1], -1)
+            if sp.kind == "sum":
+                return jnp.sum(lifted, axis=1)
+            return (jnp.min if sp.kind == "min" else jnp.max)(lifted,
+                                                              axis=1)
+
+        # -- closed-form arrival accounting --------------------------------
+        def late_of(k):
+            """Late lanes of interval k (early intervals have fewer prior
+            rows to stratify over)."""
+            return E * P * jnp.minimum(jnp.maximum(k, 0), q) if E else 0
+
+        def arrived_before(k):
+            """Total arrivals of intervals [0, k)."""
+            k = jnp.maximum(k, 0)
+            if not E:
+                return k * SR
+            m = jnp.minimum(k, q)
+            tri = m * (m - 1) // 2
+            full = q * jnp.maximum(k - q, 0)
+            return k * SR + E * P * (tri + full)
+
+        def c_cut(e, N_i):
+            """Arrival-cut rank of time edge ``e`` (see module docstring):
+            interval k's late lanes arrive first, then the paced in-order
+            lanes ``ts = kP + j//u``. Edge 0 is the bootstrap slice."""
+            e = jnp.maximum(e, 0)
+            k = e // P
+            j = (e - k * P) * u                # first in-order lane >= e
+            cut = arrived_before(k) + late_of(k) + j
+            return jnp.where(e == 0, 0, jnp.minimum(cut, N_i))
+
+        def gen_inorder(key, i):
+            """[P, u] in-order values (ts of row r = i*P + r, u per ms —
+            the constant-rate LoadGeneratorSource)."""
+            return jax.random.uniform(
+                key, (P, u), dtype=jnp.float32) * value_scale
+
+        def gen_late(key, i, a):
+            """[P, E] late values appended this interval to the rows of
+            age ``a`` (ms [i*P - a*P, i*P - a*P + P))."""
+            ka = jax.random.fold_in(key, 0x70000000 + a)
+            return jax.random.uniform(
+                ka, (P, E), dtype=jnp.float32) * value_scale
+
+        def rowstart_slot(base_next):
+            """Ring slot of ms ``base_next - W`` .. : slot of a row with
+            ms m is m mod W; the retained window is [wm - W, wm)."""
+            return jnp.mod(base_next, W)
+
+        def step(state, key, i):
+            base = i * jnp.int64(P)
+            N_prev = arrived_before(i)
+            N_i = arrived_before(i + 1)
+            rows, row_aggs = state.rows, list(state.row_aggs)
+
+            # 1. claim this interval's P rows (aligned block in the ring)
+            slot = jnp.mod(base, W).astype(jnp.int32)
+            vals_in = gen_inorder(key, i)                    # [P, u]
+            blk = jnp.zeros((P, cap), jnp.float32)
+            blk = jax.lax.dynamic_update_slice(blk, vals_in, (0, 0))
+            rows = jax.lax.dynamic_update_slice(rows, blk,
+                                                (slot, jnp.int32(0)))
+            for ai, sp in enumerate(specs):
+                row_aggs[ai] = jax.lax.dynamic_update_slice(
+                    row_aggs[ai],
+                    lift_rows(sp, vals_in).astype(row_aggs[ai].dtype),
+                    (slot, jnp.int32(0)))
+
+            # 2. late appends: one fixed-column [P, E] block per age
+            if E:
+                for a in range(1, q + 1):
+                    tgt = base - a * P
+                    ok = tgt >= 0
+                    slot_a = jnp.mod(jnp.maximum(tgt, 0),
+                                     W).astype(jnp.int32)
+                    lv = gen_late(key, i, a)                 # [P, E]
+                    col = jnp.int32(u + E * (a - 1))
+                    cur = jax.lax.dynamic_slice(rows, (slot_a, col),
+                                                (P, E))
+                    rows = jax.lax.dynamic_update_slice(
+                        rows, jnp.where(ok, lv, cur), (slot_a, col))
+                    for ai, sp in enumerate(specs):
+                        wdt = row_aggs[ai].shape[1]
+                        cur_a = jax.lax.dynamic_slice(
+                            row_aggs[ai], (slot_a, jnp.int32(0)), (P, wdt))
+                        upd = lift_rows(sp, lv).astype(cur_a.dtype)
+                        if sp.kind == "sum":
+                            comb = cur_a + upd
+                        else:
+                            comb = red[sp.kind](cur_a, upd)
+                        row_aggs[ai] = jax.lax.dynamic_update_slice(
+                            row_aggs[ai], jnp.where(ok, comb, cur_a),
+                            (slot_a, jnp.int32(0)))
+
+            # 3. per-row counts of the retained window, in ms order —
+            # closed form: row of ms m holds u + E*clip(i - m//P, 0, q)
+            # (0 for m < 0)
+            shift = rowstart_slot(base + P)
+            ms = (base + P - W) + jnp.arange(W, dtype=jnp.int64)  # ms order
+            kk = ms // P
+            cnt_row = jnp.where(
+                ms >= 0,
+                u + (E * jnp.clip(i - kk, 0, q) if E else 0),
+                0).astype(jnp.int64)
+            prefix = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int64), jnp.cumsum(cnt_row)])
+            base_rank = N_i - prefix[-1]       # global rank of ms-order 0
+
+            # ms-order views of rows / row_aggs (one roll of small arrays)
+            def ms_order(x):
+                return jnp.roll(x, -shift, axis=0)
+
+            aggs_o = [ms_order(a) for a in row_aggs]  # [W, width]: small
+
+            # -- triggers --------------------------------------------------
+            ws_parts, we_parts, ok_parts, cw_parts = [], [], [], []
+            for (c, maxk) in count_layout:
+                last_start = (N_prev // c) * c
+                ends = last_start + c * (1 + jnp.arange(maxk,
+                                                        dtype=jnp.int64))
+                ok = ends <= N_i + 1           # the reference's cend+1
+                ws_parts.append(ends - c)
+                we_parts.append(ends)
+                ok_parts.append(ok)
+                cw_parts.append(jnp.ones((maxk,), bool))
+            if make_time_triggers is not None:
+                last_wm = jnp.where(i > 0, base, jnp.int64(first_lw))
+                t_ws, t_we, t_ok = make_time_triggers(last_wm, base + P)
+                ws_parts.append(t_ws)
+                we_parts.append(t_we)
+                ok_parts.append(t_ok)
+                cw_parts.append(jnp.zeros((Tt,), bool))
+            ws = jnp.concatenate(ws_parts)
+            we = jnp.concatenate(we_parts)
+            tmask = jnp.concatenate(ok_parts)
+            is_count = jnp.concatenate(cw_parts)
+
+            a_rank = jnp.where(is_count, ws, c_cut(ws, N_i))
+            if make_time_triggers is not None:
+                # The reference's duplicated-edge shadowing
+                # (LazyAggregateStore.java:83-92 find* walk from the END;
+                # reproduced by build_query's mix_rec scan bounds): when a
+                # count cut fires while the running max still equals the
+                # batch's min time edge (count edge m with arrival m-1 in
+                # min_ts's ms row), its slice start duplicates min_ts and
+                # the batch scan starts at the LAST duplicate — slices in
+                # ranks [c_cut(min_ts), m*) are shadowed out of the
+                # min_ts window, unless the batch's min_count bound pulls
+                # the scan start below them (the simulator seeds it with
+                # the running total, operator.py:252).
+                t_valid = ~is_count & tmask
+                min_ts = jnp.min(jnp.where(t_valid, ws, ec.I64_MAX))
+                r0 = c_cut(min_ts, N_i)
+                mstar = r0
+                for (c, _) in count_layout:
+                    cand = ((r0 + u) // c) * c
+                    mstar = jnp.maximum(mstar,
+                                        jnp.where(cand > r0, cand, r0))
+                min_count = jnp.minimum(
+                    N_i, jnp.min(jnp.where(is_count & tmask, ws,
+                                           ec.I64_MAX)))
+                shadow = (mstar > r0) & (min_count >= mstar) \
+                    & jnp.any(t_valid)
+                a_rank = jnp.where(
+                    shadow & t_valid & (ws == min_ts),
+                    jnp.maximum(a_rank, mstar), a_rank)
+            b_rank = jnp.where(is_count, jnp.minimum(we, N_i),
+                               c_cut(we, N_i))
+            b_rank = jnp.maximum(b_rank, a_rank)
+            cnt = jnp.where(tmask, b_rank - a_rank, 0)
+            bad = jnp.any(tmask & (cnt > 0) & (a_rank < base_rank))
+
+            # rank → (ms-order row, intra-row offset)
+            def locate(r):
+                rr = jnp.clip(r - base_rank, 0, prefix[-1])
+                row = jnp.clip(
+                    jnp.searchsorted(prefix, rr, side="right") - 1,
+                    0, W - 1)
+                return row, (rr - prefix[row]).astype(jnp.int32)
+
+            row_a, off_a = locate(a_rank)
+            row_b, off_b = locate(b_rank)
+            # boundary rows gathered straight from the ring (the [W, cap]
+            # tuple store is never rolled — only its [T]-sized gathers)
+            ga = rows[jnp.mod(shift + row_a, W)]         # [T, cap]
+            gb = rows[jnp.mod(shift + row_b, W)]
+            col = jnp.arange(cap, dtype=jnp.int32)[None, :]
+            n_a = cnt_row[row_a].astype(jnp.int32)
+
+            results = []
+            for sp, agg_o in zip(specs, aggs_o):
+                wdt = agg_o.shape[1]
+                ident = jnp.asarray(sp.identity, agg_o.dtype)
+
+                def boundary(g, keep):        # [T, cap] masked row fold
+                    lifted = sp.lift_dense(g.reshape(-1)).reshape(
+                        g.shape[0], cap, -1)
+                    lifted = jnp.where(keep[:, :, None], lifted, ident)
+                    if sp.kind == "sum":
+                        return jnp.sum(lifted, axis=1)
+                    return (jnp.min if sp.kind == "min" else jnp.max)(
+                        lifted, axis=1)
+
+                if sp.kind == "sum":
+                    Pr = jnp.concatenate(
+                        [jnp.zeros((1, wdt), agg_o.dtype),
+                         jnp.cumsum(agg_o, axis=0)])
+                    # S(x) = full rows below row(x) + head of row(x)
+                    Sa = Pr[row_a] + boundary(ga, col < off_a[:, None])
+                    Sb = Pr[row_b] + boundary(gb, col < off_b[:, None])
+                    res = Sb - Sa
+                else:
+                    # tail of row(a) ∪ full rows (row_a, row_b) ∪ head of
+                    # row(b); same-row ranges use one masked fold
+                    same = row_a == row_b
+                    seg = boundary(
+                        ga, (col >= off_a[:, None])
+                        & jnp.where(same[:, None], col < off_b[:, None],
+                                    col < n_a[:, None]))
+                    mid = ec._range_combine(
+                        agg_o, row_a + 1,
+                        jnp.maximum(row_b - row_a - 1, 0),
+                        red[sp.kind], sp.identity, row_levels)
+                    head = boundary(gb, col < jnp.where(same, 0,
+                                                        off_b)[:, None])
+                    res = red[sp.kind](seg, red[sp.kind](mid, head))
+                results.append(
+                    jnp.where((tmask & (cnt > 0))[:, None], res, ident))
+
+            new_state = CountRowState(
+                rows=rows, row_aggs=tuple(row_aggs),
+                overflow=state.overflow | bad)
+            return new_state, (ws, we, cnt, tuple(results))
+
+        self._step = jax.jit(step, donate_argnums=0)
+        self._init = lambda: CountRowState(
+            rows=jnp.zeros((W, cap), jnp.float32),
+            row_aggs=tuple(
+                jnp.full((W, sp.width), sp.identity,
+                         jnp.dtype(self.config.partial_dtype))
+                for sp in specs),
+            overflow=jnp.asarray(False))
+        self._root = None
+        self.state = None
+        self._interval = 0
+
+    # -- driver hooks ------------------------------------------------------
+    def _init_pipeline_state(self) -> None:
+        self.state = self._init()
+
+    def _sync_anchor(self):
+        return self.state.overflow
+
+    def check_overflow(self) -> None:
+        import jax
+
+        if bool(jax.device_get(self.state.overflow)):
+            raise RuntimeError(
+                "count row-window underrun: a trigger reached below the "
+                "retained per-ms rows — widen the retention model "
+                "(windows larger than expected?)")
+
+    # -- test/replay face --------------------------------------------------
+    def materialize_interval(self, i: int):
+        """Regenerate interval ``i``'s tuples on host, in ARRIVAL order
+        (late lanes first — they arrive at the start of the interval, in
+        ms order, ``E`` per row over the lateness span — then the paced
+        in-order lanes): ``(vals f32, ts i64)``. Bit-identical to what
+        the fused step folds in (same fold_in keying and draws)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._root is None:
+            self._root = jax.random.PRNGKey(self.seed)
+        P, u, E, q = self.wm_period_ms, self.u, self.E, self.q
+        key = self._interval_key(i)
+        base = np.int64(i) * P
+        vin = np.asarray(jax.random.uniform(
+            key, (P, u), dtype=jnp.float32)) * self.value_scale
+        ts_in = base + np.repeat(np.arange(P, dtype=np.int64), u)
+        parts_v, parts_t = [], []
+        if E:
+            for a in range(min(i, q), 0, -1):  # oldest rows first (ms asc)
+                ka = jax.random.fold_in(key, 0x70000000 + a)
+                lv = np.asarray(jax.random.uniform(
+                    ka, (P, E), dtype=jnp.float32)) * self.value_scale
+                lo = int(base) - a * P
+                parts_v.append(lv.reshape(-1))
+                parts_t.append(lo + np.repeat(np.arange(P, dtype=np.int64),
+                                              E))
+        parts_v.append(vin.reshape(-1))
+        parts_t.append(ts_in)
+        return (np.concatenate(parts_v).astype(np.float32),
+                np.concatenate(parts_t))

@@ -180,6 +180,24 @@ def _context_kernels(aggs, spec, capacity: int, emit_cap: int):
     return hit
 
 
+def _context_chunk_kernel(aggs, spec, capacity: int, chunk_len: int):
+    """Jitted vectorized in-order chain kernel (one per padded chunk
+    length), cached by the spec's token — see
+    engine/context.py::build_context_chunk."""
+    import jax
+    from . import context as ectx
+
+    key = ("context-chunk", spec.token(), tuple(a.token for a in aggs),
+           capacity, chunk_len)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        hit = jax.jit(
+            ectx.build_context_chunk(aggs, spec, capacity, chunk_len),
+            donate_argnums=0)
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
 def _dense_kernel(spec, capacity: int, runs: int):
     """Jitted scatter-free in-order ingest (build_ingest_dense), cached."""
     import jax
@@ -468,6 +486,9 @@ class TpuWindowOperator(WindowOperator):
                      for sp in specs]
             self._ctx_applies = tuple(p[0] for p in pairs)
             self._ctx_sweeps = tuple(p[1] for p in pairs)
+            self._ctx_specs = tuple(specs)
+            self._ctx_chain = tuple(
+                sp.inorder_chain_params() is not None for sp in specs)
             # clear_delay participates in the GC bound (mirroring
             # Window.clear_delay / WindowManager.java:121-127): retention
             # beyond what orphan_reach already grants is applied as a
@@ -565,9 +586,15 @@ class TpuWindowOperator(WindowOperator):
             # boundaries (engine/sessions.py module docstring)
             self._feed_sessions(batch_v[:take], batch_t[:take], met_pre)
         if self._ctx_states and take:
-            # generic context windows replay the whole batch in arrival
-            # order through their scan kernels (engine/context.py)
-            self._feed_contexts(batch_v[:take], batch_t[:take])
+            # generic context windows replay the batch in arrival order:
+            # sorted in-order batches take the vectorized chunk kernel
+            # when the spec certifies the greedy chain
+            # (DeviceContextSpec.inorder_chain_params); everything else
+            # goes through the per-tuple scan (engine/context.py)
+            bt = batch_t[:take]
+            inorder = bool((bt[:-1] <= bt[1:]).all()) \
+                and (met_pre is None or int(bt[0]) >= met_pre)
+            self._feed_contexts(batch_v[:take], bt, inorder=inorder)
 
         if mixed and take:
             # arrival-order cut calculus: maintains the open-slice mirror on
@@ -827,13 +854,17 @@ class TpuWindowOperator(WindowOperator):
                     self._session_states[i] = kern(
                         self._session_states[i], pt, pv, m)
 
-    def _feed_contexts(self, vals: np.ndarray, tss: np.ndarray) -> None:
+    def _feed_contexts(self, vals: np.ndarray, tss: np.ndarray,
+                       inorder: bool = False) -> None:
         """Apply this batch to every generic context window's active
-        arrays, in arrival order, one fused scan dispatch per chunk. The
-        tail chunk pads to a small power-of-two bucket, NOT the full batch
-        size — the scan is sequential per lane, so a trickle flush at
-        batch_size-length would pay thousands of wasted device steps (the
-        kernels retrace per padded length; bucketing bounds the variants)."""
+        arrays, in arrival order, one fused device dispatch per chunk:
+        the vectorized chain kernel for sorted in-order chunks when the
+        spec certifies it (inorder_chain_params — O(B) total work), the
+        per-tuple scan otherwise. The tail chunk pads to a small
+        power-of-two bucket, NOT the full batch size — the scan is
+        sequential per lane, so a trickle flush at batch_size-length
+        would pay thousands of wasted device steps (the kernels retrace
+        per padded length; bucketing bounds the variants)."""
         B = self.config.batch_size
         for lo in range(0, tss.size, B):
             ct, cv = tss[lo:lo + B], vals[lo:lo + B]
@@ -845,6 +876,10 @@ class TpuWindowOperator(WindowOperator):
             m = np.zeros((L,), bool)
             m[:k] = True
             for i, kern in enumerate(self._ctx_applies):
+                if inorder and self._ctx_chain[i]:
+                    kern = _context_chunk_kernel(
+                        self._spec.aggs, self._ctx_specs[i],
+                        self.config.capacity, L)
                 self._ctx_states[i] = kern(self._ctx_states[i], pt, pv, m)
 
     def _pick_inorder_kernel(self, ts_lo: int, ts_hi: int):
@@ -889,10 +924,37 @@ class TpuWindowOperator(WindowOperator):
             m = np.zeros((B,), bool)
             m[:n] = True
             valid = jax.device_put(m)
-        if self._session_states or self._ctx_states:
+        if self._session_states:
             raise UnsupportedOnDevice(
-                "device-resident batches with session/context windows: use "
-                "process_elements (host-fed) for context workloads")
+                "device-resident batches with session windows: use "
+                "process_elements (host-fed) for session workloads")
+        if self._ctx_states:
+            # context windows accept device-resident batches when every
+            # spec certifies the in-order chain (the chunk kernel needs
+            # no host-side inspection) and the batch is in-order
+            if not all(self._ctx_chain):
+                raise UnsupportedOnDevice(
+                    "device-resident batches with scan-only context "
+                    "windows: use process_elements (host-fed)")
+            if self._host_met is not None and ts_min < self._host_met:
+                raise UnsupportedOnDevice(
+                    "out-of-order device batches with context windows "
+                    "need the host operator")
+            for i in range(len(self._ctx_states)):
+                kern = _context_chunk_kernel(
+                    self._spec.aggs, self._ctx_specs[i],
+                    self.config.capacity, B)
+                self._ctx_states[i] = kern(self._ctx_states[i], ts, vals,
+                                           valid)
+            if not self._has_grid:
+                self._host_met = ts_max if self._host_met is None \
+                    else max(self._host_met, ts_max)
+                self._host_min_ts = ts_min if self._host_min_ts is None \
+                    else min(self._host_min_ts, ts_min)
+                if self._host_first_ts is None:
+                    self._host_first_ts = ts_min
+                self._host_count += n
+                return
         if self._has_count and self._grid_spec.has_time_grid:
             # the host cut mirror can't see device-resident timestamps; a
             # later late host batch must fall back (see _launch_batch)

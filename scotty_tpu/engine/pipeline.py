@@ -310,7 +310,6 @@ class StreamPipeline(FusedPipelineDriver):
         for w in self.windows:
             if w.measure != WindowMeasure.Time:
                 raise NotImplementedError("pipeline: time-measure only")
-            max_fixed = max(max_fixed, w.clear_delay())
             if isinstance(w, TumblingWindow):
                 periods.append(int(w.size))
             elif isinstance(w, SlidingWindow):
@@ -319,6 +318,7 @@ class StreamPipeline(FusedPipelineDriver):
                 bands.append((int(w.start), int(w.size)))
             else:
                 raise NotImplementedError(f"pipeline: {type(w).__name__}")
+            max_fixed = max(max_fixed, w.clear_delay())
         spec = ec.EngineSpec(
             periods=ec.collapse_periods(periods),
             bands=tuple(sorted(set(bands))),
@@ -452,6 +452,10 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         on a slice boundary."""
         members = [wm_period_ms]
         for w in windows:
+            if not isinstance(w, (TumblingWindow, SlidingWindow,
+                                  FixedBandWindow)):
+                raise NotImplementedError(
+                    f"no slice grid for {type(w).__name__}")
             members.append(int(w.size))
             if isinstance(w, SlidingWindow):
                 members.append(int(w.slide))

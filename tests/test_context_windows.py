@@ -52,44 +52,46 @@ class _ExactCapped:
         self.orphans = []    # (pos, value)
 
     def add(self, v, t):
+        # priority calculus (see CappedContext.update_context): inside >
+        # first fitting extension > cap-declined insert; exact-gap-only
+        # reach orphans
         g, cap, s = self.gap, self.cap, self.s
-        hit = None
+        exact = declined = False
+        fit_i = -1
         for i, (f, l, vs) in enumerate(s):
+            if f <= t <= l:
+                vs.append(v)
+                return                  # (1) inside
             if f - g <= t <= l + g:
-                hit = i
-                break
-            if f - g > t:
-                break
-        if hit is None:
-            self._insert(t, t, [v])
-            return
-        f, l, vs = s[hit]
-        if f <= t <= l:
-            vs.append(v)
-            return
-        if t < f:                       # start-extension
-            if l - t > cap:
-                self._insert(t, t, [v])
+                if t == f - g:
+                    exact = True
+                elif fit_i < 0 and ((f > t and l - t <= cap)
+                                    or (l < t and t - f <= cap)):
+                    fit_i = i
+                else:
+                    declined = True
+        if fit_i >= 0:                  # (2) fitting extension
+            hit = fit_i
+            f, l, vs = s[hit]
+            if t < f:                   # start-extension
+                s[hit][0] = t
+                vs.append(v)
+                if hit > 0 and s[hit - 1][1] + g >= t \
+                        and l - s[hit - 1][0] <= cap:
+                    pf, pl, pvs = s.pop(hit - 1)
+                    s[hit - 1][0] = pf
+                    s[hit - 1][2] = pvs + s[hit - 1][2]
                 return
-            s[hit][0] = t
-            vs.append(v)
-            if hit > 0 and s[hit - 1][1] + g >= t \
-                    and l - s[hit - 1][0] <= cap:
-                pf, pl, pvs = s.pop(hit - 1)
-                s[hit - 1][0] = pf
-                s[hit - 1][2] = pvs + s[hit - 1][2]
-            return
-        if t <= l + g:                  # end-extension
-            if t - f > cap:
-                self._insert(t, t, [v])
-                return
-            s[hit][1] = t
+            s[hit][1] = t               # end-extension
             vs.append(v)
             if hit + 1 < len(s) and t + g >= s[hit + 1][0] \
                     and s[hit + 1][1] - f <= cap:
                 nf, nl, nvs = s.pop(hit + 1)
                 s[hit][1] = nl
                 s[hit][2] = s[hit][2] + nvs
+            return
+        if declined or not exact:       # (3) declined / out of reach
+            self._insert(t, t, [v])
             return
         self.orphans.append((t, v))     # exact-gap fall-through
 
@@ -295,3 +297,107 @@ def test_ctx_clear_delay_extends_orphan_retention():
     op.process_element(1.0, 5)
     op.process_watermark(4)            # force build
     assert op._ctx_gc_slack == (30,)
+
+
+def test_capped_continuous_stream_bounded_active_rows():
+    """The bench shape that exposed the first-reach degeneracy: a dense
+    paced stream past the cap must keep splitting into successive capped
+    sessions (bounded active rows), not insert one point window per
+    tuple. Pinned against the exact oracle."""
+    import jax
+
+    gap, cap = 10, 40
+    rng = np.random.default_rng(7)
+    eng = TpuWindowOperator(config=SMALL)
+    eng.add_window_assigner(CappedSessionWindow(Time, gap, cap))
+    eng.add_aggregation(SumAggregation())
+    eng.set_max_lateness(100)
+    oracle = _ExactCapped(gap, cap)
+    got, exp = [], []
+    for i in range(6):
+        ts = np.sort(rng.integers(i * 100, (i + 1) * 100,
+                                  size=300)).astype(np.int64)
+        vals = rng.random(300).astype(np.float32)
+        for v, t in zip(vals, ts):
+            oracle.add(float(v), int(t))
+        eng.process_elements(vals.tolist(), ts.tolist())
+        got += [(w.start, w.end, round(float(w.agg_values[0]), 2))
+                for w in eng.process_watermark((i + 1) * 100)]
+        exp += [(ws, we, round(sum(vs), 2)) for ws, we, vs in
+                oracle.sweep((i + 1) * 100)]
+        n = int(jax.device_get(eng._ctx_states[0].n))
+        assert n <= 8, f"active rows exploded: {n}"
+    eng.check_overflow()
+    assert len(got) == len(exp) and len(got) >= 8
+    for (gs, ge, gv), (es, ee, ev) in zip(sorted(got), sorted(exp)):
+        assert (gs, ge) == (es, ee)
+        assert abs(gv - ev) <= 1e-2 * max(1.0, abs(ev))
+
+
+def test_chunk_kernel_equals_scan_kernel():
+    """The certified in-order chain kernel must produce bit-equal active
+    arrays to the per-tuple scan on the same sorted chunk (the
+    inorder_chain_params contract)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scotty_tpu.engine import context as ectx
+    from scotty_tpu.engine import sessions as es
+
+    gap, cap = 12, 50
+    spec = ectx.CappedSessionDecider(gap, cap)
+    aggs = (SumAggregation().device_spec(), MaxAggregation().device_spec())
+    S, B = 128, 256
+    scan_k = ectx.build_context_apply(aggs, spec, S)
+    chunk_k = ectx.build_context_chunk(aggs, spec, S, B)
+
+    rng = np.random.default_rng(21)
+    # clustered sorted stream: bursts + gaps so the chain breaks on both
+    # the gap rule and the span cap
+    ts = np.cumsum(rng.choice([1, 2, 3, 30], size=B,
+                              p=[0.5, 0.3, 0.15, 0.05])).astype(np.int64)
+    vals = rng.random(B).astype(np.float32)
+    m = np.ones((B,), bool)
+
+    s0 = es.init_session_state(aggs, S, orphan_capacity=64)
+    a = scan_k(s0, jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(m))
+    s1 = es.init_session_state(aggs, S, orphan_capacity=64)
+    b = chunk_k(s1, jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(m))
+    an, bn = int(a.n), int(b.n)
+    assert an == bn and an > 3
+    np.testing.assert_array_equal(np.asarray(a.first[:an]),
+                                  np.asarray(b.first[:bn]))
+    np.testing.assert_array_equal(np.asarray(a.last[:an]),
+                                  np.asarray(b.last[:bn]))
+    np.testing.assert_array_equal(np.asarray(a.counts[:an]),
+                                  np.asarray(b.counts[:bn]))
+    for pa, pb in zip(a.partials, b.partials):
+        # sum partials: prefix-diff vs sequential adds — f32
+        # accumulation-order noise only
+        np.testing.assert_allclose(np.asarray(pa[:an]),
+                                   np.asarray(pb[:bn]), rtol=1e-4,
+                                   atol=1e-4)
+    assert not bool(a.overflow) and not bool(b.overflow)
+
+
+def test_chunk_kernel_small_capacity_no_spurious_overflow():
+    """r5 review: the chunk kernel's append block must not shrink usable
+    capacity (capacity < max_segments ran fine on the scan kernel and
+    must keep running on the chunk kernel)."""
+    import jax
+
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=48, batch_size=64, annex_capacity=64, min_trigger_pad=32))
+    op.add_window_assigner(CappedSessionWindow(Time, 10, 40))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(100)
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        ts = np.sort(rng.integers(i * 100, (i + 1) * 100,
+                                  size=64)).astype(np.int64)
+        op.process_elements(rng.random(64).astype(np.float32).tolist(),
+                            ts.tolist())
+        op.process_watermark((i + 1) * 100)
+    op.check_overflow()
+    assert int(jax.device_get(op._ctx_states[0].n)) <= 4

@@ -1,0 +1,229 @@
+"""Differential tests for the fused count-measure pipeline.
+
+Oracles, per semantics domain:
+
+* **In-order** (count-only and count+time mixes): the host simulator —
+  the reference calculus replayed class-for-class
+  (simulator/operator.py). Exact match expected.
+* **Out-of-order**: the device engine (`TpuWindowOperator`) is the
+  oracle. The simulator mirrors the reference's TreeSet record-set
+  dedup at EQUAL timestamps (StreamRecord equals-ignores-element,
+  simulator/slices.py:18-21 — a reproduced reference artifact), which
+  the engine's record buffer deliberately does not reproduce (every
+  record is kept; PARITY.md). The pipeline must agree with the ENGINE;
+  where the fuzz stream has all-distinct ts the simulator agrees too
+  and is asserted as a third face.
+
+Cadence quirks pinned here (reference behavior, see the module
+docstring of engine/count_pipeline.py): the ends<=cend+1 early-partial
+emission, its complete re-emission next watermark, and the lost-window
+behavior of last_count jumping to the running total.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from scotty_tpu import (
+    MaxAggregation,
+    MeanAggregation,
+    SlicingWindowOperator,
+    SumAggregation,
+    TumblingWindow,
+    SlidingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+from scotty_tpu.engine.count_pipeline import CountStreamPipeline
+
+Count, Time = WindowMeasure.Count, WindowMeasure.Time
+
+SMALL = EngineConfig(capacity=1 << 12, batch_size=64, annex_capacity=256,
+                     min_trigger_pad=32, record_capacity=1 << 12)
+
+
+def lowered(agg, part_row, cnt):
+    """Host-lower one window's partial row the way the bench edge does."""
+    sp = agg.device_spec()
+    return float(np.asarray(
+        sp.lower(np.asarray(part_row)[None, :], np.asarray([cnt]))[0]))
+
+
+def pipeline_windows(p, fetched, agg, n_iv):
+    """[(start, end, value)] per interval from the fused step outputs."""
+    out = []
+    for i in range(n_iv):
+        ws, we, cnt, res = fetched[i]
+        rows = [(int(ws[j]), int(we[j]),
+                 lowered(agg, res[0][j], int(cnt[j])))
+                for j in range(len(ws)) if cnt[j] > 0]
+        out.append(sorted(rows))
+    return out
+
+
+def oracle_windows(make_op, p, agg, n_iv):
+    """Replay the pipeline's materialized stream through an operator."""
+    op = make_op()
+    out = []
+    for i in range(n_iv):
+        vs, ts = p.materialize_interval(i)
+        for v, t in zip(vs, ts):
+            op.process_element(float(v), int(t))
+        rows = [(w.start, w.end, float(w.agg_values[0]))
+                for w in op.process_watermark((i + 1) * p.wm_period_ms)]
+        out.append(sorted(rows))
+    return out
+
+
+def assert_same(ref, got, rtol=3e-4):
+    assert len(ref) == len(got)
+    for i, (r_rows, g_rows) in enumerate(zip(ref, got)):
+        assert [r[:2] for r in r_rows] == [g[:2] for g in g_rows], \
+            f"interval {i} bounds: {r_rows} vs {g_rows}"
+        for r, g in zip(r_rows, g_rows):
+            assert abs(r[2] - g[2]) <= rtol * max(1.0, abs(r[2])), \
+                f"interval {i} window {r[:2]}: {r[2]} vs {g[2]}"
+
+
+def run_pipeline(windows, agg, throughput, ooo, n_iv, P=100, lateness=100,
+                 seed=3):
+    p = CountStreamPipeline(windows, [agg], throughput=throughput,
+                            wm_period_ms=P, max_lateness=lateness,
+                            seed=seed, out_of_order_pct=ooo)
+    fetched = jax.device_get(p.run(n_iv))
+    p.check_overflow()
+    return p, pipeline_windows(p, fetched, agg, n_iv)
+
+
+def oracle_wm(p, i):
+    return (i + 1) * p.wm_period_ms
+
+
+def make_sim(windows, agg, lateness):
+    def build():
+        op = SlicingWindowOperator()
+        for w in windows:
+            op.add_window_assigner(w)
+        op.add_aggregation(agg)
+        op.set_max_lateness(lateness)
+        return op
+    return build
+
+
+def make_dev(windows, agg, lateness):
+    def build():
+        op = TpuWindowOperator(config=SMALL)
+        for w in windows:
+            op.add_window_assigner(w)
+        op.add_aggregation(agg)
+        op.set_max_lateness(lateness)
+        return op
+    return build
+
+
+@pytest.mark.parametrize("agg", [SumAggregation(), MaxAggregation(),
+                                 MeanAggregation()])
+def test_count_only_inorder_vs_simulator(agg):
+    W = [TumblingWindow(Count, 7)]
+    p, got = run_pipeline(W, agg, 2000, 0.0, 6)
+    assert_same(oracle_windows(make_sim(W, agg, 100), p, agg, 6), got)
+
+
+def test_count_mix_inorder_vs_simulator():
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 7), TumblingWindow(Time, 50)]
+    p, got = run_pipeline(W, agg, 2000, 0.0, 6)
+    assert_same(oracle_windows(make_sim(W, agg, 100), p, agg, 6), got)
+
+
+def test_count_multi_mix_inorder_vs_simulator():
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 13), TumblingWindow(Count, 5),
+         SlidingWindow(Time, 60, 20)]
+    p, got = run_pipeline(W, agg, 3000, 0.0, 8)
+    assert_same(oracle_windows(make_sim(W, agg, 100), p, agg, 8), got)
+
+
+@pytest.mark.parametrize("agg", [SumAggregation(), MaxAggregation()])
+def test_count_only_ooo_vs_engine(agg):
+    W = [TumblingWindow(Count, 7)]
+    p, got = run_pipeline(W, agg, 2000, 0.3, 5)
+    assert_same(oracle_windows(make_dev(W, agg, 100), p, agg, 5), got)
+
+
+def test_count_mix_ooo_vs_engine():
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 7), TumblingWindow(Time, 50)]
+    p, got = run_pipeline(W, agg, 2000, 0.3, 5)
+    assert_same(oracle_windows(make_dev(W, agg, 100), p, agg, 5), got)
+
+
+def test_count_ooo_multi_interval_lateness_vs_engine():
+    """Lateness spanning multiple intervals (q = 2): late appends reach
+    two interval generations back; the engine's record merge is the
+    rank-semantics oracle."""
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 11)]
+    p, got = run_pipeline(W, agg, 2000, 0.2, 6, lateness=200)
+    assert_same(oracle_windows(make_dev(W, agg, 200), p, agg, 6), got)
+
+
+def test_count_inorder_three_way():
+    """In-order streams have no ripple and (at u=1) no equal-ts ties, so
+    the simulator, the device engine, and the fused pipeline must agree
+    exactly."""
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 5)]
+    p = CountStreamPipeline(W, [agg], throughput=1000, wm_period_ms=40,
+                            max_lateness=40, seed=0)
+    n_iv = 6
+    fetched = jax.device_get(p.run(n_iv))
+    p.check_overflow()
+    got = pipeline_windows(p, fetched, agg, n_iv)
+    assert_same(oracle_windows(make_sim(W, agg, 40), p, agg, n_iv), got)
+    assert_same(oracle_windows(make_dev(W, agg, 40), p, agg, n_iv), got)
+
+
+def test_early_partial_and_reemission_quirk():
+    """ends <= cend+1: with R_total=13 and c=7, interval 0 ends at
+    N=13 so window [7,14) (end == N+1) emits one tuple early with a
+    PARTIAL value (ranks [7,13)), and interval 1 re-emits it complete —
+    the reference's off-by-one, reproduced."""
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 7)]
+    p, got = run_pipeline(W, agg, 1000, 0.0, 2, P=13, lateness=13)
+    iv0 = dict((tuple(r[:2]), r[2]) for r in got[0])
+    iv1 = dict((tuple(r[:2]), r[2]) for r in got[1])
+    assert (7, 14) in iv0 and (7, 14) in iv1          # partial then full
+    vs0, _ = p.materialize_interval(0)
+    vs1, _ = p.materialize_interval(1)
+    allv = np.concatenate([vs0, vs1])
+    np.testing.assert_allclose(iv0[(7, 14)], float(np.sum(vs0[7:13])),
+                               rtol=1e-5)
+    np.testing.assert_allclose(iv1[(7, 14)], float(np.sum(allv[7:14])),
+                               rtol=1e-5)
+
+
+def test_rejects_unsupported_specs():
+    from scotty_tpu import SessionWindow
+    from scotty_tpu.core.aggregates import QuantileAggregation
+
+    with pytest.raises(NotImplementedError):
+        CountStreamPipeline([TumblingWindow(Time, 100)], [SumAggregation()])
+    with pytest.raises(NotImplementedError):
+        CountStreamPipeline([SessionWindow(Time, 10)], [SumAggregation()])
+    with pytest.raises(NotImplementedError):
+        CountStreamPipeline([TumblingWindow(Count, 10)],
+                            [QuantileAggregation(0.5)])
+
+
+def test_no_overflow_on_contract_streams():
+    """The row-window retention model covers every in-contract trigger:
+    the overflow flag stays clear over a multi-interval run."""
+    p = CountStreamPipeline([TumblingWindow(Count, 7)], [SumAggregation()],
+                            throughput=2000, wm_period_ms=100,
+                            max_lateness=100, seed=0, out_of_order_pct=0.2)
+    p.reset()
+    p.run(5, collect=False)
+    assert not bool(jax.device_get(p.state.overflow))
