@@ -563,6 +563,8 @@ class CappedSessionWindow(ForwardContextAware):
             fit_i = -1
             for k in range(n):
                 s = self.get_window(k)
+                if s.start - gap > position:
+                    break           # sorted by start: nothing later reaches
                 if s.start <= position <= s.end:
                     return s                        # (1) inside
                 if s.start - gap <= position <= s.end + gap:

@@ -170,3 +170,145 @@ def save_host_operator(op, path: str) -> None:
 def restore_host_operator(path: str):
     with open(os.path.join(path, "host_operator.pkl"), "rb") as f:
         return pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Keyed operator + fused pipelines (VERDICT r4 item 9: the modes every
+# benchmark actually runs)
+# ---------------------------------------------------------------------------
+
+
+def save_keyed_operator(op, path: str) -> None:
+    """Snapshot a KeyedTpuWindowOperator: the [K, ...] slice-buffer batch
+    plus its host clock mirrors. Windows/aggregations/config/mesh are
+    re-registered on restore by the caller (code, not data)."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    if not op._built:
+        raise ValueError("operator not built yet; nothing to checkpoint")
+    if op._n_pending:
+        raise ValueError("flush pending rounds (process a watermark) "
+                         "before checkpointing")
+    leaves = jax.tree.flatten(op._state)[0]
+    np.savez(os.path.join(path, "keyed_state.npz"),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({
+            "kind": "keyed", "n_keys": op.n_keys,
+            "last_watermark": op._last_watermark,
+            "max_lateness": op.max_lateness,
+            "max_fixed_window_size": op.max_fixed_window_size,
+            "host_met": op._host_met,
+            "n_leaves": len(leaves),
+        }, f)
+
+
+def restore_keyed_operator(op, path: str) -> None:
+    """Restore into a freshly-configured KeyedTpuWindowOperator (same
+    windows/aggregations/config/n_keys as at save time)."""
+    import jax
+
+    if not op._built:
+        op._build()
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != "keyed" or meta["n_keys"] != op.n_keys:
+        raise ValueError("snapshot is not a matching keyed checkpoint")
+    data = np.load(os.path.join(path, "keyed_state.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    treedef = jax.tree.structure(op._state)
+    template = jax.tree.flatten(op._state)[0]
+    if len(leaves) != len(template) or any(
+            np.asarray(l).shape != np.asarray(t).shape
+            for l, t in zip(leaves, template)):
+        raise ValueError(
+            "checkpoint shape mismatch: construct the keyed operator "
+            "with the same windows/aggregations/config as saved")
+    cast = [np.asarray(l, dtype=np.asarray(t).dtype)
+            for l, t in zip(leaves, template)]
+    op._state = jax.tree.unflatten(treedef, cast)
+    if op.mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import jax as _jax
+        op._state = _jax.device_put(
+            op._state, NamedSharding(op.mesh, P(op.axis)))
+    op._last_watermark = meta["last_watermark"]
+    op.max_lateness = meta["max_lateness"]
+    op.max_fixed_window_size = meta["max_fixed_window_size"]
+    op._host_met = meta["host_met"]
+
+
+def _pipeline_tree(p) -> dict:
+    """A fused pipeline's complete device state as one pytree: the main
+    state (slice buffer / count ring / grid state) plus, for the session
+    pipeline, the per-window active-session arrays."""
+    return {"state": getattr(p, "state", None),
+            "sessions": list(getattr(p, "sess_states", None) or [])}
+
+
+def save_pipeline(p, path: str) -> None:
+    """Snapshot a fused pipeline (Aligned/Stream/Count/Session/Keyed-
+    Aligned): device state + interval counter + RNG root. The stream is a
+    pure function of (seed, interval), so a restored pipeline continues
+    the EXACT tuple stream and emission sequence of the saved one —
+    kill-and-resume mid-sweep reproduces identical window results
+    (tests/test_checkpoint.py)."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    if getattr(p, "_root", None) is None or not getattr(
+            p, "_pipeline_ready", False):
+        raise ValueError("pipeline not started; nothing to checkpoint")
+    tree = _pipeline_tree(p)
+    leaves = jax.tree.flatten(tree)[0]
+    np.savez(os.path.join(path, "pipeline_state.npz"),
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({
+            "kind": "pipeline", "cls": type(p).__name__,
+            "interval": int(p._interval), "seed": int(p.seed),
+            "root": np.asarray(p._root).tolist(),
+            "n_leaves": len(leaves),
+        }, f)
+
+
+def restore_pipeline(p, path: str) -> None:
+    """Restore into a freshly-CONSTRUCTED pipeline of the same class and
+    constructor arguments (windows/aggs/throughput/seed/...)."""
+    import jax
+    import jax.numpy as jnp
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != "pipeline" or meta["cls"] != type(p).__name__:
+        raise ValueError(
+            f"snapshot is a {meta.get('cls')} checkpoint, not "
+            f"{type(p).__name__}")
+    if int(p.seed) != meta["seed"]:
+        raise ValueError("seed mismatch: the restored stream would differ")
+    p.reset()                          # allocate state at current shapes
+    tree = _pipeline_tree(p)
+    data = np.load(os.path.join(path, "pipeline_state.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    template = jax.tree.flatten(tree)[0]
+    if len(leaves) != len(template):
+        raise ValueError("checkpoint shape mismatch: construct the "
+                         "pipeline with the same configuration as saved")
+    for i, (l, t) in enumerate(zip(leaves, template)):
+        if np.asarray(l).shape != np.asarray(t).shape:
+            raise ValueError(
+                f"checkpoint leaf {i} has shape {np.asarray(l).shape}, "
+                f"this pipeline expects {np.asarray(t).shape} — construct "
+                "the pipeline with the same configuration as saved "
+                "(throughput/capacity/windows all shape the state)")
+    treedef = jax.tree.structure(tree)
+    cast = [np.asarray(l, dtype=np.asarray(t).dtype)
+            for l, t in zip(leaves, template)]
+    restored = jax.tree.unflatten(treedef, cast)
+    p.state = restored["state"]
+    if restored["sessions"]:
+        p.sess_states = restored["sessions"]
+    p._interval = meta["interval"]
+    p._root = jnp.asarray(np.asarray(meta["root"], np.uint32))

@@ -1,0 +1,173 @@
+"""Kill-and-resume for the benchmark execution modes (VERDICT r4 item 9):
+the keyed operator and the fused pipelines — the modes every benchmark
+actually runs — snapshot mid-sweep and reproduce IDENTICAL window
+results after restore (the stream is a pure function of (seed,
+interval), so a restored pipeline continues the exact tuple sequence)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from scotty_tpu import (
+    HyperLogLogAggregation,
+    SessionWindow,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.utils.checkpoint import (
+    restore_keyed_operator,
+    restore_pipeline,
+    save_keyed_operator,
+    save_pipeline,
+)
+
+Time, Count = WindowMeasure.Time, WindowMeasure.Count
+CFG = EngineConfig(capacity=1 << 12, batch_size=256, annex_capacity=256,
+                   min_trigger_pad=32)
+
+
+def fetch(outs):
+    return jax.device_get(outs)
+
+
+def rows_of(fetched):
+    out = []
+    for (ws, we, cnt, res) in fetched:
+        ws, we, cnt = np.asarray(ws), np.asarray(we), np.asarray(cnt)
+        for j in range(len(ws)):
+            if cnt.ndim == 1 and cnt[j] > 0:
+                out.append((int(ws[j]), int(we[j]), int(cnt[j]),
+                            tuple(np.asarray(r[j]).ravel().round(3).tolist()
+                                  for r in res)))
+    return out
+
+
+def keyed_rows(fetched):
+    out = []
+    for (ws, we, cnt, res) in fetched:
+        cnt = np.asarray(cnt)
+        out.append((np.asarray(ws).tolist(), cnt.round(0).tolist(),
+                    [np.asarray(r).round(3).tolist() for r in res]))
+    return out
+
+
+def run_resume_case(make, n_before=3, n_after=3, rows=rows_of,
+                    tmp_path=None):
+    # killed-and-resumed run
+    p1 = make()
+    _ = fetch(p1.run(n_before))
+    save_pipeline(p1, str(tmp_path / "ckpt"))
+    del p1
+    p2 = make()
+    restore_pipeline(p2, str(tmp_path / "ckpt"))
+    got_tail = rows(fetch(p2.run(n_after)))
+    # the uninterrupted run's tail must match the resumed tail exactly
+    full = fetch(make().run(n_before + n_after))
+    assert rows(full[n_before:]) == got_tail, "resumed tail diverged"
+
+
+def test_aligned_pipeline_resume(tmp_path):
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    def make():
+        return AlignedStreamPipeline(
+            [TumblingWindow(Time, 50), SlidingWindow(Time, 200, 50)],
+            [SumAggregation()], config=CFG, throughput=20_000,
+            wm_period_ms=100, max_lateness=100, seed=5, gc_every=10 ** 9)
+    run_resume_case(make, tmp_path=tmp_path)
+
+
+def test_count_pipeline_resume(tmp_path):
+    from scotty_tpu.engine.count_pipeline import CountStreamPipeline
+
+    def make():
+        return CountStreamPipeline(
+            [TumblingWindow(Count, 7), TumblingWindow(Time, 50)],
+            [SumAggregation()], throughput=2000, wm_period_ms=100,
+            max_lateness=100, seed=3, out_of_order_pct=0.3)
+    run_resume_case(make, tmp_path=tmp_path)
+
+
+def test_session_pipeline_resume(tmp_path):
+    from scotty_tpu.engine.session_pipeline import SessionStreamPipeline
+
+    def make():
+        return SessionStreamPipeline(
+            [SessionWindow(Time, 300), SlidingWindow(Time, 500, 100)],
+            [HyperLogLogAggregation(6)], config=CFG, throughput=20_000,
+            wm_period_ms=100, max_lateness=100, seed=2,
+            session_config={"count": 3, "minGapMs": 300, "maxGapMs": 700})
+    run_resume_case(make, n_before=4, n_after=6, tmp_path=tmp_path)
+
+
+def test_keyed_pipeline_resume(tmp_path):
+    from scotty_tpu.parallel.keyed import KeyedAlignedPipeline
+
+    def make():
+        return KeyedAlignedPipeline(
+            [TumblingWindow(Time, 100)], [SumAggregation()], n_keys=8,
+            config=EngineConfig(capacity=256, annex_capacity=8,
+                                min_trigger_pad=32),
+            throughput=80_000, wm_period_ms=100, max_lateness=100, seed=7)
+    run_resume_case(make, rows=keyed_rows, tmp_path=tmp_path)
+
+
+def test_keyed_operator_resume(tmp_path):
+    from scotty_tpu.parallel.keyed import KeyedTpuWindowOperator
+
+    def make():
+        op = KeyedTpuWindowOperator(4, config=EngineConfig(
+            capacity=1 << 10, batch_size=64, min_trigger_pad=32))
+        op.add_window_assigner(TumblingWindow(Time, 100))
+        op.add_aggregation(SumAggregation())
+        op.set_max_lateness(100)
+        return op
+
+    rng = np.random.default_rng(0)
+    N = 400
+    ts = np.sort(rng.integers(0, 800, size=N)).astype(np.int64)
+    keys = rng.integers(0, 4, size=N).astype(np.int64)
+    vals = rng.random(N).astype(np.float32)
+
+    def feed(op, lo, hi, wm):
+        for k, v, t in zip(keys[lo:hi], vals[lo:hi], ts[lo:hi]):
+            op.process_element(int(k), float(v), int(t))
+        out = op.process_watermark_arrays(wm)
+        return [tuple(np.asarray(x).round(3).ravel().tolist())
+                for x in out]
+
+    ref_op = make()
+    a = feed(ref_op, 0, 200, 400)
+    b = feed(ref_op, 200, 400, 900)
+
+    op1 = make()
+    a1 = feed(op1, 0, 200, 400)
+    save_keyed_operator(op1, str(tmp_path / "kop"))
+    op2 = make()
+    restore_keyed_operator(op2, str(tmp_path / "kop"))
+    b2 = feed(op2, 200, 400, 900)
+    assert a1 == a
+    assert b2 == b
+
+
+def test_pipeline_restore_guards(tmp_path):
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    def make(seed=5):
+        return AlignedStreamPipeline(
+            [TumblingWindow(Time, 50)], [SumAggregation()], config=CFG,
+            throughput=20_000, wm_period_ms=100, max_lateness=100,
+            seed=seed, gc_every=10 ** 9)
+
+    p = make()
+    with pytest.raises(ValueError, match="not started"):
+        save_pipeline(p, str(tmp_path / "x"))
+    p.run(1, collect=False)
+    p.sync()
+    save_pipeline(p, str(tmp_path / "x"))
+    with pytest.raises(ValueError, match="seed mismatch"):
+        restore_pipeline(make(seed=6), str(tmp_path / "x"))
