@@ -98,12 +98,23 @@ class BucketWindowPipeline(FusedPipelineDriver):
 
             rows = jnp.arange(S, dtype=jnp.int64)
             keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
-            u = jax.vmap(lambda k: jax.random.uniform(
-                k, (2, R), dtype=jnp.float32))(keys)     # [S, 2, R]
-            vals = (u[:, 0] * value_scale).reshape(-1)
+            if R % 2 == 0:
+                # two 16-bit values per 32-bit draw — byte-identical to
+                # AlignedStreamPipeline.gen_rows (r5)
+                bits = jax.vmap(lambda k: jax.random.bits(
+                    k, (R // 2,), dtype=jnp.uint32))(keys)
+                lo = (bits & jnp.uint32(0xffff)).astype(jnp.float32)
+                hi = (bits >> 16).astype(jnp.float32)
+                vals = (jnp.concatenate([lo, hi], axis=-1)
+                        * jnp.float32(value_scale / 65536.0)).reshape(-1)
+            else:
+                u = jax.vmap(lambda k: jax.random.uniform(
+                    k, (R,), dtype=jnp.float32))(keys)
+                vals = (u * value_scale).reshape(-1)
             row_starts = base + g * rows
-            off = jnp.clip(jnp.floor(u[:, 1] * jnp.float32(g)), 0, g - 1)
-            ts = (row_starts[:, None] + off.astype(jnp.int64)).reshape(-1)
+            # tuples sit at their row start (the aligned generator emits
+            # no offset stream — unobservable on the aligned grid)
+            ts = jnp.broadcast_to(row_starts[:, None], (S, R)).reshape(-1)
 
             slot = (interval_idx % intervals_needed) * n_new
             ring_ts = jax.lax.dynamic_update_slice(

@@ -466,10 +466,12 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
             generator's value distribution stays uniform (65536 levels
             over [0, value_scale)); aggregates are f32 throughout."""
             if half:
-                bits = jax.random.bits(kg, (K, S, Rc // 2))
+                bits = jax.random.bits(kg, (K, S, Rc // 2), dtype=jnp.uint32)
                 lo = (bits & jnp.uint32(0xffff)).astype(jnp.float32)
                 hi = (bits >> 16).astype(jnp.float32)
-                return (jnp.stack([lo, hi], axis=-1).reshape(K, S, Rc)
+                # block layout (lo half then hi half) — see the aligned
+                # generator's fusion note
+                return (jnp.concatenate([lo, hi], axis=-1)
                         * jnp.float32(value_scale / 65536.0))
             return jax.random.uniform(kg, (K, S, Rc),
                                       dtype=jnp.float32) * value_scale
@@ -587,11 +589,10 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
             kg = jax.random.fold_in(key, jnp.int64(c))
             if self._half_draw:
                 bits = np.asarray(jax.device_get(jax.random.bits(
-                    kg, (self.n_keys, S, Rc // 2))))
+                    kg, (self.n_keys, S, Rc // 2), dtype=jnp.uint32)))
                 lo = (bits & 0xffff).astype(np.float32)
                 hi = (bits >> 16).astype(np.float32)
-                vals = (np.stack([lo, hi], axis=-1)
-                        .reshape(self.n_keys, S, Rc)[key_idx]
+                vals = (np.concatenate([lo, hi], axis=-1)[key_idx]
                         * np.float32(self.value_scale / 65536.0))
             else:
                 u = jax.device_get(jax.random.uniform(

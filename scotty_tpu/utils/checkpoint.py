@@ -254,7 +254,7 @@ def save_pipeline(p, path: str) -> None:
     pure function of (seed, interval), so a restored pipeline continues
     the EXACT tuple stream and emission sequence of the saved one —
     kill-and-resume mid-sweep reproduces identical window results
-    (tests/test_checkpoint.py)."""
+    (tests/test_checkpoint_pipelines.py)."""
     import jax
 
     os.makedirs(path, exist_ok=True)
@@ -263,6 +263,10 @@ def save_pipeline(p, path: str) -> None:
         raise ValueError("pipeline not started; nothing to checkpoint")
     tree = _pipeline_tree(p)
     leaves = jax.tree.flatten(tree)[0]
+    if not leaves:
+        raise ValueError(
+            f"{type(p).__name__} keeps no state under .state/.sess_states "
+            "— this pipeline class is not checkpointable via save_pipeline")
     np.savez(os.path.join(path, "pipeline_state.npz"),
              **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
     with open(os.path.join(path, "meta.json"), "w") as f:
