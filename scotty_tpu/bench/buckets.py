@@ -32,6 +32,7 @@ from ..engine.pipeline import (
     AlignedStreamPipeline,
     FusedPipelineDriver,
     build_trigger_grid,
+    half_draw,
     lower_interval,
 )
 
@@ -73,6 +74,15 @@ class BucketWindowPipeline(FusedPipelineDriver):
         if throughput * g % 1000:
             raise ValueError("throughput not an integer per-slice rate")
         R = throughput * g // 1000
+        if R > 1 << 25:
+            # the aligned twin switches to sub-row (row, sub)-keyed
+            # chunking past its lift budget, so the per-row streams would
+            # silently diverge; the bucket baseline is run at far lower
+            # offered loads anyway (O(triggers × ring) per watermark)
+            raise NotImplementedError(
+                "bucket baseline: per-slice rate exceeds the row-granular "
+                "generator (the aligned pipeline sub-chunks here and the "
+                "streams would differ); lower bucketsThroughput")
         S = wm_period_ms // g
         self.grid, self.R, self.S = g, R, S
         self.tuples_per_interval = S * R
@@ -103,10 +113,7 @@ class BucketWindowPipeline(FusedPipelineDriver):
                 # AlignedStreamPipeline.gen_rows (r5)
                 bits = jax.vmap(lambda k: jax.random.bits(
                     k, (R // 2,), dtype=jnp.uint32))(keys)
-                lo = (bits & jnp.uint32(0xffff)).astype(jnp.float32)
-                hi = (bits >> 16).astype(jnp.float32)
-                vals = (jnp.concatenate([lo, hi], axis=-1)
-                        * jnp.float32(value_scale / 65536.0)).reshape(-1)
+                vals = half_draw(bits, value_scale).reshape(-1)
             else:
                 u = jax.vmap(lambda k: jax.random.uniform(
                     k, (R,), dtype=jnp.float32))(keys)

@@ -38,6 +38,23 @@ from ..core.windows import (
 from .config import EngineConfig
 
 
+def half_draw(bits, value_scale: float):
+    """Expand 32-bit draws into TWO 16-bit-granular uniform values over
+    ``[0, value_scale)``, laid out as blocks (lo half then hi half) along
+    the LAST axis. The layout is load-bearing: a stride-2 interleave
+    breaks XLA's producer fusion into dot operands (measured
+    2.75 G → 0.77 G on the factored-histogram quantile cell), and the
+    bucket/keyed generators must agree bit-exactly with the aligned one.
+    Callers pass ``jax.random.bits(..., dtype=jnp.uint32)`` — under x64
+    the default widens to uint64 and silently rescales the values."""
+    import jax.numpy as jnp
+
+    lo = (bits & jnp.uint32(0xffff)).astype(jnp.float32)
+    hi = (bits >> 16).astype(jnp.float32)
+    return (jnp.concatenate([lo, hi], axis=-1)
+            * jnp.float32(value_scale / 65536.0))
+
+
 def build_trigger_grid(windows, wm_period_ms: int):
     """Device-side trigger enumeration with a static layout.
 
@@ -580,6 +597,22 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             if S % cand == 0 and cand * R * max_width <= max_chunk_elems:
                 d = cand
         self._heuristic_d = d
+        # Sub-row chunking (r5): coarse grids put the whole interval in a
+        # handful of rows (S=1, R=800M for Sliding(60s,10s) at 800M/s), so
+        # even d=1 materializes a multi-GB row and the generator+reduce
+        # can't tile. When one row exceeds the budget, the scan iterates
+        # over n_sub sub-chunks per row (smallest divisor count bringing
+        # R/n_sub within budget), keyed per ABSOLUTE (row, sub) pair —
+        # the sub-chunked stream is a pure function of the pipeline
+        # parameters, and materialize_interval replays it bit-exactly.
+        n_sub = 1
+        if R * max_width > max_chunk_elems:
+            n_sub = min(-(-R * max_width // max_chunk_elems), R)
+            while R % n_sub and n_sub < R:
+                n_sub += 1
+            # degenerate budgets (max_width > max_chunk_elems) land on
+            # q = 1 lanes per chunk rather than spinning or crashing
+        self._n_sub = n_sub
 
         spec = ec.EngineSpec(
             periods=(g,), bands=(), count_periods=(),
@@ -656,7 +689,7 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                 current_count=state.current_count + n_ok,
                 overflow=state.overflow | bad)
 
-        half_draw = R % 2 == 0
+        use_half = R % 2 == 0
 
         def gen_rows(key, rows):
             """The paced generator: R tuples per slice row (the reference's
@@ -674,20 +707,21 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             tuple placement is unobservable (t_last containment ≡ start
             containment) and tuples sit at their row start."""
             keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
-            if half_draw:
+            if use_half:
                 bits = jax.vmap(lambda k: jax.random.bits(
                     k, (R // 2,), dtype=jnp.uint32))(keys)
-                lo = (bits & jnp.uint32(0xffff)).astype(jnp.float32)
-                hi = (bits >> 16).astype(jnp.float32)
-                # block layout (lo half then hi half), NOT interleaved:
-                # a stride-2 interleave breaks XLA's producer fusion into
-                # dot operands (the factored-histogram einsum), spilling
-                # the one-hots to HBM — measured 2.75 G -> 0.77 G on the
-                # quantile cell
-                return (jnp.concatenate([lo, hi], axis=-1)
-                        * jnp.float32(value_scale / 65536.0))
+                return half_draw(bits, value_scale)
             return jax.vmap(lambda k: jax.random.uniform(
                 k, (R,), dtype=jnp.float32))(keys) * value_scale
+
+        def gen_lanes(kk, n):
+            """[n] values from one key — the sub-row chunk generator
+            (same half-draw block layout as gen_rows)."""
+            if n % 2 == 0:
+                return half_draw(jax.random.bits(
+                    kk, (n // 2,), dtype=jnp.uint32), value_scale)
+            return jax.random.uniform(
+                kk, (n,), dtype=jnp.float32) * value_scale
 
         span_l8 = self._late_span
         R_l8 = self._late_R
@@ -756,66 +790,93 @@ class AlignedStreamPipeline(FusedPipelineDriver):
 
         late_fold_active = late_fold_segment if span_l8 else late_fold
 
+        n_sub = self._n_sub
+
+        def lift_chunk(flat, dd, RR):
+            """Per-aggregation [dd, width] partials of a flat [dd*RR]
+            value chunk — the sparse/factored/dense strategy block shared
+            by row-granular and sub-row chunking."""
+            parts = []
+            for aspec in spec.aggs:
+                if aspec.is_sparse and aspec.token in self._factored:
+                    # factored MXU histogram (see strategy note):
+                    # hist[row] = A^T·B with A, B the hi/lo one-hots
+                    wa, wb = self._factored[aspec.token]
+                    col, v = aspec.lift_sparse(flat)
+                    hi = (col // wb).astype(jnp.int32)
+                    lo = (col - hi * wb).astype(jnp.int32)
+                    A = jnp.where(
+                        hi[:, None] == jnp.arange(wa)[None, :],
+                        v[:, None], 0.0).reshape(dd, RR, wa)  # carries v
+                    Bm = (lo[:, None]
+                          == jnp.arange(wb)[None, :]).astype(
+                              jnp.bfloat16).reshape(dd, RR, wb)
+                    hist = jnp.einsum(
+                        "drk,drl->dkl", A, Bm,
+                        preferred_element_type=jnp.float32)
+                    parts.append(hist.reshape(dd, wa * wb))
+                elif aspec.is_sparse and onehot_ok[aspec.token]:
+                    # one-hot densify + row reduce (see strategy note
+                    # in __init__)
+                    col, v = aspec.lift_sparse(flat)
+                    lifted = jnp.where(
+                        col[:, None] == jnp.arange(aspec.width)[None, :],
+                        v[:, None], jnp.asarray(aspec.identity,
+                                                v.dtype))
+                    lifted = lifted.reshape(dd, RR, -1)
+                    parts.append(red[aspec.kind](lifted, axis=1))
+                elif aspec.is_sparse:
+                    # flat [dd*width] f32 scatter — per-lane cost only
+                    col, v = aspec.lift_sparse(flat)
+                    row_id = jnp.arange(dd * RR, dtype=jnp.int32) // RR
+                    fi = row_id * aspec.width + col.astype(jnp.int32)
+                    tgt = jnp.full((dd * aspec.width,), aspec.identity,
+                                   jnp.float32)
+                    if aspec.kind == "sum":
+                        tgt = tgt.at[fi].add(v)
+                    elif aspec.kind == "min":
+                        tgt = tgt.at[fi].min(v)
+                    else:
+                        tgt = tgt.at[fi].max(v)
+                    parts.append(tgt.reshape(dd, aspec.width))
+                else:
+                    lifted = aspec.lift_dense(flat).reshape(dd, RR, -1)
+                    parts.append(red[aspec.kind](lifted, axis=1))
+            return parts
+
         def step_impl(state, key, interval_idx, d):
-            n_chunks = S // d
             base = interval_idx * P
             if L:
                 state = late_fold_active(state, key, base)
 
-            def body(_, c):
-                vals = gen_rows(
-                    key, c * d + jnp.arange(d, dtype=jnp.int64))
-                flat = vals.reshape(-1)
-                parts = []
-                for aspec in spec.aggs:
-                    if aspec.is_sparse and aspec.token in self._factored:
-                        # factored MXU histogram (see strategy note):
-                        # hist[row] = A^T·B with A, B the hi/lo one-hots
-                        wa, wb = self._factored[aspec.token]
-                        col, v = aspec.lift_sparse(flat)
-                        hi = (col // wb).astype(jnp.int32)
-                        lo = (col - hi * wb).astype(jnp.int32)
-                        A = jnp.where(
-                            hi[:, None] == jnp.arange(wa)[None, :],
-                            v[:, None], 0.0).reshape(d, R, wa)  # carries v
+            if n_sub > 1:
+                # sub-row chunking (see __init__): q lanes of one row per
+                # scan step, keyed per absolute (row, sub) pair
+                q = R // n_sub
 
-                        Bm = (lo[:, None]
-                              == jnp.arange(wb)[None, :]).astype(
-                                  jnp.bfloat16).reshape(d, R, wb)
-                        hist = jnp.einsum(
-                            "drk,drl->dkl", A, Bm,
-                            preferred_element_type=jnp.float32)
-                        parts.append(hist.reshape(d, wa * wb))
-                    elif aspec.is_sparse and onehot_ok[aspec.token]:
-                        # one-hot densify + row reduce (see strategy note
-                        # in __init__)
-                        col, v = aspec.lift_sparse(flat)
-                        lifted = jnp.where(
-                            col[:, None] == jnp.arange(aspec.width)[None, :],
-                            v[:, None], jnp.asarray(aspec.identity,
-                                                    v.dtype))
-                        lifted = lifted.reshape(d, R, -1)
-                        parts.append(red[aspec.kind](lifted, axis=1))
-                    elif aspec.is_sparse:
-                        # flat [d*width] f32 scatter — per-lane cost only
-                        col, v = aspec.lift_sparse(flat)
-                        row_id = jnp.arange(d * R, dtype=jnp.int32) // R
-                        fi = row_id * aspec.width + col.astype(jnp.int32)
-                        tgt = jnp.full((d * aspec.width,), aspec.identity,
-                                       jnp.float32)
-                        if aspec.kind == "sum":
-                            tgt = tgt.at[fi].add(v)
-                        elif aspec.kind == "min":
-                            tgt = tgt.at[fi].min(v)
-                        else:
-                            tgt = tgt.at[fi].max(v)
-                        parts.append(tgt.reshape(d, aspec.width))
-                    else:
-                        lifted = aspec.lift_dense(flat).reshape(d, R, -1)
-                        parts.append(red[aspec.kind](lifted, axis=1))
-                return None, tuple(parts)
+                def body(_, c):
+                    row = c // n_sub
+                    s_i = c % n_sub
+                    kk = jax.random.fold_in(
+                        jax.random.fold_in(key, row),
+                        0x5f000000 + s_i)
+                    flat = gen_lanes(kk, q)
+                    return None, tuple(p[0] for p in lift_chunk(flat, 1, q))
 
-            _, parts = jax.lax.scan(body, None, jnp.arange(n_chunks))
+                _, stacked = jax.lax.scan(
+                    body, None, jnp.arange(S * n_sub, dtype=jnp.int64))
+                parts = tuple(
+                    red[a.kind](p.reshape(S, n_sub, -1), axis=1)
+                    for a, p in zip(spec.aggs, stacked))
+            else:
+                def body(_, c):
+                    vals = gen_rows(
+                        key, c * d + jnp.arange(d, dtype=jnp.int64))
+                    return None, tuple(lift_chunk(vals.reshape(-1), d, R))
+
+                _, stacked = jax.lax.scan(
+                    body, None, jnp.arange(S // d))
+                parts = tuple(p.reshape(S, -1) for p in stacked)
 
             row_starts = base + g * jnp.arange(S, dtype=jnp.int64)
             # tuples sit at their row start (the offset stream is
@@ -840,7 +901,7 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                             + R * jnp.arange(S, dtype=jnp.int64)),
                 counts=app(state.counts, jnp.full((S,), R, jnp.int64)),
                 partials=tuple(
-                    app(p, pr.reshape(S, -1))
+                    app(p, pr)
                     for p, pr in zip(state.partials, parts)),
                 n_slices=n + S,
                 max_event_time=jnp.maximum(state.max_event_time, t_last[-1]),
@@ -856,6 +917,7 @@ class AlignedStreamPipeline(FusedPipelineDriver):
 
         self._step_impl = step_impl
         self._gen_rows = gen_rows
+        self._gen_lanes = gen_lanes
         self.set_rows_per_chunk(self._heuristic_d)
         self._root = None
         self.state = None
@@ -997,10 +1059,23 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             self._root = jax.random.PRNGKey(self.seed)
         key = self._interval_key(i)
         g, P, S = self.grid, self.wm_period_ms, self.S
-        # per-row keying makes the stream chunk-shape-independent, so one
-        # whole-interval generation replays ANY chunking bit-exactly
-        vals = np.asarray(jax.device_get(self._gen_rows(
-            key, jnp.arange(S, dtype=jnp.int64))))
+        if self._n_sub > 1:
+            # sub-row chunking: per-(row, sub) keying (see step_impl) —
+            # one vmapped generation over all (row, sub) pairs, not a
+            # dispatch per chunk
+            q = self.R // self._n_sub
+            rr = jnp.repeat(jnp.arange(S, dtype=jnp.int64), self._n_sub)
+            ss = jnp.tile(jnp.arange(self._n_sub, dtype=jnp.int64), S)
+            vals = np.asarray(jax.device_get(jax.vmap(
+                lambda r, s: self._gen_lanes(
+                    jax.random.fold_in(jax.random.fold_in(key, r),
+                                       0x5f000000 + s), q))(rr, ss))
+            ).reshape(S, self.R)
+        else:
+            # per-row keying makes the stream chunk-shape-independent, so
+            # one whole-interval generation replays ANY chunking bit-exact
+            vals = np.asarray(jax.device_get(self._gen_rows(
+                key, jnp.arange(S, dtype=jnp.int64))))
         row_starts = i * P + g * np.arange(S, dtype=np.int64)
         # tuples sit at their row start (see gen_rows: the offset stream
         # is unobservable on the aligned grid and not generated)

@@ -466,13 +466,10 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
             generator's value distribution stays uniform (65536 levels
             over [0, value_scale)); aggregates are f32 throughout."""
             if half:
+                from ..engine.pipeline import half_draw
+
                 bits = jax.random.bits(kg, (K, S, Rc // 2), dtype=jnp.uint32)
-                lo = (bits & jnp.uint32(0xffff)).astype(jnp.float32)
-                hi = (bits >> 16).astype(jnp.float32)
-                # block layout (lo half then hi half) — see the aligned
-                # generator's fusion note
-                return (jnp.concatenate([lo, hi], axis=-1)
-                        * jnp.float32(value_scale / 65536.0))
+                return half_draw(bits, value_scale)
             return jax.random.uniform(kg, (K, S, Rc),
                                       dtype=jnp.float32) * value_scale
 

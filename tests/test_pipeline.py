@@ -314,3 +314,35 @@ def test_aligned_chunk_shape_retune_keeps_results():
     assert set(timings) == set(cands)
     assert p.rows_per_chunk == min(timings, key=timings.get)
     assert same(emit(p), base_rows)           # winner: same stream/results
+
+
+def test_sub_row_chunking_differential():
+    """Coarse grids (S=1, huge R) exceed the per-chunk lift budget even at
+    d=1; the generator then iterates sub-row chunks keyed per absolute
+    (row, sub) pair (r5). Forced here with a tiny budget: results must
+    match the simulator on the materialized stream, and the sub-chunked
+    stream must replay bit-exactly."""
+    windows = [SlidingWindow(Time, 200, 100)]
+    p = AlignedStreamPipeline(
+        windows, [SumAggregation()], config=CFG, throughput=2560,
+        wm_period_ms=100, seed=9, gc_every=10 ** 9, max_chunk_elems=64)
+    assert p._n_sub > 1, "budget did not force sub-row chunking"
+    sim = SlicingWindowOperator()
+    for w in windows:
+        sim.add_window_assigner(w)
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(1000)
+    p.reset()
+    for i in range(4):
+        out = p.run(1)[0]
+        vals, ts = p.materialize_interval(i)
+        for v, t in zip(vals, ts):
+            sim.process_element(float(v), int(t))
+        exp = {(w.get_start(), w.get_end()): float(w.get_agg_values()[0])
+               for w in sim.process_watermark((i + 1) * 100)
+               if w.has_value()}
+        got = {(s, e): float(v[0])
+               for s, e, c, v in p.lowered_results(out) if c > 0}
+        assert set(got) == set(exp), (i, got, exp)
+        for k in got:
+            assert got[k] == pytest.approx(exp[k], rel=1e-4), (i, k)
