@@ -32,7 +32,7 @@ from ..engine.pipeline import (
     AlignedStreamPipeline,
     FusedPipelineDriver,
     build_trigger_grid,
-    half_draw,
+    draw_uniform16,
     lower_interval,
 )
 
@@ -108,16 +108,9 @@ class BucketWindowPipeline(FusedPipelineDriver):
 
             rows = jnp.arange(S, dtype=jnp.int64)
             keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
-            if R % 2 == 0:
-                # two 16-bit values per 32-bit draw — byte-identical to
-                # AlignedStreamPipeline.gen_rows (r5)
-                bits = jax.vmap(lambda k: jax.random.bits(
-                    k, (R // 2,), dtype=jnp.uint32))(keys)
-                vals = half_draw(bits, value_scale).reshape(-1)
-            else:
-                u = jax.vmap(lambda k: jax.random.uniform(
-                    k, (R,), dtype=jnp.float32))(keys)
-                vals = (u * value_scale).reshape(-1)
+            # byte-identical to AlignedStreamPipeline.gen_rows (r5)
+            vals = jax.vmap(lambda k: draw_uniform16(
+                k, (R,), value_scale))(keys).reshape(-1)
             row_starts = base + g * rows
             # tuples sit at their row start (the aligned generator emits
             # no offset stream — unobservable on the aligned grid)

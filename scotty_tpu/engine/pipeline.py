@@ -66,6 +66,23 @@ def half_draw(bits, value_scale: float):
     return jnp.concatenate([lo, hi], axis=-1)
 
 
+def draw_uniform16(key, shape, value_scale: float):
+    """The benchmark generators' value draw: ``shape`` values uniform
+    over 65536 levels in ``[0, value_scale)`` via the half-draw block
+    layout when ``shape[-1]`` is even (two values per 32-bit threefry
+    draw), plain f32 uniforms otherwise. Every generator (aligned,
+    bucket, keyed, session — device AND host-replay faces) goes through
+    THIS function so the streams cannot drift."""
+    import jax
+    import jax.numpy as jnp
+
+    if shape[-1] % 2 == 0:
+        bits = jax.random.bits(key, shape[:-1] + (shape[-1] // 2,),
+                               dtype=jnp.uint32)
+        return half_draw(bits, value_scale)
+    return jax.random.uniform(key, shape, dtype=jnp.float32) * value_scale
+
+
 def build_trigger_grid(windows, wm_period_ms: int):
     """Device-side trigger enumeration with a static layout.
 
@@ -700,8 +717,6 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                 current_count=state.current_count + n_ok,
                 overflow=state.overflow | bad)
 
-        use_half = R % 2 == 0
-
         def gen_rows(key, rows):
             """The paced generator: R tuples per slice row (the reference's
             constant-rate LoadGeneratorSource), values uniform over 65536
@@ -718,21 +733,13 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             tuple placement is unobservable (t_last containment ≡ start
             containment) and tuples sit at their row start."""
             keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
-            if use_half:
-                bits = jax.vmap(lambda k: jax.random.bits(
-                    k, (R // 2,), dtype=jnp.uint32))(keys)
-                return half_draw(bits, value_scale)
-            return jax.vmap(lambda k: jax.random.uniform(
-                k, (R,), dtype=jnp.float32))(keys) * value_scale
+            return jax.vmap(
+                lambda k: draw_uniform16(k, (R,), value_scale))(keys)
 
         def gen_lanes(kk, n):
             """[n] values from one key — the sub-row chunk generator
             (same half-draw block layout as gen_rows)."""
-            if n % 2 == 0:
-                return half_draw(jax.random.bits(
-                    kk, (n // 2,), dtype=jnp.uint32), value_scale)
-            return jax.random.uniform(
-                kk, (n,), dtype=jnp.float32) * value_scale
+            return draw_uniform16(kk, (n,), value_scale)
 
         span_l8 = self._late_span
         R_l8 = self._late_R

@@ -234,9 +234,22 @@ class SessionStreamPipeline(FusedPipelineDriver):
         self._onehot_q = onehot_q
 
         def gen_chunk(key, c):
-            kg = jax.random.fold_in(key, c)
-            u = jax.random.uniform(kg, (2, d, R), dtype=jnp.float32)
-            return u[0] * value_scale, u[1]
+            """[d, R] values for chunk c. Values take the half-draw block
+            layout (two 16-bit values per 32-bit draw — the shared RNG
+            cost model, engine/pipeline.half_draw); event times are PACED
+            within each slice row (tuple j at offset j·g//R — the
+            reference's constant-rate LoadGeneratorSource arrival clock),
+            so the per-tuple offset stream costs nothing and the row
+            extrema are closed form."""
+            from .pipeline import draw_uniform16
+
+            return draw_uniform16(jax.random.fold_in(key, c), (d, R),
+                                  value_scale)
+
+        # paced intra-row offsets: first tuple at the row start, last at
+        # (R-1)·g//R — deterministic, identical for every row
+        off_first = 0
+        off_last = ((R - 1) * g) // R
 
         def step(grid_state, sess_states, key, interval_idx, live):
             """live: i1 scalar — False = silent interval (no tuples)."""
@@ -245,7 +258,7 @@ class SessionStreamPipeline(FusedPipelineDriver):
 
             def gen_and_fold(_):
                 def body(carry, c):
-                    vals, offs = gen_chunk(key, c)
+                    vals = gen_chunk(key, c)
                     flat = vals.reshape(-1)
                     parts = []
                     for aspec in spec.aggs:
@@ -317,11 +330,9 @@ class SessionStreamPipeline(FusedPipelineDriver):
                             lifted = aspec.lift_dense(flat).reshape(d, R, -1)
                             pr = red(lifted, axis=1)              # [d, w]
                         parts.append(pr)
-                    return carry, (tuple(parts),
-                                   jnp.min(offs, axis=1),
-                                   jnp.max(offs, axis=1))
+                    return carry, tuple(parts)
 
-                _, (parts, omin, omax) = jax.lax.scan(
+                _, parts = jax.lax.scan(
                     body, None, jnp.arange(n_chunks))
                 # the interval-wide fold shared by every session window
                 # derives from the STACKED row partials ([n_chunks, d, w]
@@ -337,27 +348,19 @@ class SessionStreamPipeline(FusedPipelineDriver):
                            "max": jnp.max}[aspec.kind]
                     comb.append(red(pstack, axis=(0, 1)))
                 comb = tuple(comb)
-                off_lo = jnp.clip(
-                    jnp.floor(omin.reshape(S) * jnp.float32(g)), 0,
-                    g - 1).astype(jnp.int64)
-                off_hi = jnp.clip(
-                    jnp.floor(omax.reshape(S) * jnp.float32(g)), 0,
-                    g - 1).astype(jnp.int64)
-                return comb, parts, off_lo, off_hi
+                return comb, parts
 
             def no_fold(_):
                 comb = tuple(jnp.full((a.width,), a.identity, jnp.float32)
                              for a in spec.aggs)
                 parts = tuple(jnp.full((S // d, d, a.width), a.identity,
                                        jnp.float32) for a in spec.aggs)
-                z = jnp.zeros((S,), jnp.int64)
-                return comb, parts, z, z
+                return comb, parts
 
-            comb, parts, off_lo, off_hi = jax.lax.cond(
-                live, gen_and_fold, no_fold, None)
+            comb, parts = jax.lax.cond(live, gen_and_fold, no_fold, None)
             row_starts = base + g * jnp.arange(S, dtype=jnp.int64)
-            t_first_iv = base + off_lo[0]          # first tuple ts
-            t_last_iv = base + (S - 1) * g + off_hi[-1]
+            t_first_iv = base + off_first          # first tuple ts (paced)
+            t_last_iv = base + (S - 1) * g + off_last
             n_tuples = jnp.where(live, jnp.int64(S * R), 0)
 
             # ---- grid append (aligned, zero-scatter) ---------------------
@@ -373,8 +376,8 @@ class SessionStreamPipeline(FusedPipelineDriver):
                 appended = st._replace(
                     starts=app(st.starts, row_starts),
                     ends=app(st.ends, row_starts + g),
-                    t_first=app(st.t_first, row_starts + off_lo),
-                    t_last=app(st.t_last, row_starts + off_hi),
+                    t_first=app(st.t_first, row_starts + off_first),
+                    t_last=app(st.t_last, row_starts + off_last),
                     c_start=app(st.c_start, st.current_count
                                 + R * jnp.arange(S, dtype=jnp.int64)),
                     counts=app(st.counts, jnp.full((S,), R, jnp.int64)),
@@ -505,17 +508,18 @@ class SessionStreamPipeline(FusedPipelineDriver):
             self._root = jax.random.PRNGKey(self.seed)
         key = jax.random.fold_in(self._root, i)
         g, d, R, P = self.grid, self._d, self.R, self.wm_period_ms
+        from .pipeline import draw_uniform16
+
         vals_all, ts_all = [], []
+        paced = (np.arange(R, dtype=np.int64) * g) // R
         for c in range(self._n_chunks):
             kg = jax.random.fold_in(key, jnp.int64(c))
-            u = jax.device_get(jax.random.uniform(
-                kg, (2, d, R), dtype=jnp.float32))
-            vals, offs = u[0] * np.float32(self.value_scale), u[1]
+            vals = np.asarray(jax.device_get(draw_uniform16(
+                kg, (d, R), self.value_scale)))
             row_starts = (i * P + g * (c * d + np.arange(d, dtype=np.int64)))
-            off_ms = np.clip(np.floor(np.asarray(offs, np.float32)
-                                      * np.float32(g)), 0, g - 1)
-            ts = row_starts[:, None] + off_ms.astype(np.int64)
-            vals_all.append(np.asarray(vals).reshape(-1))
+            # paced intra-row event times (see gen_chunk)
+            ts = row_starts[:, None] + paced[None, :]
+            vals_all.append(vals.reshape(-1))
             ts_all.append(ts.reshape(-1))
         return np.concatenate(vals_all), np.concatenate(ts_all)
 

@@ -449,13 +449,9 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
         while R % n_chunks:
             n_chunks += 1
         Rc = R // n_chunks
-        # two values per 32-bit draw (below) needs an even chunk width
-        self._half_draw = Rc % 2 == 0
         self._n_chunks, self._rc = n_chunks, Rc
         first_lw = max(0, P - max_lateness)
         red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
-
-        half = self._half_draw
 
         def gen_vals(kg):
             """[K, S, Rc] generated values. The RNG is the measured
@@ -465,13 +461,9 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
             values — halving the threefry lanes per tuple. The load
             generator's value distribution stays uniform (65536 levels
             over [0, value_scale)); aggregates are f32 throughout."""
-            if half:
-                from ..engine.pipeline import half_draw
+            from ..engine.pipeline import draw_uniform16
 
-                bits = jax.random.bits(kg, (K, S, Rc // 2), dtype=jnp.uint32)
-                return half_draw(bits, value_scale)
-            return jax.random.uniform(kg, (K, S, Rc),
-                                      dtype=jnp.float32) * value_scale
+            return draw_uniform16(kg, (K, S, Rc), value_scale)
 
         def step(state, key, interval_idx):
             base = interval_idx * P
@@ -584,17 +576,10 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
         vals_all, ts_all = [], []
         for c in range(self._n_chunks):
             kg = jax.random.fold_in(key, jnp.int64(c))
-            if self._half_draw:
-                bits = np.asarray(jax.device_get(jax.random.bits(
-                    kg, (self.n_keys, S, Rc // 2), dtype=jnp.uint32)))
-                lo = (bits & 0xffff).astype(np.float32)
-                hi = (bits >> 16).astype(np.float32)
-                vals = (np.concatenate([lo, hi], axis=-1)[key_idx]
-                        * np.float32(self.value_scale / 65536.0))
-            else:
-                u = jax.device_get(jax.random.uniform(
-                    kg, (self.n_keys, S, Rc), dtype=jnp.float32))
-                vals = u[key_idx] * np.float32(self.value_scale)
+            from ..engine.pipeline import draw_uniform16
+
+            vals = np.asarray(jax.device_get(draw_uniform16(
+                kg, (self.n_keys, S, Rc), self.value_scale)))[key_idx]
             row_starts = i * P + g * np.arange(S, dtype=np.int64)
             # tuples sit at their row start (the offset stream is
             # unobservable on the aligned grid and not generated)
