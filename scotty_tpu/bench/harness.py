@@ -10,6 +10,7 @@ runner reports mean throughput + p99 window-emit latency per configuration.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 import time
@@ -18,6 +19,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import obs as _obs
 from ..core.aggregates import (
     BUILTIN_AGGREGATIONS,
     AggregateFunction,
@@ -330,6 +332,23 @@ def latency_stats(lats) -> dict:
             "stall_flagged": bool(p99 > 10.0 * p50)}
 
 
+def finalize_observability(res: "BenchResult", obs, lats, emitted: int,
+                           n_tuples: Optional[int] = None) -> None:
+    """Shared cell epilogue: fold the sampled emit latencies and emission
+    count into the registry, then embed the structured export on the
+    result. ``n_tuples`` is passed only by cells whose operator had no
+    hook points (the counter would otherwise double-count)."""
+    if obs is None:
+        return
+    for v in lats:
+        obs.histogram(_obs.EMIT_LATENCY_MS).observe(v)
+    obs.counter(_obs.WINDOWS_EMITTED).inc(emitted)
+    if n_tuples is not None:
+        obs.counter(_obs.INGEST_TUPLES).inc(n_tuples)
+    res.metrics = obs.export()
+    res.observability = obs             # for exporters (not in to_dict)
+
+
 @dataclass
 class BenchResult:
     name: str
@@ -340,9 +359,12 @@ class BenchResult:
     n_windows_emitted: int
     n_tuples: int
     wall_s: float
+    #: structured observability section (Observability.export(): metrics
+    #: snapshot + span summary); None when observability was disabled
+    metrics: Optional[dict] = None
 
     def to_dict(self):
-        return {
+        out = {
             "name": self.name, "windows": self.windows,
             "aggregation": self.aggregation,
             "tuples_per_sec": self.tuples_per_sec,
@@ -350,6 +372,9 @@ class BenchResult:
             "windows_emitted": self.n_windows_emitted,
             "tuples": self.n_tuples, "wall_s": self.wall_s,
         }
+        if self.metrics is not None:
+            out["metrics"] = self.metrics
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -359,13 +384,27 @@ class BenchResult:
 
 def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                   engine: str = "TpuEngine",
-                  warmup_batches: int = 2) -> BenchResult:
+                  warmup_batches: int = 2,
+                  obs: Optional[_obs.Observability] = None,
+                  collect_metrics: bool = True) -> BenchResult:
     """One (window-config × aggregation × engine) cell: feed the whole
     generated stream, watermark every ``watermark_period_ms`` event-ms,
-    report mean tuples/s + p99 window-emit latency."""
+    report mean tuples/s + p99 window-emit latency.
+
+    Observability: unless ``collect_metrics=False``, a fresh
+    :class:`scotty_tpu.obs.Observability` (or the caller's ``obs``) is
+    attached to the run — engine hooks record ingest/late/watermark
+    telemetry, harness phases record spans, and the structured export is
+    embedded as the result's ``metrics`` section
+    (``BenchResult.to_dict()["metrics"]``)."""
     import jax
 
     from ..core.windows import ForwardContextAware, ForwardContextFree
+
+    if obs is None and collect_metrics:
+        obs = _obs.Observability()
+    _span = obs.span if obs is not None else (
+        lambda name: contextlib.nullcontext())
 
     windows = parse_window_spec(window_spec, seed=cfg.seed)
     # out-of-order streams can use the device source too (on-device
@@ -391,11 +430,12 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     device_source = (engine == "TpuEngine" and not cfg.session_config
                      and not _host_fed
                      and (cfg.out_of_order_pct == 0 or not _host_only_ooo))
-    if device_source:
-        gen = make_device_source(cfg)
-        batches = None
-    else:
-        batches = generate_batches(cfg)
+    with _span("generate"):
+        if device_source:
+            gen = make_device_source(cfg)
+            batches = None
+        else:
+            batches = generate_batches(cfg)
 
     if engine == "TpuEngine":
         from ..engine import EngineConfig, TpuWindowOperator
@@ -421,37 +461,46 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         op.add_window_assigner(w)
     op.add_aggregation(make_aggregation(agg_name))
     op.set_max_lateness(cfg.max_lateness)
+    op_has_obs = hasattr(op, "set_observability")
+    if obs is not None and op_has_obs:
+        op.set_observability(obs)
 
     # warmup: compile ingest + query + gc paths on a throwaway twin
-    if engine == "TpuEngine" and warmup_batches > 0:
-        from ..engine import EngineConfig, TpuWindowOperator
+    # (deliberately NOT given the observability hooks: warmup tuples must
+    # not pollute the run's ingest/watermark counters)
+    with _span("warmup"):
+        if engine == "TpuEngine" and warmup_batches > 0:
+            from ..engine import EngineConfig, TpuWindowOperator
 
-        twin = TpuWindowOperator(config=EngineConfig(
-            capacity=cfg.capacity, batch_size=cfg.batch_size,
-            record_capacity=cfg.record_capacity))
-        for w in windows:
-            twin.add_window_assigner(w)
-        twin.add_aggregation(make_aggregation(agg_name))
-        twin.set_max_lateness(cfg.max_lateness)
-        if device_source:
-            last = 0
-            for i in range(warmup_batches):
-                vals, ts, lo, hi = gen(i)
-                twin.ingest_device_batch(vals, ts, lo, hi)
-                if gen.gen_late is not None and i > 0:
-                    twin.ingest_device_late(*gen.gen_late(i))
-                last = hi
-            twin.process_watermark_async(last + 1)
-            twin.process_watermark_async(last + cfg.watermark_period_ms + 1)
-            anchor = (twin._state if twin._state is not None
-                      else twin._ctx_states[0])
-            jax.block_until_ready(jax.tree.leaves(anchor)[0])
-        else:
-            for vals, ts in batches[:warmup_batches]:
-                twin.process_elements(vals, ts)
-            twin.process_watermark(int(batches[warmup_batches - 1][1][-1]) + 1)
-            twin.process_watermark(int(batches[warmup_batches - 1][1][-1])
-                                   + cfg.watermark_period_ms + 1)
+            twin = TpuWindowOperator(config=EngineConfig(
+                capacity=cfg.capacity, batch_size=cfg.batch_size,
+                record_capacity=cfg.record_capacity))
+            for w in windows:
+                twin.add_window_assigner(w)
+            twin.add_aggregation(make_aggregation(agg_name))
+            twin.set_max_lateness(cfg.max_lateness)
+            if device_source:
+                last = 0
+                for i in range(warmup_batches):
+                    vals, ts, lo, hi = gen(i)
+                    twin.ingest_device_batch(vals, ts, lo, hi)
+                    if gen.gen_late is not None and i > 0:
+                        twin.ingest_device_late(*gen.gen_late(i))
+                    last = hi
+                twin.process_watermark_async(last + 1)
+                twin.process_watermark_async(last + cfg.watermark_period_ms + 1)
+                anchor = (twin._state if twin._state is not None
+                          else twin._ctx_states[0])
+                jax.block_until_ready(jax.tree.leaves(anchor)[0])
+            else:
+                for vals, ts in batches[:warmup_batches]:
+                    twin.process_elements(vals, ts)
+                twin.process_watermark(int(batches[warmup_batches - 1][1][-1]) + 1)
+                twin.process_watermark(int(batches[warmup_batches - 1][1][-1])
+                                       + cfg.watermark_period_ms + 1)
+    if obs is not None:
+        # rates (*_per_s) measure the stream region, not generation/compile
+        obs.registry.reset_clock()
 
     stats = ThroughputStatistics()
     n_emitted = 0
@@ -510,42 +559,46 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         wm_count += 1
 
     t0 = time.perf_counter()
-    if device_source:
-        for i in range(gen.n_batches):
-            vals, ts, lo, hi = gen(i)
-            op.ingest_device_batch(vals, ts, lo, hi)
-            n_tuples += cfg.batch_size
-            if gen.gen_late is not None and i > 0:
-                late_args = gen.gen_late(i)
-                op.ingest_device_late(*late_args)
-                n_tuples += late_args[3]
-            while hi >= next_wm:
+    with _span("stream"):
+        if device_source:
+            for i in range(gen.n_batches):
+                vals, ts, lo, hi = gen(i)
+                op.ingest_device_batch(vals, ts, lo, hi)
+                n_tuples += cfg.batch_size
+                if gen.gen_late is not None and i > 0:
+                    late_args = gen.gen_late(i)
+                    op.ingest_device_late(*late_args)
+                    n_tuples += late_args[3]
+                while hi >= next_wm:
+                    advance_watermark(next_wm)
+                    next_wm += cfg.watermark_period_ms
+            batches = []
+        for vals, ts in batches:
+            if engine in ("TpuEngine", "Hybrid"):
+                op.process_elements(vals, ts)
+            else:
+                for v, t in zip(vals, ts):
+                    op.process_element(float(v), int(t))
+            n_tuples += len(vals)
+            last_ts = int(ts[-1])
+            while last_ts >= next_wm:
                 advance_watermark(next_wm)
                 next_wm += cfg.watermark_period_ms
-        batches = []
-    for vals, ts in batches:
-        if engine in ("TpuEngine", "Hybrid"):
-            op.process_elements(vals, ts)
-        else:
-            for v, t in zip(vals, ts):
-                op.process_element(float(v), int(t))
-        n_tuples += len(vals)
-        last_ts = int(ts[-1])
-        while last_ts >= next_wm:
-            advance_watermark(next_wm)
-            next_wm += cfg.watermark_period_ms
     # drain: one final watermark past the stream end + bundled result fetch
-    advance_watermark(next_wm)
-    if engine == "TpuEngine":
-        fetched = jax.device_get([c for _, c in pending])
-        for (T, _), cnt in zip(pending, fetched):
-            n_emitted += int((cnt[:T] > 0).sum())
-        if pending_sessions:
-            n_emitted += int(sum(int(m)
-                                 for grp in jax.device_get(pending_sessions)
-                                 for m in grp))
-        op.check_overflow()
+    with _span("drain"):
+        advance_watermark(next_wm)
+        if engine == "TpuEngine":
+            fetched = jax.device_get([c for _, c in pending])
+            for (T, _), cnt in zip(pending, fetched):
+                n_emitted += int((cnt[:T] > 0).sum())
+            if pending_sessions:
+                n_emitted += int(sum(
+                    int(m) for grp in jax.device_get(pending_sessions)
+                    for m in grp))
+            op.check_overflow()
     wall = time.perf_counter() - t0
+    if obs is not None:
+        obs.registry.stop_clock()       # rates cover the stream region only
 
     stats.tuples = n_tuples
     stats.seconds = wall
@@ -556,4 +609,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         n_windows_emitted=n_emitted, n_tuples=n_tuples, wall_s=wall)
     for k, v in latency_stats(stats.emit_latencies_ms).items():
         setattr(res, k, v)
+    # engines without hook points (Simulator/Hybrid host paths) still
+    # report harness-known ingest totals
+    finalize_observability(res, obs, stats.emit_latencies_ms, n_emitted,
+                           n_tuples=None if op_has_obs else n_tuples)
     return res

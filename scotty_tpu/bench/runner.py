@@ -22,6 +22,7 @@ Engines:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -30,9 +31,11 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import obs as _obs
 from .harness import (
     BenchmarkConfig,
     BenchResult,
+    finalize_observability,
     latency_stats,
     make_aggregation,
     parse_window_spec,
@@ -80,14 +83,22 @@ def measure_rtt_floor(n: int = 12) -> float:
 def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
                        agg_name: str, mode: str,
                        latency_samples: int = LATENCY_SAMPLES_MAX,
-                       latency_budget_s: float = LATENCY_BUDGET_S) -> BenchResult:
+                       latency_budget_s: float = LATENCY_BUDGET_S,
+                       obs: Optional[_obs.Observability] = None) -> BenchResult:
     """bench.py's measurement discipline for any fused pipeline object:
     pre-roll past the widest window span, time a steady-state region, then
     sample emit latency with a drained queue (up to ``latency_samples``
-    samples within ``latency_budget_s``, at least 5)."""
+    samples within ``latency_budget_s``, at least 5).
+
+    With ``obs`` attached, the pipeline's driver hooks record per-interval
+    step latency + ingest counters, the harness phases record spans, and
+    the structured export lands in the result's ``metrics`` section."""
     import jax
 
     from ..core.windows import SessionWindow
+
+    _span = obs.span if obs is not None else (
+        lambda name: contextlib.nullcontext())
 
     max_span = max(int(w.gap) if isinstance(w, SessionWindow)
                    else w.clear_delay() for w in pipeline.windows)
@@ -122,17 +133,25 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
     max_period = max(_trigger_horizon(w) for w in pipeline.windows)
     timed = max(timed, -(-max_period // pipeline.wm_period_ms) + 1)
 
-    pipeline.reset()
-    if hasattr(pipeline, "prefill"):
-        pipeline.prefill(warmup)       # ring fill without the query cost
-    else:
-        pipeline.run(warmup, collect=False)
-    pipeline.sync()
+    with _span("warmup"):
+        pipeline.reset()
+        if hasattr(pipeline, "prefill"):
+            pipeline.prefill(warmup)   # ring fill without the query cost
+        else:
+            pipeline.run(warmup, collect=False)
+        pipeline.sync()
 
+    if obs is not None:
+        # attach AFTER warmup: warmup tuples must not pollute the counters,
+        # and the rate denominator restarts so *_per_s reflects the
+        # measured region, not compile/warmup wall time
+        pipeline.set_observability(obs)
+        obs.registry.reset_clock()
     timed_from = getattr(pipeline, "_interval", warmup)
     t0 = time.perf_counter()
-    outs = pipeline.run(timed, collect=True)
-    pipeline.sync()
+    with _span("timed"):
+        outs = pipeline.run(timed, collect=True)
+        pipeline.sync()
     wall = time.perf_counter() - t0
 
     cnts = jax.device_get([o[2] for o in outs])
@@ -155,17 +174,24 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         # dense aggs: [T, w<=2] payloads are already small — a jitted
         # identity would only add a dispatch per sample
         emit_payload = lambda cnt, results: (cnt, results)  # noqa: E731
+    if obs is not None:
+        # the timed region is over: freeze the rate denominator and detach
+        # the per-interval hooks so the drained latency phase (up to 45 s
+        # of syncs) neither dilutes *_per_s nor inflates the counters
+        obs.registry.stop_clock()
+        pipeline.set_observability(None)
     lats = []
     t_lat = time.perf_counter()
-    for _ in range(latency_samples):
-        pipeline.sync()
-        t1 = time.perf_counter()
-        out = pipeline.run(1)[0]
-        jax.device_get(emit_payload(out[2], out[3]))
-        lats.append((time.perf_counter() - t1) * 1e3)
-        if (len(lats) >= LATENCY_SAMPLES_MIN
-                and time.perf_counter() - t_lat > latency_budget_s):
-            break
+    with _span("latency"):
+        for _ in range(latency_samples):
+            pipeline.sync()
+            t1 = time.perf_counter()
+            out = pipeline.run(1)[0]
+            jax.device_get(emit_payload(out[2], out[3]))
+            lats.append((time.perf_counter() - t1) * 1e3)
+            if (len(lats) >= LATENCY_SAMPLES_MIN
+                    and time.perf_counter() - t_lat > latency_budget_s):
+                break
     pipeline.check_overflow()
 
     if hasattr(pipeline, "tuples_in_range"):
@@ -185,6 +211,7 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
     # stall can never masquerade as an engine latency
     for k, v in latency_stats(lats).items():
         setattr(res, k, v)
+    finalize_observability(res, obs, lats, emitted)
     # tunnel-independent emit latency (VERDICT r3 item 9): the fused step
     # computes an interval's window results within the same device program
     # that ingests it, so the steady-state per-interval device time IS the
@@ -196,10 +223,15 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
 
 
 def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
-             engine: str) -> BenchResult:
-    """One (windowConfiguration × engine × aggFunction) cell."""
+             engine: str,
+             collect_metrics: bool = True) -> BenchResult:
+    """One (windowConfiguration × engine × aggFunction) cell. Unless
+    ``collect_metrics=False``, a fresh per-cell
+    :class:`scotty_tpu.obs.Observability` rides the run and its export is
+    embedded in the result (``metrics`` section)."""
     windows = parse_window_spec(window_spec, seed=cfg.seed)
     engine = {"Slicing": "TpuEngine", "Flink": "Buckets"}.get(engine, engine)
+    obs = _obs.Observability() if collect_metrics else None
 
     if engine == "TpuEngine":
         if not cfg.session_config:
@@ -219,7 +251,7 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
                     gc_every=32, out_of_order_pct=cfg.out_of_order_pct)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
-                                          "aligned")
+                                          "aligned", obs=obs)
             except NotImplementedError:
                 pass
             try:
@@ -236,7 +268,7 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
                     out_of_order_pct=cfg.out_of_order_pct)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
-                                          "count-fused")
+                                          "count-fused", obs=obs)
             except NotImplementedError:
                 pass
             try:
@@ -251,7 +283,7 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
                     out_of_order_pct=cfg.out_of_order_pct)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
-                                          "fused")
+                                          "fused", obs=obs)
             except NotImplementedError:
                 pass
         # count-measure / session specs: batch-at-a-time device operator
@@ -259,7 +291,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         # late sub-batches). Anything the fused pipelines reject pays
         # per-batch dispatch overhead (~5-15 ms each on tunneled devices —
         # docs/DESIGN.md), so the pipelines above are always preferred.
-        return run_benchmark(cfg, window_spec, agg_name, engine="TpuEngine")
+        return run_benchmark(cfg, window_spec, agg_name, engine="TpuEngine",
+                             obs=obs, collect_metrics=collect_metrics)
 
     if engine == "Buckets":
         from .buckets import BucketWindowPipeline
@@ -274,7 +307,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             windows, [make_aggregation(agg_name)], throughput=tp,
             wm_period_ms=cfg.watermark_period_ms, seed=cfg.seed,
             max_lateness=cfg.max_lateness)
-        return _run_pipeline_cell(p, cfg, window_spec, agg_name, "buckets")
+        return _run_pipeline_cell(p, cfg, window_spec, agg_name, "buckets",
+                                  obs=obs)
 
     if engine == "Hybrid":
         # resolve the backend the way HybridWindowOperator would, then use
@@ -303,30 +337,35 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                         max_lateness=cfg.max_lateness, seed=cfg.seed,
                         session_config=cfg.session_config)
                     return _run_pipeline_cell(p, cfg, window_spec,
-                                              agg_name, "session")
+                                              agg_name, "session", obs=obs)
                 except NotImplementedError:
                     pass
             return run_benchmark(cfg, window_spec, agg_name,
-                                 engine="TpuEngine")
-        return run_benchmark(cfg, window_spec, agg_name, engine="Hybrid")
+                                 engine="TpuEngine", obs=obs,
+                                 collect_metrics=collect_metrics)
+        return run_benchmark(cfg, window_spec, agg_name, engine="Hybrid",
+                             obs=obs, collect_metrics=collect_metrics)
 
     if engine == "Simulator":
-        return run_benchmark(cfg, window_spec, agg_name, engine="Simulator")
+        return run_benchmark(cfg, window_spec, agg_name, engine="Simulator",
+                             obs=obs, collect_metrics=collect_metrics)
 
     if engine == "Keyed":
-        return run_keyed_cell(cfg, window_spec, agg_name)
+        return run_keyed_cell(cfg, window_spec, agg_name, obs=obs)
 
     if engine == "HostFed":
-        return run_host_fed_cell(cfg, window_spec, agg_name)
+        return run_host_fed_cell(cfg, window_spec, agg_name, obs=obs)
 
     if engine == "KeyedHostFed":
-        return run_keyed_host_fed_cell(cfg, window_spec, agg_name)
+        return run_keyed_host_fed_cell(cfg, window_spec, agg_name, obs=obs)
 
     raise ValueError(f"unknown engine {engine!r}")
 
 
 def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
-                      agg_name: str) -> BenchResult:
+                      agg_name: str,
+                      obs: Optional[_obs.Observability] = None
+                      ) -> BenchResult:
     """Host-fed cell (SURVEY.md §7 stage 7): tuples originate in HOST
     memory as pre-packed (ts-delta u32, value f32) batches; the timed
     region covers host→device transfer + unpack + ingest + watermarks via
@@ -374,6 +413,11 @@ def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     warm_wm = packed[1][4] + 1
     op.process_watermark_async(warm_wm)
     jax.device_get(op._state.n_slices)
+    if obs is not None:
+        # attach AFTER warmup: warmup tuples must not pollute the counters,
+        # and the rate denominator restarts at the measured region
+        op.set_observability(obs)
+        obs.registry.reset_clock()
 
     # timed region: pure pipelined flow (no syncs — emit latency is
     # sampled in a separate drained phase below, like _run_pipeline_cell)
@@ -398,6 +442,9 @@ def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     op.check_overflow()
     wall = time.perf_counter() - t0
     n_tuples = (n_batches - 2) * B
+    if obs is not None:
+        obs.registry.stop_clock()       # rates cover the timed region only
+        op.set_observability(None)      # latency replays are not ingest
 
     # drained emit-latency samples: one packed batch + watermark each,
     # transfer included (that IS the host-fed delivery path). The first
@@ -440,11 +487,14 @@ def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     res.link_saturation = res.link_mbps_achieved / max(link_mbps, 1e-9)
     res.n_lat_samples = len(lats)
     res.p50_emit_ms = float(np.percentile(lats, 50))
+    finalize_observability(res, obs, lats, emitted)
     return res
 
 
 def run_keyed_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
-                            agg_name: str) -> BenchResult:
+                            agg_name: str,
+                            obs: Optional[_obs.Observability] = None
+                            ) -> BenchResult:
     """Keyed host-fed cell (VERDICT r3 item 7): (key, value, ts) records
     originate in HOST memory, pack into padded ``[K, Bk]`` rounds
     (``KeyedHostFeed`` — one vectorized argsort per round) and cross the
@@ -493,6 +543,8 @@ def run_keyed_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     warm_wm = packed[1][5] + 1
     op.process_watermark_async(warm_wm)
     jax.device_get(op._state.n_slices)
+    if obs is not None:
+        obs.registry.reset_clock()      # rates start at the timed region
 
     next_wm = (warm_wm // cfg.watermark_period_ms + 1) \
         * cfg.watermark_period_ms
@@ -515,6 +567,8 @@ def run_keyed_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     op.check_overflow()
     wall = time.perf_counter() - t0
     n_tuples = (n_rounds - 2) * N
+    if obs is not None:
+        obs.registry.stop_clock()       # rates cover the timed region only
 
     # drained emit-latency samples (transfer included — that IS the
     # keyed host-fed delivery path); first round replayed time-shifted
@@ -553,11 +607,13 @@ def run_keyed_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     res.link_saturation = res.link_mbps_achieved / max(link_mbps, 1e-9)
     res.n_lat_samples = len(lats)
     res.p50_emit_ms = float(np.percentile(lats, 50)) if lats else 0.0
+    finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
     return res
 
 
 def run_keyed_cell(cfg: BenchmarkConfig, window_spec: str,
-                   agg_name: str) -> BenchResult:
+                   agg_name: str,
+                   obs: Optional[_obs.Observability] = None) -> BenchResult:
     """Keyed-throughput cell: ``cfg.n_keys`` independent keyed operators as
     one batched device program (the reference's keyBy scaling model,
     KeyedScottyWindowOperator.java:56-66 — there a HashMap of JVM objects,
@@ -583,14 +639,18 @@ def run_keyed_cell(cfg: BenchmarkConfig, window_spec: str,
                                 min_trigger_pad=32),
             throughput=cfg.throughput, wm_period_ms=cfg.watermark_period_ms,
             max_lateness=cfg.max_lateness, seed=cfg.seed)
-        return _run_pipeline_cell(p, cfg, window_spec, agg_name, "keyed")
+        return _run_pipeline_cell(p, cfg, window_spec, agg_name, "keyed",
+                                  obs=obs)
     except NotImplementedError:
         pass
-    return _run_keyed_rounds_cell(cfg, windows, window_spec, agg_name)
+    return _run_keyed_rounds_cell(cfg, windows, window_spec, agg_name,
+                                  obs=obs)
 
 
 def _run_keyed_rounds_cell(cfg: BenchmarkConfig, windows, window_spec: str,
-                           agg_name: str) -> BenchResult:
+                           agg_name: str,
+                           obs: Optional[_obs.Observability] = None
+                           ) -> BenchResult:
     """Round-driven keyed fallback for specs the fused keyed pipeline
     rejects: device-generated [K, B] rounds through
     KeyedTpuWindowOperator.ingest_device_round (pays per-round dispatch
@@ -642,6 +702,8 @@ def _run_keyed_rounds_cell(cfg: BenchmarkConfig, windows, window_spec: str,
     feed_interval(0)
     op.process_watermark_arrays(cfg.watermark_period_ms)
     jax.device_get(op._state.n_slices[0])
+    if obs is not None:
+        obs.registry.reset_clock()      # rates start at the timed region
 
     lats: list = []
     emitted = 0
@@ -665,26 +727,46 @@ def _run_keyed_rounds_cell(cfg: BenchmarkConfig, windows, window_spec: str,
     op.check_overflow()
     wall = time.perf_counter() - t0
     n_tuples = cfg.runtime_s * rounds_per_wm * tuples_per_round
-    return BenchResult(
+    if obs is not None:
+        obs.registry.stop_clock()       # rates cover the timed region only
+    res = BenchResult(
         name=cfg.name, windows=window_spec, aggregation=agg_name,
         tuples_per_sec=n_tuples / wall,
         p99_emit_ms=float(np.percentile(lats, 99)) if lats else 0.0,
         n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
+    return res
 
 
 def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
-               echo=print) -> List[dict]:
-    """All cells of one config; writes result_<name>.json."""
+               echo=print, collect_metrics: bool = True,
+               obs_dir: Optional[str] = None) -> List[dict]:
+    """All cells of one config; writes result_<name>.json (each cell row
+    carries a ``metrics`` section unless ``collect_metrics=False``). With
+    ``obs_dir``, additionally exports a per-config JSONL time series (one
+    snapshot row per cell — ``python -m scotty_tpu.obs report`` summarizes
+    it) and per-cell Chrome-trace span files."""
     rows = []
+    cell_idx = 0
     rtt_floor = round(measure_rtt_floor(), 2)
     echo(f"  (drained device->host round-trip floor: {rtt_floor} ms — "
          "lower-bounds every emit-latency sample)")
+    if obs_dir and not collect_metrics:
+        echo("  (--obs-dir ignored: observability is disabled)")
+        obs_dir = None
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        # truncate: result_<name>.json is overwritten per run, so the
+        # sibling JSONL must not accumulate stale rows across runs
+        open(os.path.join(obs_dir, f"metrics_{cfg.name}.jsonl"),
+             "w").close()
     for window_spec in (cfg.window_configurations or ["Tumbling(1000)"]):
         for engine in cfg.configurations:
             for agg_name in cfg.agg_functions:
                 t0 = time.perf_counter()
                 try:
-                    res = run_cell(cfg, window_spec, agg_name, engine)
+                    res = run_cell(cfg, window_spec, agg_name, engine,
+                                   collect_metrics=collect_metrics)
                 except Exception as e:        # one bad cell must not void
                     rows.append({              # the already-computed ones
                         "name": cfg.name, "windows": window_spec,
@@ -705,6 +787,15 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)
+                cell_obs = getattr(res, "observability", None)
+                if obs_dir and cell_obs is not None:
+                    label = f"{window_spec}|{engine}|{agg_name}"
+                    cell_obs.write_jsonl(
+                        os.path.join(obs_dir, f"metrics_{cfg.name}.jsonl"),
+                        label=label)
+                    cell_obs.write_chrome_trace(os.path.join(
+                        obs_dir, f"trace_{cfg.name}_{cell_idx}.json"))
+                cell_idx += 1
                 echo(f"  {window_spec:28s} {engine:10s} {agg_name:8s} "
                      f"{res.tuples_per_sec:15,.0f} t/s  "
                      f"p99={res.p99_emit_ms:8.1f} ms  "
@@ -714,6 +805,9 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
     with open(path, "w") as f:
         json.dump(rows, f, indent=1)
     echo(f"  -> {path}")
+    if obs_dir:
+        echo(f"  -> {obs_dir}/metrics_{cfg.name}.jsonl (summarize with "
+             f"`python -m scotty_tpu.obs report`)")
     return rows
 
 
@@ -734,6 +828,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("configs", nargs="*",
                     help="JSON config paths (default: bundled configs)")
     ap.add_argument("--out-dir", default="bench_results")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="export per-config JSONL metrics time series + "
+                         "per-cell Chrome-trace span files into DIR")
+    ap.add_argument("--no-obs", action="store_true",
+                    help="disable observability entirely (no metrics "
+                         "section in results; the overhead A/B baseline)")
     args = ap.parse_args(argv)
 
     paths = args.configs
@@ -745,5 +845,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     for path in paths:
         cfg = load_config(path)
         print(f"== {cfg.name} ({path})")
-        run_config(cfg, out_dir=args.out_dir)
+        run_config(cfg, out_dir=args.out_dir,
+                   collect_metrics=not args.no_obs, obs_dir=args.obs_dir)
     return 0

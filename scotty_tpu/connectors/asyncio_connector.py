@@ -4,6 +4,12 @@ The streaming-Python analogue of the reference's push-based engine
 connectors (Samza StreamTask / Kafka Processor callbacks, SURVEY.md §2.4):
 an async task consumes ``(key, value, ts)`` items from an ``asyncio.Queue``
 or async iterator and emits window results to a callback as watermarks fire.
+
+Telemetry: pass an :class:`scotty_tpu.obs.Observability` to record
+connector-side ingest metrics — ``ingest_tuples``/``windows_emitted`` in
+:func:`run_keyed_async`, the source ``queue_depth`` gauge in
+:func:`queue_source`. The registry is thread-safe, so a producer thread
+filling the queue and the consumer task share one registry safely.
 """
 
 from __future__ import annotations
@@ -11,6 +17,7 @@ from __future__ import annotations
 import asyncio
 from typing import AsyncIterator, Awaitable, Callable, Optional, Tuple
 
+from .. import obs as _obs
 from .base import KeyedScottyWindowOperator
 
 
@@ -18,20 +25,32 @@ async def run_keyed_async(
         source: AsyncIterator[Tuple],
         operator: KeyedScottyWindowOperator,
         emit: Callable[[Tuple], Optional[Awaitable]],
+        obs=None,
 ) -> None:
     """Consume (key, value, ts) from an async iterator; call ``emit`` for
-    every (key, AggregateWindow) result. ``emit`` may be sync or async."""
+    every (key, AggregateWindow) result. ``emit`` may be sync or async.
+    ``obs`` defaults to the operator's attached Observability (metrics are
+    then recorded by the operator itself — no double counting)."""
+    own_obs = obs if obs is not None and obs is not operator.obs else None
     async for key, value, ts in source:
-        for item in operator.process_element(key, value, int(ts)):
+        items = operator.process_element(key, value, int(ts))
+        if own_obs is not None:
+            own_obs.counter(_obs.INGEST_TUPLES).inc()
+            if items:
+                own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
+        for item in items:
             r = emit(item)
             if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
                 await r
 
 
-async def queue_source(queue: "asyncio.Queue", sentinel=None):
+async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None):
     """Adapt an asyncio.Queue into an async iterator (terminates on
-    ``sentinel``)."""
+    ``sentinel``). With ``obs``, the queue depth is recorded as a gauge
+    per item — backpressure made visible."""
     while True:
+        if obs is not None:
+            obs.gauge(_obs.QUEUE_DEPTH).set(queue.qsize())
         item = await queue.get()
         if item is sentinel:
             return

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
+from .. import obs as _obs
 from ..core.aggregates import AggregateFunction
 from ..core.operator import AggregateWindow
 from ..core.windows import Window
@@ -35,6 +36,13 @@ class WatermarkPolicy:
 
     def observe(self, ts: int) -> Optional[int]:
         raise NotImplementedError
+
+    def current_watermark(self) -> Optional[int]:
+        """The last watermark this policy advanced to (None before the
+        first) — connector telemetry uses it to flag tuples that arrive
+        already older than ``watermark - allowed_lateness`` (the operator
+        will not repair them)."""
+        return None
 
 
 class AscendingWatermarks(WatermarkPolicy):
@@ -54,6 +62,9 @@ class AscendingWatermarks(WatermarkPolicy):
             return wm
         return None
 
+    def current_watermark(self) -> Optional[int]:
+        return self.current if self.current >= 0 else None
+
 
 class PeriodicWatermarks(WatermarkPolicy):
     """Event-time tick: fire when the stream has advanced ``period`` ms past
@@ -64,6 +75,7 @@ class PeriodicWatermarks(WatermarkPolicy):
     def __init__(self, period: int = 1000):
         self.period = period
         self.last = -1
+        self._fired = False
 
     def observe(self, ts: int) -> Optional[int]:
         if self.last == -1:
@@ -71,8 +83,15 @@ class PeriodicWatermarks(WatermarkPolicy):
             return None
         if ts > self.last + self.period:
             self.last = ts
+            self._fired = True
             return ts
         return None
+
+    def current_watermark(self) -> Optional[int]:
+        # before the first FIRED watermark, `last` is just the first
+        # element's ts — not a watermark; the contract says None until one
+        # actually advanced
+        return self.last if self._fired else None
 
 
 class KeyedScottyWindowOperator:
@@ -90,7 +109,8 @@ class KeyedScottyWindowOperator:
                  watermark_policy: Optional[WatermarkPolicy] = None,
                  backend: str = "host",
                  n_key_shards: int = 64,
-                 engine_config=None):
+                 engine_config=None,
+                 obs=None):
         self.windows: List[Window] = list(windows or [])
         self.aggregations: List[AggregateFunction] = list(aggregations or [])
         # reference default allowedLateness = 1 ms
@@ -100,6 +120,7 @@ class KeyedScottyWindowOperator:
         self.backend = backend
         self.n_key_shards = n_key_shards
         self.engine_config = engine_config
+        self.obs = obs                      # scotty_tpu.obs.Observability
         self._host_ops: Dict[Hashable, Any] = {}
         self._key_lanes: Dict[Hashable, int] = {}
         self._lane_keys: List[Hashable] = []
@@ -167,6 +188,14 @@ class KeyedScottyWindowOperator:
                         ) -> List[Tuple[Hashable, AggregateWindow]]:
         """Feed one tuple; returns window results if this tuple's ts advanced
         the watermark (the connector emit path)."""
+        if self.obs is not None:
+            self.obs.counter(_obs.INGEST_TUPLES).inc()
+            wm_cur = self.policy.current_watermark()
+            if wm_cur is not None \
+                    and ts + self.allowed_lateness < wm_cur:
+                # older than watermark - lateness: the operator will not
+                # repair it — surfaced here so silent loss is visible
+                self.obs.counter(_obs.DROPPED_TUPLES).inc()
         if self.backend == "device":
             self._device().process_element(self._lane_for_key(key), value, ts)
         else:
@@ -188,6 +217,10 @@ class KeyedScottyWindowOperator:
                 for w in op.process_watermark(wm):
                     if w.has_value():      # emit contract: non-empty only
                         out.append((key, w))
+        if self.obs is not None:
+            self.obs.counter(_obs.WATERMARKS).inc()
+            if out:
+                self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
         return out
 
 
@@ -201,7 +234,8 @@ class GlobalScottyWindowOperator:
                  watermark_policy: Optional[WatermarkPolicy] = None,
                  backend: str = "host",
                  n_shards: int = 8,
-                 engine_config=None):
+                 engine_config=None,
+                 obs=None):
         self.windows = list(windows or [])
         self.aggregations = list(aggregations or [])
         self.allowed_lateness = allowed_lateness
@@ -209,6 +243,7 @@ class GlobalScottyWindowOperator:
         self.backend = backend
         self.n_shards = n_shards
         self.engine_config = engine_config
+        self.obs = obs
         self._op = None
 
     def add_window(self, window: Window) -> "GlobalScottyWindowOperator":
@@ -238,6 +273,12 @@ class GlobalScottyWindowOperator:
         return self._op
 
     def process_element(self, value: Any, ts: int) -> List[AggregateWindow]:
+        if self.obs is not None:
+            self.obs.counter(_obs.INGEST_TUPLES).inc()
+            wm_cur = self.policy.current_watermark()
+            if wm_cur is not None \
+                    and ts + self.allowed_lateness < wm_cur:
+                self.obs.counter(_obs.DROPPED_TUPLES).inc()
         self._operator().process_element(value, ts)
         wm = self.policy.observe(ts)
         if wm is not None:
@@ -245,5 +286,10 @@ class GlobalScottyWindowOperator:
         return []
 
     def process_watermark(self, wm: int) -> List[AggregateWindow]:
-        return [w for w in self._operator().process_watermark(wm)
-                if w.has_value()]
+        out = [w for w in self._operator().process_watermark(wm)
+               if w.has_value()]
+        if self.obs is not None:
+            self.obs.counter(_obs.WATERMARKS).inc()
+            if out:
+                self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
+        return out

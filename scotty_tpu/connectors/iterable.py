@@ -4,43 +4,62 @@
 Plays the role the reference's per-engine demo sources play for manual
 validation (SURVEY.md §2.6 DemoSource); also the building block the asyncio /
 torchdata adapters reduce to.
+
+Telemetry: when the operator carries an attached
+:class:`scotty_tpu.obs.Observability` it records ingest metrics itself; the
+optional ``obs`` parameter here covers the bare-operator case (tuples
+accepted + windows emitted at the connector boundary) without double
+counting.
 """
 
 from __future__ import annotations
 
 from typing import Iterable, Iterator, List, Tuple
 
+from .. import obs as _obs
 from .base import GlobalScottyWindowOperator, KeyedScottyWindowOperator
 
 
-def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator
-              ) -> Iterator[Tuple]:
+def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
+              obs=None) -> Iterator[Tuple]:
     """Drive a keyed operator from an iterable of (key, value, ts); yields
     (key, AggregateWindow) results as watermarks fire."""
+    own_obs = obs if obs is not None and obs is not operator.obs else None
     for key, value, ts in source:
-        for item in operator.process_element(key, value, int(ts)):
+        items = operator.process_element(key, value, int(ts))
+        if own_obs is not None:
+            own_obs.counter(_obs.INGEST_TUPLES).inc()
+            if items:
+                own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
+        for item in items:
             yield item
 
 
-def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator
-               ) -> Iterator:
+def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
+               obs=None) -> Iterator:
     """Drive a global operator from an iterable of (value, ts)."""
+    own_obs = obs if obs is not None and obs is not operator.obs else None
     for value, ts in source:
-        for item in operator.process_element(value, int(ts)):
+        items = operator.process_element(value, int(ts))
+        if own_obs is not None:
+            own_obs.counter(_obs.INGEST_TUPLES).inc()
+            if items:
+                own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
+        for item in items:
             yield item
 
 
 def collect_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
-                  final_watermark: int | None = None) -> List[Tuple]:
-    out = list(run_keyed(source, operator))
+                  final_watermark: int | None = None, obs=None) -> List[Tuple]:
+    out = list(run_keyed(source, operator, obs=obs))
     if final_watermark is not None:
         out.extend(operator.process_watermark(final_watermark))
     return out
 
 
 def collect_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
-                   final_watermark: int | None = None) -> List:
-    out = list(run_global(source, operator))
+                   final_watermark: int | None = None, obs=None) -> List:
+    out = list(run_global(source, operator, obs=obs))
     if final_watermark is not None:
         out.extend(operator.process_watermark(final_watermark))
     return out

@@ -39,9 +39,14 @@ class KafkaScottyWindowOperator:
 
     def __init__(self, operator: Optional[KeyedScottyWindowOperator] = None,
                  deserialize: Callable = _default_deserialize,
-                 watermark_period_ms: int = 100):
+                 watermark_period_ms: int = 100,
+                 obs=None):
         self.operator = operator or KeyedScottyWindowOperator(
-            watermark_policy=PeriodicWatermarks(watermark_period_ms))
+            watermark_policy=PeriodicWatermarks(watermark_period_ms),
+            obs=obs)
+        if obs is not None and self.operator.obs is None:
+            # a caller-supplied operator still gets the requested telemetry
+            self.operator.obs = obs
         self.deserialize = deserialize
 
     def run(self, consumer: Iterable, on_result: Callable[[Tuple], None],

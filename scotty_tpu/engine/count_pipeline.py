@@ -81,6 +81,7 @@ from typing import NamedTuple, Optional, Sequence
 import numpy as np
 
 from .. import jax_config  # noqa: F401
+from .. import obs as _obs
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import (
@@ -457,16 +458,30 @@ class CountStreamPipeline(FusedPipelineDriver):
         self._interval = 0
 
     # -- driver hooks ------------------------------------------------------
+    #: the anchor is the overflow flag, not a slice count — the driver's
+    #: occupancy gauges don't apply to the fixed [W, cap] row ring
+    _anchor_is_slices = False
+
     def _init_pipeline_state(self) -> None:
         self.state = self._init()
 
     def _sync_anchor(self):
         return self.state.overflow
 
+    def _interval_tuples(self, i: int) -> int:
+        """Telemetry: intervals before the late reach warms up (i < q)
+        carry only the in-order stream plus the partial late strata."""
+        if self.obs is not None and self.L:
+            self.obs.counter(_obs.LATE_TUPLES).inc(
+                self.E * min(i, self.q) * self.wm_period_ms)
+        return self.SR + self.E * min(i, self.q) * self.wm_period_ms
+
     def check_overflow(self) -> None:
         import jax
 
         if bool(jax.device_get(self.state.overflow)):
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
             raise RuntimeError(
                 "count row-window underrun: a trigger reached below the "
                 "retained per-ms rows — widen the retention model "

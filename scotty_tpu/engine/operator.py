@@ -21,10 +21,12 @@ reference (SliceFactory.java:17-22).
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
+from .. import obs as _obs
 from ..core.aggregates import AggregateFunction
 from ..core.operator import AggregateWindow, WindowOperator
 from ..core.windows import (
@@ -246,8 +248,9 @@ class TpuWindowOperator(WindowOperator):
     """
 
     def __init__(self, state_factory: Optional[StateFactory] = None,
-                 config: Optional[EngineConfig] = None):
+                 config: Optional[EngineConfig] = None, obs=None):
         self.config = config or EngineConfig()
+        self.obs = obs                      # scotty_tpu.obs.Observability
         self.windows: List[ContextFreeWindow] = []
         self.aggregations: List[AggregateFunction] = []
         self.max_lateness = 1000            # WindowManager.java:24 default
@@ -372,6 +375,17 @@ class TpuWindowOperator(WindowOperator):
 
     def set_max_lateness(self, max_lateness: int) -> None:
         self.max_lateness = max_lateness
+
+    def set_observability(self, obs) -> None:
+        """Attach an :class:`scotty_tpu.obs.Observability` (None detaches).
+        All hooks are host-side at batch/watermark boundaries — the jitted
+        kernels are untouched: ``ingest_tuples``/``ingest_batch_size`` on
+        ingest, ``late_tuples`` when a batch reaches below the stream's
+        max event time, ``watermarks``/``watermark_lag_ms``/
+        ``watermark_dispatch_ms`` per watermark, ``overflows`` on overflow,
+        ``slice_occupancy``/``slice_headroom`` at the
+        :meth:`check_overflow` sync point."""
+        self.obs = obs
 
     # -- build -------------------------------------------------------------
     def _compute_spec(self):
@@ -543,6 +557,9 @@ class TpuWindowOperator(WindowOperator):
         tss = np.asarray(timestamps, dtype=np.int64).reshape(-1)
         if vals.shape != tss.shape:
             raise ValueError("elements/timestamps length mismatch")
+        if self.obs is not None:
+            self.obs.counter(_obs.INGEST_TUPLES).inc(vals.shape[0])
+            self.obs.histogram(_obs.INGEST_BATCH_SIZE).observe(vals.shape[0])
         self._pend_vals.append(vals)
         self._pend_ts.append(tss)
         self._n_pending += vals.shape[0]
@@ -566,6 +583,12 @@ class TpuWindowOperator(WindowOperator):
         self._n_pending -= take
 
         met_pre = self._host_met            # max event time BEFORE this batch
+        if self.obs is not None and take and met_pre is not None:
+            # late = below the stream's max event time at batch start
+            # (host-side count; the device late/annex path handles them)
+            n_below = int((batch_t[:take] < met_pre).sum())
+            if n_below:
+                self.obs.counter(_obs.LATE_TUPLES).inc(n_below)
         if take and self._host_first_ts is None:
             self._host_first_ts = int(batch_t[0])   # arrival order, pre-sort
         intra_ooo = take > 1 and not bool(
@@ -947,6 +970,9 @@ class TpuWindowOperator(WindowOperator):
                 self._ctx_states[i] = kern(self._ctx_states[i], ts, vals,
                                            valid)
             if not self._has_grid:
+                if self.obs is not None:        # pure-context ingest done
+                    self.obs.counter(_obs.INGEST_TUPLES).inc(n)
+                    self.obs.histogram(_obs.INGEST_BATCH_SIZE).observe(n)
                 self._host_met = ts_max if self._host_met is None \
                     else max(self._host_met, ts_max)
                 self._host_min_ts = ts_min if self._host_min_ts is None \
@@ -966,6 +992,14 @@ class TpuWindowOperator(WindowOperator):
                     "out-of-order device batches with count-measure "
                     "windows need the host operator")
             self._annex_dirty = True
+        if self.obs is not None:
+            # past every reject guard: the batch is definitely ingested.
+            # Device-resident ts are opaque host-side, so a back-reaching
+            # batch counts whole as late.
+            self.obs.counter(_obs.INGEST_TUPLES).inc(n)
+            self.obs.histogram(_obs.INGEST_BATCH_SIZE).observe(n)
+            if has_late:
+                self.obs.counter(_obs.LATE_TUPLES).inc(n)
         if self._host_first_ts is None:
             self._host_first_ts = ts_min    # conservative (device ts opaque)
         self._host_met = ts_max if self._host_met is None \
@@ -997,6 +1031,9 @@ class TpuWindowOperator(WindowOperator):
             raise UnsupportedOnDevice(
                 "out-of-order device batches with count-measure, session "
                 "or context windows need the host operator")
+        if self.obs is not None:
+            self.obs.counter(_obs.INGEST_TUPLES).inc(n)
+            self.obs.counter(_obs.LATE_TUPLES).inc(n)
         self._annex_dirty = True
         self._host_met = ts_max if self._host_met is None \
             else max(self._host_met, ts_max)
@@ -1033,6 +1070,27 @@ class TpuWindowOperator(WindowOperator):
         after any GC, oldest ≤ gc bound < last watermark — and at that point
         the oldest slice start is exactly grid_start(min ts seen).
         """
+        obs = self.obs
+        if obs is None:
+            return self._process_watermark_dispatch(watermark_ts)
+        t0 = time.perf_counter()
+        out = self._process_watermark_dispatch(watermark_ts)
+        # host-side, interval-boundary telemetry: dispatch wall time (no
+        # device sync — delivery latency is the harness's emit_latency_ms),
+        # watermark count, and event-time lag of the watermark behind the
+        # stream head
+        obs.histogram(_obs.WATERMARK_DISPATCH_MS).observe(
+            (time.perf_counter() - t0) * 1e3)
+        obs.counter(_obs.WATERMARKS).inc()
+        if self._host_met is not None:
+            # floored at 0: a drain watermark deliberately runs past the
+            # stream end, and a last-value gauge stuck negative would make
+            # the headline lag metric meaningless for the whole run
+            obs.gauge(_obs.WATERMARK_LAG_MS).set(
+                max(0, self._host_met - watermark_ts))
+        return out
+
+    def _process_watermark_dispatch(self, watermark_ts: int):
         if not self._built:
             self._build()
         self._flush()
@@ -1226,6 +1284,8 @@ class TpuWindowOperator(WindowOperator):
 
     def _raise_if_overflow(self, ovf) -> None:
         if bool(ovf):
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
             raise RuntimeError(
                 "slice/session buffer overflow: raise EngineConfig.capacity "
                 "(slice rows, session rows) / annex_capacity (late annex & "
@@ -1245,6 +1305,16 @@ class TpuWindowOperator(WindowOperator):
             self._raise_if_overflow(st.overflow)
         for st in getattr(self, "_ctx_states", ()):
             self._raise_if_overflow(st.overflow)
+        if self.obs is not None and self._state is not None:
+            # this method is already a deliberate sync point, so the
+            # occupancy/headroom gauges can read the live slice count
+            # without introducing a new device round trip
+            import jax
+
+            n = int(jax.device_get(self._state.n_slices))
+            cap = self.config.capacity
+            self.obs.gauge(_obs.SLICE_OCCUPANCY).set(n / cap)
+            self.obs.gauge(_obs.SLICE_HEADROOM).set(cap - n)
 
     def _fetch_sessions(self, outs):
         """Fetch per-session-window sweep outputs; emission follows window

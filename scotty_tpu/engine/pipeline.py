@@ -22,11 +22,13 @@ SlidingWindow.java:50-57).
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .. import jax_config  # noqa: F401
+from .. import obs as _obs
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import (
@@ -250,6 +252,28 @@ class FusedPipelineDriver:
     optionally ``_gc(bound)`` for out-of-step GC.
     """
 
+    #: attached Observability (scotty_tpu.obs) — None = zero-overhead off.
+    #: All hooks are HOST-side at interval boundaries; nothing enters the
+    #: jitted step.
+    obs = None
+    #: whether _sync_anchor() is the live-slice count (occupancy gauges);
+    #: pipelines whose anchor is something else (count pipeline: the
+    #: overflow flag) set this False
+    _anchor_is_slices = True
+
+    def set_observability(self, obs) -> None:
+        """Attach an :class:`scotty_tpu.obs.Observability`; pass ``None``
+        to detach. Telemetry recorded per interval: ``interval_step_ms``
+        histogram, ``ingest_tuples`` counter; per :meth:`sync`:
+        ``sync_ms`` histogram + ``slice_occupancy``/``slice_headroom``
+        gauges (sync is the drain point — the one place occupancy is
+        host-known without adding a device round trip)."""
+        self.obs = obs
+
+    def _interval_tuples(self, i: int) -> int:
+        """Host-known tuple count interval ``i`` ingests (telemetry)."""
+        return int(getattr(self, "tuples_per_interval", 0))
+
     def reset(self) -> None:
         import jax
 
@@ -282,11 +306,17 @@ class FusedPipelineDriver:
         handles. Dispatch only — no sync."""
         if self._needs_reset():
             self.reset()
+        obs = self.obs
         out = []
         for _ in range(n_intervals):
             i = self._interval
+            t0 = time.perf_counter() if obs is not None else 0.0
             res = self._step_interval(self._interval_key(i), i)
             self._interval += 1
+            if obs is not None:
+                obs.histogram(_obs.INTERVAL_STEP_MS).observe(
+                    (time.perf_counter() - t0) * 1e3)
+                obs.counter(_obs.INGEST_TUPLES).inc(self._interval_tuples(i))
             if collect:
                 out.append(res)
             if self._gc is not None and self._interval % self.gc_every == 0:
@@ -301,7 +331,17 @@ class FusedPipelineDriver:
         """Drain all queued device work; returns the anchor scalar."""
         import jax
 
-        return int(jax.device_get(self._sync_anchor()))
+        obs = self.obs
+        t0 = time.perf_counter() if obs is not None else 0.0
+        v = int(jax.device_get(self._sync_anchor()))
+        if obs is not None:
+            obs.histogram(_obs.SYNC_MS).observe(
+                (time.perf_counter() - t0) * 1e3)
+            cap = getattr(getattr(self, "config", None), "capacity", 0)
+            if self._anchor_is_slices and cap:
+                obs.gauge(_obs.SLICE_OCCUPANCY).set(v / cap)
+                obs.gauge(_obs.SLICE_HEADROOM).set(cap - v)
+        return v
 
 
 class StreamPipeline(FusedPipelineDriver):
@@ -443,6 +483,8 @@ class StreamPipeline(FusedPipelineDriver):
         import jax
 
         if bool(jax.device_get(self.state.overflow)):
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
             raise RuntimeError("slice buffer overflow: raise capacity or "
                                "advance watermarks more often")
 
@@ -1044,6 +1086,8 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         import jax
 
         if bool(jax.device_get(self.state.overflow)):
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
             raise RuntimeError("slice buffer overflow: raise capacity or "
                                "gc more often")
 

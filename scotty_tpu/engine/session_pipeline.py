@@ -44,6 +44,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from .. import jax_config  # noqa: F401
+from .. import obs as _obs
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import (
@@ -177,6 +178,10 @@ class SessionStreamPipeline(FusedPipelineDriver):
                 1 << max(4, (need_rows - 1).bit_length()))
         A = self.config.annex_capacity
         self.has_grid = bool(grid_windows)
+        # pure-session mode anchors the live-SESSION count, whose capacity
+        # is the session array's, not config.capacity — the driver's
+        # occupancy gauges would misreport headroom, so they stay off there
+        self._anchor_is_slices = self.has_grid
         spec = ec.EngineSpec(
             periods=(g,) if self.has_grid else (), bands=(),
             count_periods=(), aggs=aggs)
@@ -481,6 +486,16 @@ class SessionStreamPipeline(FusedPipelineDriver):
     def live(self, i: int) -> bool:
         return not bool(self._silent[i % self._horizon])
 
+    def _interval_tuples(self, i: int) -> int:
+        """Telemetry: silent intervals carry no tuples — counting them at
+        the flat per-interval rate would overstate ``ingest_tuples`` by
+        the silence fraction; count them (``silent_intervals``) instead."""
+        if not self.live(i):
+            if self.obs is not None:
+                self.obs.counter(_obs.SILENT_INTERVALS).inc()
+            return 0
+        return int(self.tuples_per_interval)
+
     def tuples_in_range(self, i0: int, i1: int) -> int:
         return sum(self.tuples_per_interval
                    for i in range(i0, i1) if self.live(i))
@@ -492,6 +507,8 @@ class SessionStreamPipeline(FusedPipelineDriver):
         if self.has_grid:
             flags.append(self.state.overflow)
         if any(bool(v) for v in jax.device_get(flags)):
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
             raise RuntimeError(
                 "slice/session buffer overflow: raise capacity")
 

@@ -1,0 +1,127 @@
+"""Structured observability: spans, engine/connector telemetry, exporters.
+
+The reference Scotty's only observability was a benchmark-side throughput
+logger plus a log-scraping AnalyzeTool (PAPER.md / SURVEY.md §5). This
+package replaces that split with a first-class subsystem:
+
+* :class:`Observability` — one :class:`~scotty_tpu.utils.metrics.MetricsRegistry`
+  plus one :class:`~scotty_tpu.obs.spans.SpanRecorder`, attachable to
+  operators (``TpuWindowOperator(obs=...)``), fused pipelines
+  (``pipeline.set_observability(obs)``), connectors
+  (``KeyedScottyWindowOperator(obs=...)``) and the bench harness
+  (``run_benchmark(..., obs=...)``).
+* exporters — JSONL time series, Prometheus text exposition, Chrome-trace
+  span dumps (:mod:`.exporters`).
+* ``python -m scotty_tpu.obs report <file>`` — summarize any export
+  (:mod:`.report`).
+
+Every hook is host-side and records at batch/interval boundaries — nothing
+enters a jitted code path, preserving the reference's silent-core
+discipline (the engine itself never prints; tier-1 enforces it).
+
+Stable metric-name contract (documented in README.md / docs/API.md):
+
+========================  ====================================================
+``ingest_tuples``         counter: tuples accepted (operator or connector)
+``ingest_batch_size``     histogram: tuples per host batch
+``late_tuples``           counter: tuples arriving below the stream's max ts
+``dropped_tuples``        counter: tuples older than watermark - lateness
+``watermarks``            counter: watermark advances
+``watermark_lag_ms``      gauge: max event time seen - watermark ts (>= 0)
+``watermark_dispatch_ms`` histogram: host time of one watermark dispatch
+``interval_step_ms``      histogram: host time of one fused interval step
+``sync_ms``               histogram: host time of a pipeline drain/sync
+``slice_occupancy``       gauge: live slices / capacity (at sync points)
+``slice_headroom``        gauge: capacity - live slices (at sync points)
+``queue_depth``           gauge: asyncio source queue depth
+``windows_emitted``       counter: non-empty windows delivered
+``overflows``             counter: buffer-overflow events detected
+``silent_intervals``      counter: session-pipeline intervals with no tuples
+``emit_latency_ms``       histogram: sampled dispatch→results-on-host time
+========================  ====================================================
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.metrics import MetricsRegistry
+from .exporters import JsonlExporter, prometheus_text, write_chrome_trace
+from .spans import Span, SpanRecorder
+
+# stable metric names (the contract above)
+INGEST_TUPLES = "ingest_tuples"
+INGEST_BATCH_SIZE = "ingest_batch_size"
+LATE_TUPLES = "late_tuples"
+DROPPED_TUPLES = "dropped_tuples"
+WATERMARKS = "watermarks"
+WATERMARK_LAG_MS = "watermark_lag_ms"
+WATERMARK_DISPATCH_MS = "watermark_dispatch_ms"
+INTERVAL_STEP_MS = "interval_step_ms"
+SYNC_MS = "sync_ms"
+SLICE_OCCUPANCY = "slice_occupancy"
+SLICE_HEADROOM = "slice_headroom"
+QUEUE_DEPTH = "queue_depth"
+WINDOWS_EMITTED = "windows_emitted"
+OVERFLOWS = "overflows"
+SILENT_INTERVALS = "silent_intervals"
+EMIT_LATENCY_MS = "emit_latency_ms"
+
+
+class Observability:
+    """One registry + span recorder, shared by every layer of a run.
+
+    ``annotate=True`` additionally opens a ``jax.profiler.TraceAnnotation``
+    per span, so the same phase names appear inside captured device traces
+    (:func:`scotty_tpu.utils.profiling.trace`).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 spans: Optional[SpanRecorder] = None,
+                 annotate: bool = False):
+        self.registry = registry or MetricsRegistry()
+        self.spans = spans or SpanRecorder(annotate=annotate)
+
+    # -- recording --------------------------------------------------------
+    def span(self, name: str):
+        return self.spans.span(name)
+
+    def counter(self, name: str):
+        return self.registry.counter(name)
+
+    def gauge(self, name: str):
+        return self.registry.gauge(name)
+
+    def histogram(self, name: str):
+        return self.registry.histogram(name)
+
+    # -- export -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        return self.registry.snapshot()
+
+    def export(self) -> dict:
+        """The structured artifact section: metrics snapshot + span
+        summary (what ``BenchResult.to_dict()`` embeds as ``metrics``)."""
+        return {"metrics": self.snapshot(), "spans": self.spans.summary()}
+
+    def write_jsonl(self, path, label: Optional[str] = None) -> dict:
+        """Append one snapshot row to a JSONL time-series file."""
+        with JsonlExporter(path) as ex:
+            return ex.write(self.registry, label=label)
+
+    def write_chrome_trace(self, path: str) -> None:
+        self.spans.dump_chrome_trace(path)
+
+    def prometheus(self, prefix: str = "scotty_") -> str:
+        return prometheus_text(self.registry, prefix=prefix)
+
+
+__all__ = [
+    "Observability", "MetricsRegistry", "SpanRecorder", "Span",
+    "JsonlExporter", "prometheus_text", "write_chrome_trace",
+    "INGEST_TUPLES", "INGEST_BATCH_SIZE", "LATE_TUPLES", "DROPPED_TUPLES",
+    "WATERMARKS", "WATERMARK_LAG_MS", "WATERMARK_DISPATCH_MS",
+    "INTERVAL_STEP_MS", "SYNC_MS", "SLICE_OCCUPANCY", "SLICE_HEADROOM",
+    "QUEUE_DEPTH", "WINDOWS_EMITTED", "OVERFLOWS", "SILENT_INTERVALS",
+    "EMIT_LATENCY_MS",
+]

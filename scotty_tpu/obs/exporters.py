@@ -1,0 +1,102 @@
+"""Metric exporters: JSONL time series, Prometheus text exposition, and
+Chrome-trace span dumps.
+
+These replace the reference's log-scraping flow (the ``AnalyzeTool`` that
+regexed ``"That's N elements/second"`` lines back out of stdout —
+benchmark/.../AnalyzeTool.java:12-63): the registry is the source of truth
+and exports are structured. ``python -m scotty_tpu.obs report <file>``
+(see :mod:`.report`) summarizes any JSONL export end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import IO, Optional, Union
+
+from ..utils.metrics import MetricsRegistry
+
+
+class JsonlExporter:
+    """Append-mode JSONL time-series writer: each :meth:`write` call emits
+    one line — a timestamped snapshot row — so a long run becomes a
+    greppable, plottable series. Rows carry ``t`` (unix seconds) and an
+    optional ``label`` (e.g. the bench cell name)."""
+
+    def __init__(self, path_or_file: Union[str, IO], append: bool = True):
+        if hasattr(path_or_file, "write"):
+            self._f = path_or_file
+            self._own = False
+            self.path = getattr(path_or_file, "name", None)
+        else:
+            self._f = open(path_or_file, "a" if append else "w")
+            self._own = True
+            self.path = path_or_file
+
+    def write(self, registry_or_snapshot, label: Optional[str] = None,
+              t: Optional[float] = None) -> dict:
+        """Write one row; accepts a registry (snapshotted here) or a
+        pre-built snapshot dict. Returns the row written."""
+        snap = (registry_or_snapshot.snapshot()
+                if isinstance(registry_or_snapshot, MetricsRegistry)
+                else dict(registry_or_snapshot))
+        row = {"t": time.time() if t is None else t}
+        if label is not None:
+            row["label"] = label
+        row.update(snap)
+        self._f.write(json.dumps(row, default=float) + "\n")
+        self._f.flush()
+        return row
+
+    def close(self) -> None:
+        if self._own:
+            self._f.close()
+
+    def __enter__(self) -> "JsonlExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    return prefix + _PROM_BAD.sub("_", name)
+
+
+def prometheus_text(registry: MetricsRegistry,
+                    prefix: str = "scotty_") -> str:
+    """Prometheus text exposition (version 0.0.4) snapshot of a registry:
+    counters as ``counter``, gauges as ``gauge``, histograms as ``summary``
+    with p50/p99 quantile samples plus ``_sum``/``_count``. Suitable for a
+    textfile-collector drop or a scrape handler body."""
+    lines = []
+    with registry._lock:
+        counters = dict(registry.counters)
+        gauges = dict(registry.gauges)
+        histograms = dict(registry.histograms)
+    for name, c in counters.items():
+        n = _prom_name(name, prefix)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {c.value}")
+    for name, g in gauges.items():
+        n = _prom_name(name, prefix)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {g.value}")
+    for name, h in histograms.items():
+        n = _prom_name(name, prefix)
+        lines.append(f"# TYPE {n} summary")
+        lines.append(f'{n}{{quantile="0.5"}} {h.percentile(50)}')
+        lines.append(f'{n}{{quantile="0.99"}} {h.percentile(99)}')
+        lines.append(f"{n}_sum {h.sum}")
+        lines.append(f"{n}_count {h.count}")
+    return "\n".join(lines) + "\n"
+
+
+def write_chrome_trace(recorder, path: str) -> None:
+    """Dump a :class:`~scotty_tpu.obs.spans.SpanRecorder`'s spans as a
+    Chrome-trace JSON file (open in chrome://tracing or Perfetto)."""
+    recorder.dump_chrome_trace(path)

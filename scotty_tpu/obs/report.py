@@ -1,0 +1,173 @@
+"""``python -m scotty_tpu.obs report <file>`` — summarize an exported
+metrics file.
+
+Replaces the reference's log-scraping AnalyzeTool flow
+(benchmark/.../AnalyzeTool.java:12-63, ported as
+``scotty_tpu.utils.profiling.analyze_log`` — now a deprecated fallback for
+pre-obs logs): instead of regexing throughput lines back out of stdout,
+this reads the structured exports and prints per-metric statistics.
+
+Accepted formats (sniffed, not flag-selected):
+
+* JSONL time series (``JsonlExporter`` output — one snapshot row per line)
+* bench result JSON (``bench_results/result_*.json`` — a list of cell rows,
+  each optionally carrying a ``metrics`` section)
+* Chrome-trace JSON (``SpanRecorder.dump_chrome_trace`` output)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def _stats(values: List[float]) -> dict:
+    n = len(values)
+    return {"n": n, "last": values[-1], "min": min(values),
+            "max": max(values), "mean": sum(values) / n}
+
+
+def summarize_rows(rows: List[dict]) -> dict:
+    """Per-numeric-key statistics across a list of snapshot rows."""
+    series: dict = {}
+    for row in rows:
+        for k, v in row.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                series.setdefault(k, []).append(float(v))
+    return {k: _stats(vs) for k, vs in sorted(series.items())}
+
+
+def summarize_jsonl(path: str) -> dict:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return {"kind": "jsonl", "rows": len(rows),
+            "metrics": summarize_rows(rows)}
+
+
+def summarize_trace(obj: dict) -> dict:
+    by_name: dict = {}
+    for ev in obj.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        row = by_name.setdefault(ev["name"], [])
+        row.append(float(ev.get("dur", 0.0)) / 1e3)   # µs -> ms
+    return {"kind": "chrome-trace",
+            "spans": {name: {"count": len(ds), "total_ms": sum(ds),
+                             "mean_ms": sum(ds) / len(ds),
+                             "max_ms": max(ds)}
+                      for name, ds in sorted(by_name.items())}}
+
+
+def summarize_bench_results(cells: List[dict]) -> dict:
+    out = {"kind": "bench-result", "cells": []}
+    for cell in cells:
+        row = {k: cell.get(k) for k in
+               ("name", "windows", "engine", "aggregation",
+                "tuples_per_sec", "p99_emit_ms", "error")
+               if k in cell}
+        m = cell.get("metrics")
+        if isinstance(m, dict):
+            row["metrics"] = m.get("metrics", m)
+            if "spans" in m:
+                row["spans"] = m["spans"]
+        out["cells"].append(row)
+    return out
+
+
+def summarize(path: str) -> dict:
+    """Sniff + summarize one exported metrics file (see module doc)."""
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "[":
+            return summarize_bench_results(json.load(f))
+        if head == "{":
+            try:
+                obj = json.load(f)
+            except json.JSONDecodeError:
+                # multiple lines of objects: a JSONL time series
+                return summarize_jsonl(path)
+            if "traceEvents" in obj:
+                return summarize_trace(obj)
+            # a single snapshot object: treat as a one-row series
+            return {"kind": "snapshot", "rows": 1,
+                    "metrics": summarize_rows([obj])}
+    return summarize_jsonl(path)
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return f"{int(v):,}"
+    return f"{v:,.3f}"
+
+
+def render(path: str, as_json: bool = False) -> str:
+    """Human-readable (or ``--json``) report for one exported file."""
+    summary = summarize(path)
+    if as_json:
+        return json.dumps(summary, indent=1, default=float)
+    lines = [f"{path} [{summary['kind']}]"]
+    if summary["kind"] in ("jsonl", "snapshot"):
+        lines.append(f"  rows: {summary['rows']}")
+        lines.append(f"  {'metric':32s} {'n':>6s} {'last':>14s} "
+                     f"{'mean':>14s} {'min':>14s} {'max':>14s}")
+        for name, st in summary["metrics"].items():
+            lines.append(
+                f"  {name:32s} {st['n']:6d} {_fmt(st['last']):>14s} "
+                f"{_fmt(st['mean']):>14s} {_fmt(st['min']):>14s} "
+                f"{_fmt(st['max']):>14s}")
+    elif summary["kind"] == "chrome-trace":
+        lines.append(f"  {'span':32s} {'count':>6s} {'total_ms':>12s} "
+                     f"{'mean_ms':>12s} {'max_ms':>12s}")
+        for name, st in summary["spans"].items():
+            lines.append(
+                f"  {name:32s} {st['count']:6d} {st['total_ms']:12.3f} "
+                f"{st['mean_ms']:12.3f} {st['max_ms']:12.3f}")
+    else:                                     # bench-result
+        for cell in summary["cells"]:
+            hdr = " ".join(str(cell.get(k, "")) for k in
+                           ("name", "windows", "engine", "aggregation"))
+            lines.append(f"  cell: {hdr}")
+            if "error" in cell:
+                lines.append(f"    ERROR {cell['error']}")
+                continue
+            if "tuples_per_sec" in cell and cell["tuples_per_sec"]:
+                lines.append(f"    tuples_per_sec: "
+                             f"{_fmt(cell['tuples_per_sec'])}")
+            m = cell.get("metrics")
+            if isinstance(m, dict):
+                for name in sorted(m):
+                    v = m[name]
+                    if isinstance(v, (int, float)):
+                        lines.append(f"    {name:30s} {_fmt(float(v)):>14s}")
+            sp = cell.get("spans")
+            if isinstance(sp, dict):
+                for name, st in sorted(sp.items()):
+                    if isinstance(st, dict):
+                        lines.append(
+                            f"    span {name:25s} count={st['count']:<5d} "
+                            f"total={st['total_ms']:.3f} ms")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m scotty_tpu.obs",
+        description="Observability tools: summarize exported metrics files")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser(
+        "report", help="summarize a JSONL/bench-result/Chrome-trace export")
+    rp.add_argument("file", help="path to the exported metrics file")
+    rp.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of the table")
+    args = ap.parse_args(argv)
+    if args.cmd == "report":
+        print(render(args.file, as_json=args.json))
+        return 0
+    return 2                                            # pragma: no cover

@@ -6,11 +6,19 @@ from .checkpoint import (
     save_engine_operator,
     save_host_operator,
 )
-from .metrics import REGISTRY, MetricsRegistry, ThroughputLogger
+from .metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    ThroughputLogger,
+)
 from .profiling import analyze_log, annotate, trace
 
 __all__ = [
-    "REGISTRY", "MetricsRegistry", "ThroughputLogger", "analyze_log",
+    "REGISTRY", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "ThroughputLogger", "analyze_log",
     "annotate", "trace", "restore_engine_operator", "restore_host_operator",
     "save_engine_operator", "save_host_operator",
 ]

@@ -4,80 +4,176 @@ SURVEY.md §5: the reference's only observability is the benchmark-side
 ThroughputLogger / ThroughputStatistics pair (benchmark/.../ThroughputLogger.java:24-49,
 ThroughputStatistics.java:3-44) and slf4j that the engine never uses — the
 engine core stays silent. Same split here: a small structured registry the
-harness/connectors write into; the engine itself logs nothing.
+harness/connectors write into; the engine itself logs nothing. The
+:mod:`scotty_tpu.obs` package builds the span/exporter/report layer on top
+of this registry.
+
+Thread-safety: one registry-wide re-entrant lock guards metric creation AND
+every mutation/read — the asyncio and kafka connectors can write from
+non-main threads, and a ``snapshot()`` racing a ``defaultdict`` mutation
+would otherwise see a half-built metric.
 """
 
 from __future__ import annotations
 
 import json
+import math
+import random
+import threading
 import time
-from collections import defaultdict
-from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 
-@dataclass
 class Counter:
-    value: float = 0.0
+    """Monotonic float counter."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: Optional[threading.RLock] = None):
+        self.value = 0.0
+        self._lock = lock or threading.RLock()
 
     def inc(self, delta: float = 1.0) -> None:
-        self.value += delta
+        with self._lock:
+            self.value += delta
 
 
-@dataclass
 class Gauge:
-    value: float = 0.0
+    """Last-value gauge."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: Optional[threading.RLock] = None):
+        self.value = 0.0
+        self._lock = lock or threading.RLock()
 
     def set(self, v: float) -> None:
-        self.value = v
+        with self._lock:
+            self.value = v
 
 
-@dataclass
 class Histogram:
-    samples: List[float] = field(default_factory=list)
+    """Bounded-memory histogram: exact ``count``/``sum``/``min``/``max``
+    plus a fixed-size uniform reservoir (Vitter's algorithm R, seeded so
+    runs are reproducible) that ``percentile()`` answers from. Long bench
+    runs observe millions of samples; the reservoir caps the footprint at
+    ``max_samples`` floats while keeping percentile estimates unbiased.
+    """
+
+    __slots__ = ("samples", "count", "sum", "min", "max", "max_samples",
+                 "_rng", "_lock")
+
+    DEFAULT_MAX_SAMPLES = 4096
+
+    def __init__(self, max_samples: int = DEFAULT_MAX_SAMPLES,
+                 lock: Optional[threading.RLock] = None, seed: int = 0):
+        self.samples: List[float] = []
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.max_samples = int(max_samples)
+        self._rng = random.Random(seed)
+        self._lock = lock or threading.RLock()
 
     def observe(self, v: float) -> None:
-        self.samples.append(v)
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            if len(self.samples) < self.max_samples:
+                self.samples.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.max_samples:
+                    self.samples[j] = v
+
+    def mean(self) -> float:
+        with self._lock:
+            return self.sum / self.count if self.count else 0.0
 
     def percentile(self, p: float) -> float:
-        if not self.samples:
-            return 0.0
-        import numpy as np
+        with self._lock:
+            if not self.samples:
+                return 0.0
+            import numpy as np
 
-        return float(np.percentile(self.samples, p))
+            return float(np.percentile(self.samples, p))
 
 
 class MetricsRegistry:
     """Structured metrics: tuples/s, windows emitted/s, slice count, device
-    bytes — the TPU-side counters SURVEY.md §5 calls for."""
+    bytes — the TPU-side counters SURVEY.md §5 calls for. Metric objects
+    share the registry's lock, so concurrent writers (connector threads)
+    and ``snapshot()`` readers never race."""
 
     def __init__(self):
-        self.counters: Dict[str, Counter] = defaultdict(Counter)
-        self.gauges: Dict[str, Gauge] = defaultdict(Gauge)
-        self.histograms: Dict[str, Histogram] = defaultdict(Histogram)
+        self._lock = threading.RLock()
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
         self._t0 = time.perf_counter()
+        self._t_stop: Optional[float] = None
 
     def counter(self, name: str) -> Counter:
-        return self.counters[name]
+        with self._lock:
+            c = self.counters.get(name)
+            if c is None:
+                c = self.counters[name] = Counter(self._lock)
+            return c
 
     def gauge(self, name: str) -> Gauge:
-        return self.gauges[name]
+        with self._lock:
+            g = self.gauges.get(name)
+            if g is None:
+                g = self.gauges[name] = Gauge(self._lock)
+            return g
 
     def histogram(self, name: str) -> Histogram:
-        return self.histograms[name]
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram(lock=self._lock)
+            return h
 
     def snapshot(self) -> dict:
-        elapsed = time.perf_counter() - self._t0
-        out = {"elapsed_s": elapsed}
-        for n, c in self.counters.items():
-            out[n] = c.value
-            out[f"{n}_per_s"] = c.value / elapsed if elapsed else 0.0
-        for n, g in self.gauges.items():
-            out[n] = g.value
-        for n, h in self.histograms.items():
-            out[f"{n}_p50"] = h.percentile(50)
-            out[f"{n}_p99"] = h.percentile(99)
-        return out
+        with self._lock:
+            elapsed = (self._t_stop if self._t_stop is not None
+                       else time.perf_counter()) - self._t0
+            out = {"elapsed_s": elapsed}
+            for n, c in self.counters.items():
+                out[n] = c.value
+                out[f"{n}_per_s"] = c.value / elapsed if elapsed else 0.0
+            for n, g in self.gauges.items():
+                out[n] = g.value
+            for n, h in self.histograms.items():
+                out[f"{n}_count"] = h.count
+                out[f"{n}_mean"] = h.mean()
+                out[f"{n}_p50"] = h.percentile(50)
+                out[f"{n}_p99"] = h.percentile(99)
+                if h.count:
+                    out[f"{n}_min"] = h.min
+                    out[f"{n}_max"] = h.max
+            return out
+
+    def reset_clock(self) -> None:
+        """Restart the rate denominator (``*_per_s``/``elapsed_s``) —
+        callers that attach a registry after an expensive setup phase
+        (compile, warmup) reset so rates reflect the measured region."""
+        with self._lock:
+            self._t0 = time.perf_counter()
+            self._t_stop = None
+
+    def stop_clock(self) -> None:
+        """Freeze the rate denominator at the end of the measured region,
+        so post-region phases (drained latency sampling, export) don't
+        dilute ``*_per_s``."""
+        with self._lock:
+            self._t_stop = time.perf_counter()
 
     def dump_json(self) -> str:
         return json.dumps(self.snapshot(), default=float)
@@ -90,7 +186,12 @@ REGISTRY = MetricsRegistry()
 
 class ThroughputLogger:
     """Per-N-elements throughput sampler (ThroughputLogger.java:24-49):
-    call ``observe(n_tuples)`` per batch; logs elements/s at each interval."""
+    call ``observe(n_tuples)`` per batch; logs elements/s at each interval.
+    Each interval's rate is recorded into the registry BOTH as a last-value
+    gauge (``<name>_rate``) and as a histogram (``<name>_rate_hist`` — a
+    distinct name: one Prometheus metric name cannot carry two types), so a
+    snapshot carries the rate distribution, not just the final sample.
+    """
 
     def __init__(self, log_every: int = 1_000_000, name: str = "ingest",
                  registry: MetricsRegistry = REGISTRY, sink=None):
@@ -106,8 +207,15 @@ class ThroughputLogger:
         self._since_log += n_tuples
         if self._since_log >= self.log_every:
             now = time.perf_counter()
-            rate = self._since_log / (now - self._t_last)
-            self.sink(f"That's {rate:,.0f} elements/second/chip")
-            self.registry.gauge(f"{self.name}_rate").set(rate)
+            dt = now - self._t_last
+            if dt > 0:
+                # dt == 0 happens on very fast consecutive batches (clock
+                # granularity); a rate cannot be computed — skip the sample
+                # rather than divide by zero, but still reset the interval
+                rate = self._since_log / dt
+                self.sink(f"That's {rate:,.0f} elements/second/chip")
+                self.registry.gauge(f"{self.name}_rate").set(rate)
+                self.registry.histogram(
+                    f"{self.name}_rate_hist").observe(rate)
             self._since_log = 0
             self._t_last = now

@@ -40,7 +40,19 @@ _RATE_RE = re.compile(r"That's ([\d,]+) elements/second/chip")
 
 def analyze_log(text: str) -> dict:
     """AnalyzeTool parity (benchmark/.../AnalyzeTool.java:12-63): scrape
-    throughput samples from harness logs, return summary statistics."""
+    throughput samples from harness logs, return summary statistics.
+
+    .. deprecated:: 0.2
+       Log scraping is the pre-obs fallback. New code should read the
+       structured exports instead: ``python -m scotty_tpu.obs report``
+       over a :class:`scotty_tpu.obs.JsonlExporter` file or a bench
+       result's embedded ``metrics`` section."""
+    import warnings
+
+    warnings.warn(
+        "analyze_log is deprecated; use the structured metrics exports "
+        "(scotty_tpu.obs) and `python -m scotty_tpu.obs report` instead",
+        DeprecationWarning, stacklevel=2)
     import numpy as np
 
     rates = [float(m.group(1).replace(",", ""))

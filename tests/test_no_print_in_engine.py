@@ -1,0 +1,36 @@
+"""Tier-1 lint: the engine core stays silent (ISSUE 1 satellite).
+
+The reference's engine never logs — its only output was the benchmark-side
+throughput logger (SURVEY.md §5). The port preserves that discipline: all
+output from ``scotty_tpu/engine/`` and ``scotty_tpu/core/`` must flow
+through the metrics registry / sinks (scotty_tpu.obs), never a bare
+``print(``. AST-based so strings/comments mentioning print don't trip it.
+"""
+
+import ast
+import pathlib
+
+import scotty_tpu
+
+PKG_ROOT = pathlib.Path(scotty_tpu.__file__).parent
+SILENT_DIRS = ("engine", "core")
+
+
+def _print_calls(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            yield f"{path}:{node.lineno}"
+
+
+def test_engine_core_have_no_bare_print():
+    offenders = []
+    for d in SILENT_DIRS:
+        for path in sorted((PKG_ROOT / d).rglob("*.py")):
+            offenders.extend(_print_calls(path))
+    assert not offenders, (
+        "bare print( in the silent engine core — route output through "
+        "the scotty_tpu.obs registry/sinks instead: "
+        + ", ".join(offenders))

@@ -1,0 +1,231 @@
+"""Observability subsystem: span nesting/export, Prometheus exposition,
+JSONL exporter + report CLI, bench-result metrics round-trip (ISSUE 1)."""
+
+import json
+
+from scotty_tpu.obs import (
+    INGEST_TUPLES,
+    JsonlExporter,
+    Observability,
+    SpanRecorder,
+    prometheus_text,
+)
+from scotty_tpu.obs.report import main as report_main, render, summarize
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_summary():
+    rec = SpanRecorder()
+    with rec.span("outer"):
+        with rec.span("inner"):
+            pass
+        with rec.span("inner"):
+            pass
+    assert [s.name for s in rec.spans] == ["inner", "inner", "outer"]
+    depths = {s.name: s.depth for s in rec.spans}
+    assert depths == {"inner": 1, "outer": 0}
+    summ = rec.summary()
+    assert summ["inner"]["count"] == 2
+    assert summ["outer"]["count"] == 1
+    # children close inside the parent: total child time <= parent time
+    assert summ["inner"]["total_ms"] <= summ["outer"]["total_ms"] + 1e-6
+
+
+def test_span_chrome_trace_export(tmp_path):
+    rec = SpanRecorder()
+    with rec.span("ingest"):
+        with rec.span("query"):
+            pass
+    events = rec.to_chrome_trace()
+    assert all(e["ph"] == "X" for e in events)
+    q, i = events[0], events[1]
+    assert (q["name"], i["name"]) == ("query", "ingest")
+    # nested event lies within the parent interval (µs timestamps)
+    assert i["ts"] <= q["ts"]
+    assert q["ts"] + q["dur"] <= i["ts"] + i["dur"] + 1.0
+    path = tmp_path / "trace.json"
+    rec.dump_chrome_trace(str(path))
+    obj = json.loads(path.read_text())
+    assert len(obj["traceEvents"]) == 2
+
+
+def test_span_bounded():
+    rec = SpanRecorder(max_spans=3)
+    for _ in range(10):
+        with rec.span("s"):
+            pass
+    assert len(rec.spans) == 3
+    assert rec.summary()["_dropped_spans"] == 7
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_exposition_format():
+    obs = Observability()
+    obs.counter(INGEST_TUPLES).inc(42)
+    obs.gauge("slice_occupancy").set(0.5)
+    obs.histogram("emit_latency_ms").observe(3.0)
+    text = obs.prometheus()
+    assert "# TYPE scotty_ingest_tuples counter" in text
+    assert "scotty_ingest_tuples 42.0" in text
+    assert "# TYPE scotty_slice_occupancy gauge" in text
+    assert "# TYPE scotty_emit_latency_ms summary" in text
+    assert 'scotty_emit_latency_ms{quantile="0.5"} 3.0' in text
+    assert "scotty_emit_latency_ms_count 1" in text
+    # every non-comment line is "name[{labels}] value"
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            name, value = line.rsplit(" ", 1)
+            assert name and float(value) is not None
+
+
+def test_jsonl_exporter_and_report(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    obs = Observability()
+    obs.counter(INGEST_TUPLES).inc(100)
+    obs.write_jsonl(str(path), label="cell-0")
+    obs.counter(INGEST_TUPLES).inc(50)
+    obs.write_jsonl(str(path), label="cell-1")
+
+    summary = summarize(str(path))
+    assert summary["kind"] == "jsonl"
+    assert summary["rows"] == 2
+    st = summary["metrics"][INGEST_TUPLES]
+    assert (st["min"], st["max"], st["last"]) == (100.0, 150.0, 150.0)
+
+    out = render(str(path))
+    assert INGEST_TUPLES in out and "150" in out
+
+
+def test_report_cli_end_to_end(tmp_path, capsys):
+    path = tmp_path / "metrics.jsonl"
+    with JsonlExporter(str(path)) as ex:
+        ex.write({"ingest_tuples": 7.0, "watermark_lag_ms": 12.0}, t=1.0)
+    assert report_main(["report", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "watermark_lag_ms" in out
+    assert report_main(["report", str(path), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["metrics"]["ingest_tuples"]["last"] == 7.0
+
+
+def test_report_reads_chrome_trace(tmp_path):
+    rec = SpanRecorder()
+    with rec.span("timed"):
+        pass
+    path = tmp_path / "trace.json"
+    rec.dump_chrome_trace(str(path))
+    summary = summarize(str(path))
+    assert summary["kind"] == "chrome-trace"
+    assert summary["spans"]["timed"]["count"] == 1
+
+
+def test_report_reads_bench_result_cells(tmp_path):
+    cells = [{"name": "x", "windows": "Tumbling(1000)", "engine": "T",
+              "aggregation": "sum", "tuples_per_sec": 1e6,
+              "metrics": {"metrics": {"ingest_tuples": 5.0},
+                          "spans": {"timed": {"count": 1, "total_ms": 2.0,
+                                              "mean_ms": 2.0,
+                                              "max_ms": 2.0}}}}]
+    path = tmp_path / "result_x.json"
+    path.write_text(json.dumps(cells))
+    summary = summarize(str(path))
+    assert summary["kind"] == "bench-result"
+    assert summary["cells"][0]["metrics"]["ingest_tuples"] == 5.0
+    assert "ingest_tuples" in render(str(path))
+
+
+# ---------------------------------------------------------------------------
+# engine + bench integration
+# ---------------------------------------------------------------------------
+
+
+def test_operator_telemetry_hooks():
+    import numpy as np
+
+    from scotty_tpu.core.windows import TumblingWindow, WindowMeasure
+    from scotty_tpu.core.aggregates import SumAggregation
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.operator import TpuWindowOperator
+
+    obs = Observability()
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=128, annex_capacity=16, batch_size=4), obs=obs)
+    op.add_window_assigner(TumblingWindow(WindowMeasure.Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(100)
+    op.process_elements(np.asarray([1.0, 2.0, 3.0, 4.0], np.float32),
+                        np.asarray([1, 5, 12, 18], np.int64))
+    # a late batch (below the stream's max event time)
+    op.process_elements(np.asarray([9.0, 9.0, 9.0, 9.0], np.float32),
+                        np.asarray([2, 3, 25, 30], np.int64))
+    op.process_watermark(20)
+    op.check_overflow()
+    snap = obs.snapshot()
+    assert snap["ingest_tuples"] == 8
+    assert snap["late_tuples"] == 2
+    assert snap["watermarks"] == 1
+    assert snap["watermark_lag_ms"] == 30 - 20
+    assert snap["watermark_dispatch_ms_count"] == 1
+    assert 0 < snap["slice_occupancy"] <= 1
+    assert snap["slice_headroom"] < 128
+
+
+def test_connector_telemetry():
+    from scotty_tpu.connectors.base import KeyedScottyWindowOperator
+    from scotty_tpu.connectors.iterable import collect_keyed
+    from scotty_tpu.core.aggregates import SumAggregation
+    from scotty_tpu.core.windows import TumblingWindow, WindowMeasure
+
+    obs = Observability()
+    op = KeyedScottyWindowOperator(
+        windows=[TumblingWindow(WindowMeasure.Time, 10)],
+        aggregations=[SumAggregation()], obs=obs)
+    stream = [("a", 1.0, t) for t in range(0, 40, 2)]
+    out = collect_keyed(stream, op, final_watermark=100)
+    assert out
+    snap = obs.snapshot()
+    assert snap["ingest_tuples"] == len(stream)
+    assert snap["watermarks"] >= 1
+    assert snap["windows_emitted"] >= len(out) - 1
+
+
+def test_run_benchmark_metrics_roundtrip(tmp_path):
+    """A small bench run embeds a metrics section in to_dict() and its
+    exports summarize end-to-end (ISSUE 1 acceptance)."""
+    from scotty_tpu.bench.harness import BenchmarkConfig, run_benchmark
+
+    cfg = BenchmarkConfig(name="obs-rt", throughput=4096, runtime_s=2,
+                          batch_size=1024, capacity=1 << 10,
+                          watermark_period_ms=500)
+    res = run_benchmark(cfg, "Tumbling(500)", "sum", engine="TpuEngine",
+                        warmup_batches=1)
+    d = res.to_dict()
+    assert "metrics" in d
+    m = d["metrics"]["metrics"]
+    assert m["ingest_tuples"] > 0
+    assert m["watermarks"] >= 1
+    assert d["metrics"]["spans"]["stream"]["count"] == 1
+    # JSON-serializable end to end (the result artifact contract)
+    json.dumps(d)
+
+    # exports + report CLI round-trip
+    jl = tmp_path / "m.jsonl"
+    tr = tmp_path
+    res.observability.write_jsonl(str(jl), label="cell")
+    res.observability.write_chrome_trace(str(tr / "t.json"))
+    assert summarize(str(jl))["metrics"]["ingest_tuples"]["last"] > 0
+    assert summarize(str(tr / "t.json"))["spans"]["stream"]["count"] == 1
+
+    # disabled observability: no metrics section, no registry work
+    res_off = run_benchmark(cfg, "Tumbling(500)", "sum",
+                            engine="TpuEngine", warmup_batches=0,
+                            collect_metrics=False)
+    assert "metrics" not in res_off.to_dict()
