@@ -56,7 +56,12 @@ def _draw(plt, path, title, xlabel, xticklabels, get):
     fig.savefig(path)
 
 
-def main(results_dir: str = "bench_results", out_dir: str = "charts") -> int:
+def main(results_dir: str = "bench_results", out_dir: str = "charts",
+         echo=None) -> int:
+    from ..utils import stdout_echo
+
+    if echo is None:
+        echo = stdout_echo
     import matplotlib
 
     matplotlib.use("Agg")
@@ -98,7 +103,8 @@ def main(results_dir: str = "bench_results", out_dir: str = "charts") -> int:
     _draw(plt, os.path.join(out_dir, "concurrent_tumbling.png"),
           "Concurrent random tumbling windows (1 → 1000), v5e-1",
           "# concurrent windows", [str(n) for n in ns], tps_tumbling)
-    print(f"-> {out_dir}/sliding_suite.png, {out_dir}/concurrent_tumbling.png")
+    echo(f"-> {out_dir}/sliding_suite.png, "
+         f"{out_dir}/concurrent_tumbling.png")
     return 0
 
 

@@ -149,6 +149,10 @@ class BenchmarkConfig:
     #: LoadGeneratorSource.java:60-76, generated BenchmarkRunner.java:174-192).
     #: Without them a constant-rate stream is one session that never closes.
     session_config: Optional[dict] = None
+    #: pin the r4-era generator (32-bit value draws + per-tuple offset
+    #: stream) so cross-round comparisons keep one workload-identical
+    #: anchor cell (ADVICE r5); aligned-pipeline cells only
+    legacy_generator: bool = False
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -170,6 +174,7 @@ class BenchmarkConfig:
             max_lateness=raw.get("maxLateness", 1000),
             seed=raw.get("seed", 42),
             session_config=raw.get("sessionConfig"),
+            legacy_generator=raw.get("legacyGenerator", False),
         )
 
 
@@ -311,25 +316,40 @@ class ThroughputStatistics:
     def mean_throughput(self) -> float:
         return self.tuples / self.seconds if self.seconds else 0.0
 
+#: a sample is attributed to a transport STALL only above this absolute
+#: floor — the documented tunnel stalls run tens of seconds, while genuine
+#: engine tail latency above 10×p50 but below this stays engine-attributed
+STALL_ABS_MS = 1000.0
+
+
 def latency_stats(lats) -> dict:
-    """Stall-robust latency summary (VERDICT r4 weak #5): the transport
-    tunnel stalls ~one sample in a few hundred for tens of seconds, and a
-    raw p99 that lands on a stall publishes a garbage engine number. Report
-    the raw percentile AND a trimmed companion (samples > 10x p50 excluded)
-    plus the excluded-sample count, so artifact consumers see both."""
+    """Stall-robust latency summary (VERDICT r4 weak #5, refined per
+    ADVICE r5): the raw p99 is the AUTHORITATIVE number; a trimmed
+    companion excludes samples > 10×p50. Previously every trimmed sample
+    was labeled a stall — silently reclassifying genuine engine tail as
+    transport noise. Now ``n_stall_samples`` counts only samples that are
+    both > 10×p50 AND > :data:`STALL_ABS_MS` (tunnel stalls run tens of
+    seconds); when raw and trimmed diverge with NO identified stall,
+    ``tail_unattributed`` flags that the tail is real, engine-attributed
+    latency the trimmed figure hides."""
     if not len(lats):
         return {"p99_emit_ms": 0.0, "p50_emit_ms": 0.0,
                 "p99_emit_ms_trimmed": 0.0, "n_stall_samples": 0,
-                "stall_flagged": False}
+                "n_trimmed_samples": 0, "stall_flagged": False,
+                "tail_unattributed": False}
     lats = np.asarray(lats, np.float64)
     p50 = float(np.percentile(lats, 50))
     p99 = float(np.percentile(lats, 99))
     core = lats[lats <= 10.0 * p50]
-    stalls = int(lats.size - core.size)
+    trimmed = int(lats.size - core.size)
+    stalls = int(((lats > 10.0 * p50) & (lats > STALL_ABS_MS)).sum())
     p99_t = float(np.percentile(core, 99)) if core.size else p99
+    diverged = bool(p99 > 10.0 * p50)
     return {"p99_emit_ms": p99, "p50_emit_ms": p50,
             "p99_emit_ms_trimmed": p99_t, "n_stall_samples": stalls,
-            "stall_flagged": bool(p99 > 10.0 * p50)}
+            "n_trimmed_samples": trimmed,
+            "stall_flagged": diverged and stalls > 0,
+            "tail_unattributed": diverged and stalls == 0}
 
 
 def finalize_observability(res: "BenchResult", obs, lats, emitted: int,
@@ -442,7 +462,8 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
 
         op = TpuWindowOperator(config=EngineConfig(
             capacity=cfg.capacity, batch_size=cfg.batch_size,
-            record_capacity=cfg.record_capacity))
+            record_capacity=cfg.record_capacity),
+            collect_device_metrics=collect_metrics)
     elif engine == "Simulator":
         from ..simulator import SlicingWindowOperator
 
@@ -472,9 +493,11 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         if engine == "TpuEngine" and warmup_batches > 0:
             from ..engine import EngineConfig, TpuWindowOperator
 
+            # the throwaway twin's telemetry is discarded — skip its cost
             twin = TpuWindowOperator(config=EngineConfig(
                 capacity=cfg.capacity, batch_size=cfg.batch_size,
-                record_capacity=cfg.record_capacity))
+                record_capacity=cfg.record_capacity),
+                collect_device_metrics=False)
             for w in windows:
                 twin.add_window_assigner(w)
             twin.add_aggregation(make_aggregation(agg_name))

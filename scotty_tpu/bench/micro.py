@@ -224,9 +224,14 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     return results
 
 
-def main(argv: Optional[list] = None) -> int:
+def main(argv: Optional[list] = None, echo=None) -> int:
     import argparse
     import os
+
+    from ..utils import stdout_echo
+
+    if echo is None:
+        echo = stdout_echo
 
     ap = argparse.ArgumentParser(prog="python -m scotty_tpu.bench.micro")
     ap.add_argument("--out", default="bench_results/micro.json")
@@ -244,12 +249,12 @@ def main(argv: Optional[list] = None) -> int:
             extra = f"  {r['tuples_per_s']:16,.0f} tuples/s"
         elif "windows_per_s" in r:
             extra = f"  {r['windows_per_s']:16,.0f} windows/s"
-        print(f"{phase:16s} mean={r['mean_ms']:9.3f} ms/dispatch"
-              f"{extra}")
+        echo(f"{phase:16s} mean={r['mean_ms']:9.3f} ms/dispatch"
+             f"{extra}")
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(res, f, indent=1)
-    print(f"-> {args.out}")
+    echo(f"-> {args.out}")
     return 0
 
 

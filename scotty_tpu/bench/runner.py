@@ -32,6 +32,7 @@ from typing import List, Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..utils import stdout_echo as _stdout
 from .harness import (
     BenchmarkConfig,
     BenchResult,
@@ -232,6 +233,14 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     windows = parse_window_spec(window_spec, seed=cfg.seed)
     engine = {"Slicing": "TpuEngine", "Flink": "Buckets"}.get(engine, engine)
     obs = _obs.Observability() if collect_metrics else None
+    if cfg.legacy_generator and (engine != "TpuEngine"
+                                 or cfg.session_config):
+        # the anchor cell must never silently substitute a different
+        # execution mode — the whole point is a workload-identical
+        # cross-round comparison on the aligned pipeline
+        raise NotImplementedError(
+            "legacyGenerator anchor cells run only on the TpuEngine "
+            "aligned pipeline (no sessionConfig, no alternate engines)")
 
     if engine == "TpuEngine":
         if not cfg.session_config:
@@ -249,11 +258,17 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                     windows, [make_aggregation(agg_name)], config=econf,
                     throughput=tp, wm_period_ms=cfg.watermark_period_ms,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
-                    gc_every=32, out_of_order_pct=cfg.out_of_order_pct)
+                    gc_every=32, out_of_order_pct=cfg.out_of_order_pct,
+                    legacy_generator=cfg.legacy_generator,
+                    collect_device_metrics=collect_metrics)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
                                           "aligned", obs=obs)
             except NotImplementedError:
-                pass
+                if cfg.legacy_generator:
+                    # no silent fallback for the anchor cell (see the
+                    # guard above; this covers aligned-spec rejections
+                    # like sketch aggs or an unaligned window mix)
+                    raise
             try:
                 # count-measure workloads (count tumbling, optionally mixed
                 # with time grids, in- or out-of-order): the fused record-
@@ -266,7 +281,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                     throughput=cfg.throughput,
                     wm_period_ms=cfg.watermark_period_ms,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
-                    out_of_order_pct=cfg.out_of_order_pct)
+                    out_of_order_pct=cfg.out_of_order_pct,
+                    collect_device_metrics=collect_metrics)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
                                           "count-fused", obs=obs)
             except NotImplementedError:
@@ -281,7 +297,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                     throughput=cfg.throughput,
                     wm_period_ms=cfg.watermark_period_ms,
                     max_lateness=cfg.max_lateness, seed=cfg.seed,
-                    out_of_order_pct=cfg.out_of_order_pct)
+                    out_of_order_pct=cfg.out_of_order_pct,
+                    collect_device_metrics=collect_metrics)
                 return _run_pipeline_cell(p, cfg, window_spec, agg_name,
                                           "fused", obs=obs)
             except NotImplementedError:
@@ -335,7 +352,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                         throughput=cfg.throughput,
                         wm_period_ms=cfg.watermark_period_ms,
                         max_lateness=cfg.max_lateness, seed=cfg.seed,
-                        session_config=cfg.session_config)
+                        session_config=cfg.session_config,
+                        collect_device_metrics=collect_metrics)
                     return _run_pipeline_cell(p, cfg, window_spec,
                                               agg_name, "session", obs=obs)
                 except NotImplementedError:
@@ -739,13 +757,15 @@ def _run_keyed_rounds_cell(cfg: BenchmarkConfig, windows, window_spec: str,
 
 
 def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
-               echo=print, collect_metrics: bool = True,
+               echo=None, collect_metrics: bool = True,
                obs_dir: Optional[str] = None) -> List[dict]:
     """All cells of one config; writes result_<name>.json (each cell row
     carries a ``metrics`` section unless ``collect_metrics=False``). With
     ``obs_dir``, additionally exports a per-config JSONL time series (one
     snapshot row per cell — ``python -m scotty_tpu.obs report`` summarizes
     it) and per-cell Chrome-trace span files."""
+    if echo is None:
+        echo = _stdout
     rows = []
     cell_idx = 0
     rtt_floor = round(measure_rtt_floor(), 2)
@@ -783,7 +803,8 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
                               "link_saturation", "n_lat_samples",
                               "p50_emit_ms", "emit_ms_device",
                               "p99_emit_ms_trimmed", "n_stall_samples",
-                              "stall_flagged"):
+                              "n_trimmed_samples", "stall_flagged",
+                              "tail_unattributed"):
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)
@@ -819,8 +840,12 @@ def load_config(path: str) -> BenchmarkConfig:
     return cfg
 
 
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
+    import shutil
+    import tempfile
 
     ap = argparse.ArgumentParser(
         prog="python -m scotty_tpu.bench",
@@ -834,6 +859,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--no-obs", action="store_true",
                     help="disable observability entirely (no metrics "
                          "section in results; the overhead A/B baseline)")
+    ap.add_argument("--gate", default=None, metavar="THRESHOLDS",
+                    help="regression gate: after each config runs, diff "
+                         "its fresh result_<name>.json against the "
+                         "baseline copy (--baseline-dir, default the "
+                         "pre-run file in --out-dir) under this "
+                         "threshold JSON (python -m scotty_tpu.obs diff "
+                         "semantics; pass 'default' for the built-in "
+                         "thresholds); exit nonzero on any regression")
+    ap.add_argument("--baseline-dir", default=None, metavar="DIR",
+                    help="where baseline result_<name>.json files live "
+                         "(with --gate; default: --out-dir, snapshotted "
+                         "before each run overwrites it)")
     args = ap.parse_args(argv)
 
     paths = args.configs
@@ -842,9 +879,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         paths = sorted(
             os.path.join(here, f) for f in os.listdir(here)
             if f.endswith(".json"))
+    gate_failures = 0
     for path in paths:
         cfg = load_config(path)
-        print(f"== {cfg.name} ({path})")
+        _stdout(f"== {cfg.name} ({path})")
+        baseline_snap = None
+        if args.gate:
+            src = os.path.join(args.baseline_dir or args.out_dir,
+                               f"result_{cfg.name}.json")
+            if os.path.exists(src):
+                # snapshot BEFORE run_config overwrites result_<name>.json
+                fd, baseline_snap = tempfile.mkstemp(suffix=".json")
+                os.close(fd)
+                shutil.copyfile(src, baseline_snap)
         run_config(cfg, out_dir=args.out_dir,
                    collect_metrics=not args.no_obs, obs_dir=args.obs_dir)
+        if args.gate:
+            if baseline_snap is None:
+                _stdout(f"  gate: no baseline for {cfg.name} — skipped "
+                        "(first run records the baseline)")
+                continue
+            from ..obs.diff import diff_main
+
+            th = None if args.gate == "default" else args.gate
+            rc = diff_main(baseline_snap,
+                           os.path.join(args.out_dir,
+                                        f"result_{cfg.name}.json"),
+                           thresholds_path=th, echo=_stdout)
+            os.unlink(baseline_snap)
+            if rc:
+                gate_failures += 1
+    if gate_failures:
+        _stdout(f"GATE FAILED: {gate_failures} config(s) regressed")
+        return 1
     return 0

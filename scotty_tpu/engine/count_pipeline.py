@@ -105,17 +105,22 @@ class CountStreamPipeline(FusedPipelineDriver):
     out-of-order. One XLA dispatch per watermark interval; no host sync
     anywhere in the steady state."""
 
+    _uses_device_metrics = True
+
     def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
                  config: Optional[EngineConfig] = None,
                  throughput: int = 5_000_000, wm_period_ms: int = 1000,
                  max_lateness: int = 1000, seed: int = 0, gc_every: int = 32,
                  value_scale: float = 10_000.0,
-                 out_of_order_pct: float = 0.0):
+                 out_of_order_pct: float = 0.0,
+                 collect_device_metrics: bool = True):
         import jax
         import jax.numpy as jnp
 
         from . import core as ec
+        from ..obs import device as _dev
 
+        self.collect_device_metrics = bool(collect_device_metrics)
         self.config = config or EngineConfig()
         self.windows = list(windows)
         self.aggregations = list(aggregations)
@@ -260,11 +265,41 @@ class CountStreamPipeline(FusedPipelineDriver):
             ms m is m mod W; the retained window is [wm - W, wm)."""
             return jnp.mod(base_next, W)
 
-        def step(state, key, i):
+        cdm = self.collect_device_metrics
+
+        def step(state, dm, key, i):
             base = i * jnp.int64(P)
             N_prev = arrived_before(i)
             N_i = arrived_before(i + 1)
             rows, row_aggs = state.rows, list(state.row_aggs)
+
+            if cdm:
+                # In-jit telemetry. Late lanes arrive at the START of the
+                # interval (materialize_interval arrival order: oldest ms
+                # rows first), below the running max base-1 left by the
+                # previous interval's paced rows — EXCEPT the age-0 ms
+                # row (ts == base-1 is not strictly below the max). Ages
+                # are closed-form: the a-strata's rows sit at
+                # aP-1 … (a-1)P below the stream head, E tuples per ms.
+                n_late_rows = jnp.int64(0)
+                if E:
+                    for a in range(1, q + 1):
+                        ok = base - a * P >= 0
+                        ages = (jnp.int64(a) * P - 1
+                                - jnp.arange(P, dtype=jnp.int64))
+                        m = ok & (ages > 0)
+                        dm = _dev.record_late_ages(dm, ages, m,
+                                                   weight=jnp.int64(E))
+                        dm = dm._replace(
+                            late=dm.late + E * jnp.sum(m.astype(jnp.int64)))
+                        n_late_rows = n_late_rows \
+                            + jnp.where(ok, jnp.int64(P), 0)
+                dm = dm._replace(
+                    ingested=dm.ingested + jnp.int64(SR)
+                    + (E * P * jnp.minimum(jnp.maximum(i, 0), q)
+                       if E else 0),
+                    slices_touched=dm.slices_touched + jnp.int64(P)
+                    + n_late_rows)
 
             # 1. claim this interval's P rows (aligned block in the ring)
             slot = jnp.mod(base, W).astype(jnp.int32)
@@ -440,12 +475,21 @@ class CountStreamPipeline(FusedPipelineDriver):
                 results.append(
                     jnp.where((tmask & (cnt > 0))[:, None], res, ident))
 
+            if cdm:
+                dm = dm._replace(
+                    triggers=dm.triggers + jnp.sum(tmask),
+                    windows_nonempty=dm.windows_nonempty
+                    + jnp.sum(tmask & (cnt > 0)))
+                # occupancy of the ms-row ring: retained rows with data
+                dm = _dev.record_occupancy(
+                    dm, jnp.sum((cnt_row > 0).astype(jnp.int64)), W)
+
             new_state = CountRowState(
                 rows=rows, row_aggs=tuple(row_aggs),
                 overflow=state.overflow | bad)
-            return new_state, (ws, we, cnt, tuple(results))
+            return new_state, dm, (ws, we, cnt, tuple(results))
 
-        self._step = jax.jit(step, donate_argnums=0)
+        self._step = jax.jit(step, donate_argnums=(0, 1))
         self._init = lambda: CountRowState(
             rows=jnp.zeros((W, cap), jnp.float32),
             row_aggs=tuple(

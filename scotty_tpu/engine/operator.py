@@ -200,6 +200,40 @@ def _context_chunk_kernel(aggs, spec, capacity: int, chunk_len: int):
     return hit
 
 
+def _dm_ingest_kernel():
+    """Jitted DeviceMetrics batch updater for device-resident ingest
+    (ingest_device_batch / ingest_device_late): device timestamps are
+    opaque to the host, so exact late counts/ages can only be computed
+    in-jit. Arrival-order running max (cummax) seeded at the stream's
+    host-known max event time — the same calculus a host arrival-order
+    replay computes. Cached like the other kernels; zero host syncs."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import core as ec
+    from ..obs import device as _dev
+
+    key = ("dm_ingest",)
+    hit = _KERNEL_CACHE.get(key)
+    if hit is None:
+        def upd(dm, ts, valid, met_pre):
+            ts = jnp.asarray(ts)
+            valid = jnp.asarray(valid)
+            eff = jnp.where(valid, ts, jnp.int64(ec.I64_MIN))
+            shifted = jnp.concatenate(
+                [jnp.reshape(jnp.int64(met_pre), (1,)), eff[:-1]])
+            rm = jax.lax.cummax(shifted)
+            late_m = valid & (ts < rm)
+            dm = _dev.record_late_ages(dm, rm - ts, late_m)
+            return dm._replace(
+                ingested=dm.ingested + jnp.sum(valid.astype(jnp.int64)),
+                late=dm.late + jnp.sum(late_m))
+
+        hit = jax.jit(upd, donate_argnums=0)
+        _KERNEL_CACHE[key] = hit
+    return hit
+
+
 def _dense_kernel(spec, capacity: int, runs: int):
     """Jitted scatter-free in-order ingest (build_ingest_dense), cached."""
     import jax
@@ -248,9 +282,18 @@ class TpuWindowOperator(WindowOperator):
     """
 
     def __init__(self, state_factory: Optional[StateFactory] = None,
-                 config: Optional[EngineConfig] = None, obs=None):
+                 config: Optional[EngineConfig] = None, obs=None,
+                 collect_device_metrics: Optional[bool] = None):
         self.config = config or EngineConfig()
         self.obs = obs                      # scotty_tpu.obs.Observability
+        #: device_* telemetry mode. None (default) = AUTO: collect only
+        #: while an Observability is attached, so a bare operator stays
+        #: zero-overhead (no dm_ingest kernel dispatch per device batch,
+        #: no numpy running-max mirror per host batch). True forces
+        #: collection without obs (device_metrics() consumers); False
+        #: disables entirely (the overhead A/B baseline — run_benchmark
+        #: propagates its collect_metrics flag here).
+        self.collect_device_metrics = collect_device_metrics
         self.windows: List[ContextFreeWindow] = []
         self.aggregations: List[AggregateFunction] = []
         self.max_lateness = 1000            # WindowManager.java:24 default
@@ -261,6 +304,13 @@ class TpuWindowOperator(WindowOperator):
         self._pend_vals: list = []
         self._pend_ts: list = []
         self._n_pending = 0
+        # in-jit device telemetry (obs/device.py): the device pytree is
+        # allocated lazily on the first device-resident batch; host-fed
+        # batches accumulate the same device_* names in numpy (their ts
+        # are host-visible — no extra dispatch on the hot path)
+        self._dm = None
+        self._dm_host_acc: dict = {}
+        self._dm_folded = None
 
     # -- registry ----------------------------------------------------------
     def add_window_assigner(self, window: Window) -> None:
@@ -384,8 +434,13 @@ class TpuWindowOperator(WindowOperator):
         max event time, ``watermarks``/``watermark_lag_ms``/
         ``watermark_dispatch_ms`` per watermark, ``overflows`` on overflow,
         ``slice_occupancy``/``slice_headroom`` at the
-        :meth:`check_overflow` sync point."""
+        :meth:`check_overflow` sync point — where the in-jit ``device_*``
+        telemetry (obs/device.py) also folds in. Attaching mid-run
+        baselines the device counters so pre-attach (warmup) batches
+        don't pollute the fold."""
         self.obs = obs
+        if obs is not None and (self._dm is not None or self._dm_host_acc):
+            self._dm_folded = self.device_metrics()
 
     # -- build -------------------------------------------------------------
     def _compute_spec(self):
@@ -545,6 +600,49 @@ class TpuWindowOperator(WindowOperator):
         self._device_fed = False        # device batches bypass the mirror
         self._built = True
 
+    # -- device telemetry --------------------------------------------------
+    @property
+    def _dm_active(self) -> bool:
+        """Whether the device_* telemetry collects right now (see the
+        collect_device_metrics mode doc in __init__)."""
+        if self.collect_device_metrics is None:
+            return self.obs is not None
+        return bool(self.collect_device_metrics)
+
+    def _dm_host_add(self, name: str, delta: int) -> None:
+        if delta:
+            self._dm_host_acc[name] = self._dm_host_acc.get(name, 0) + delta
+
+    def device_metrics(self) -> dict:
+        """Merged in-jit + host-mirrored telemetry as a ``device_*`` name
+        → int dict (syncs the device pytree if one exists)."""
+        from ..obs import device as _dev
+
+        snap = dict(self._dm_host_acc)
+        if self._dm is not None:
+            import jax
+
+            for name, v in _dev.host_snapshot(
+                    jax.device_get(self._dm)).items():
+                snap[name] = snap.get(name, 0) + v
+        return snap
+
+    def _dm_device_update(self, ts, valid) -> None:
+        """Fold one device-resident batch into the in-jit pytree (its ts
+        are host-opaque; the jitted cummax kernel is the only exact
+        source of late counts/ages). Zero host syncs; no-op when device
+        telemetry is disabled."""
+        from . import core as ec
+        from ..obs import device as _dev
+
+        if not self._dm_active:
+            return
+        if self._dm is None:
+            self._dm = _dev.init_device_metrics()
+        met = np.int64(self._host_met) if self._host_met is not None \
+            else np.int64(ec.I64_MIN)
+        self._dm = _dm_ingest_kernel()(self._dm, ts, valid, met)
+
     # -- ingest ------------------------------------------------------------
     def process_element(self, element: Any, ts: int) -> None:
         self.process_elements(np.asarray([element], dtype=np.float32),
@@ -589,6 +687,27 @@ class TpuWindowOperator(WindowOperator):
             n_below = int((batch_t[:take] < met_pre).sum())
             if n_below:
                 self.obs.counter(_obs.LATE_TUPLES).inc(n_below)
+        if take and self._dm_active:
+            # device_* telemetry, host mirror (these ts are host-visible
+            # pre-sort, so the exact arrival-order running-max calculus
+            # costs one numpy accumulate — no extra device dispatch):
+            # a tuple is late iff strictly below the running max at ITS
+            # arrival; its age is the running max minus its ts
+            from ..obs import device as _dev
+
+            arr = batch_t[:take]
+            seed = np.int64(met_pre) if met_pre is not None \
+                else np.iinfo(np.int64).min
+            rm = np.maximum.accumulate(np.concatenate(([seed], arr[:-1])))
+            late_m = arr < rm
+            n_late_exact = int(late_m.sum())
+            self._dm_host_add(_dev.DEVICE_INGEST_TUPLES, take)
+            self._dm_host_add(_dev.DEVICE_LATE_TUPLES, n_late_exact)
+            if n_late_exact:
+                hist = _dev.host_late_age_hist(rm[late_m] - arr[late_m])
+                for name, v in zip(_dev.late_bucket_names(),
+                                   hist.tolist()):
+                    self._dm_host_add(name, int(v))
         if take and self._host_first_ts is None:
             self._host_first_ts = int(batch_t[0])   # arrival order, pre-sort
         intra_ooo = take > 1 and not bool(
@@ -973,6 +1092,7 @@ class TpuWindowOperator(WindowOperator):
                 if self.obs is not None:        # pure-context ingest done
                     self.obs.counter(_obs.INGEST_TUPLES).inc(n)
                     self.obs.histogram(_obs.INGEST_BATCH_SIZE).observe(n)
+                self._dm_device_update(ts, valid)
                 self._host_met = ts_max if self._host_met is None \
                     else max(self._host_met, ts_max)
                 self._host_min_ts = ts_min if self._host_min_ts is None \
@@ -995,11 +1115,13 @@ class TpuWindowOperator(WindowOperator):
         if self.obs is not None:
             # past every reject guard: the batch is definitely ingested.
             # Device-resident ts are opaque host-side, so a back-reaching
-            # batch counts whole as late.
+            # batch counts whole as late at THIS host boundary — the
+            # in-jit device_* counters below carry the exact count.
             self.obs.counter(_obs.INGEST_TUPLES).inc(n)
             self.obs.histogram(_obs.INGEST_BATCH_SIZE).observe(n)
             if has_late:
                 self.obs.counter(_obs.LATE_TUPLES).inc(n)
+        self._dm_device_update(ts, valid)
         if self._host_first_ts is None:
             self._host_first_ts = ts_min    # conservative (device ts opaque)
         self._host_met = ts_max if self._host_met is None \
@@ -1034,6 +1156,7 @@ class TpuWindowOperator(WindowOperator):
         if self.obs is not None:
             self.obs.counter(_obs.INGEST_TUPLES).inc(n)
             self.obs.counter(_obs.LATE_TUPLES).inc(n)
+        self._dm_device_update(ts, valid)
         self._annex_dirty = True
         self._host_met = ts_max if self._host_met is None \
             else max(self._host_met, ts_max)
@@ -1315,6 +1438,12 @@ class TpuWindowOperator(WindowOperator):
             cap = self.config.capacity
             self.obs.gauge(_obs.SLICE_OCCUPANCY).set(n / cap)
             self.obs.gauge(_obs.SLICE_HEADROOM).set(cap - n)
+        if self.obs is not None:
+            # same drain point: fold the device_* telemetry delta
+            from ..obs import device as _dev
+
+            self._dm_folded = _dev.fold_into(
+                self.obs.registry, self.device_metrics(), self._dm_folded)
 
     def _fetch_sessions(self, outs):
         """Fetch per-session-window sweep outputs; emission follows window

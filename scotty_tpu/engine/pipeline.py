@@ -253,13 +253,25 @@ class FusedPipelineDriver:
     """
 
     #: attached Observability (scotty_tpu.obs) — None = zero-overhead off.
-    #: All hooks are HOST-side at interval boundaries; nothing enters the
-    #: jitted step.
+    #: Host-side hooks fire at interval boundaries; the IN-JIT telemetry
+    #: (obs/device.py DeviceMetrics) rides the carried state and is folded
+    #: into the registry at sync().
     obs = None
     #: whether _sync_anchor() is the live-slice count (occupancy gauges);
     #: pipelines whose anchor is something else (count pipeline: the
     #: overflow flag) set this False
     _anchor_is_slices = True
+    #: pipelines whose jitted step threads a DeviceMetrics pytree set this
+    #: True (their _step takes and returns the dm as the second carry);
+    #: others (buckets baseline, keyed) keep the two-value contract
+    _uses_device_metrics = False
+    #: static at construction: False builds the step WITHOUT the in-jit
+    #: counter updates (the dm passes through untouched — the overhead
+    #: A/B baseline and an escape hatch)
+    collect_device_metrics = True
+    #: the carried DeviceMetrics (device pytree); None until reset() on a
+    #: supporting pipeline
+    dm = None
 
     def set_observability(self, obs) -> None:
         """Attach an :class:`scotty_tpu.obs.Observability`; pass ``None``
@@ -267,8 +279,25 @@ class FusedPipelineDriver:
         histogram, ``ingest_tuples`` counter; per :meth:`sync`:
         ``sync_ms`` histogram + ``slice_occupancy``/``slice_headroom``
         gauges (sync is the drain point — the one place occupancy is
-        host-known without adding a device round trip)."""
+        host-known without adding a device round trip) + the in-jit
+        DeviceMetrics delta folded as ``device_*`` counters. Attaching
+        mid-run baselines the device counters at the last drained
+        snapshot, so pre-attach (warmup) tuples don't pollute the fold."""
         self.obs = obs
+        if obs is not None and self._uses_device_metrics:
+            self._dm_folded = getattr(self, "_dm_host", None)
+
+    def device_metrics(self):
+        """Fetch + flatten the in-jit DeviceMetrics as a ``device_*`` name
+        → int dict (one device sync). None when this pipeline doesn't
+        thread device telemetry or hasn't started."""
+        if self.dm is None:
+            return None
+        import jax
+
+        from ..obs import device as _dev
+
+        return _dev.host_snapshot(jax.device_get(self.dm))
 
     def _interval_tuples(self, i: int) -> int:
         """Host-known tuple count interval ``i`` ingests (telemetry)."""
@@ -280,6 +309,12 @@ class FusedPipelineDriver:
         self._root = jax.random.PRNGKey(self.seed)
         self._interval = 0
         self._init_pipeline_state()
+        if self._uses_device_metrics:
+            from ..obs import device as _dev
+
+            self.dm = _dev.init_device_metrics()
+            self._dm_host = None
+            self._dm_folded = None
         self._pipeline_ready = True
 
     def _interval_key(self, i: int):
@@ -293,7 +328,11 @@ class FusedPipelineDriver:
         return not getattr(self, "_pipeline_ready", False)
 
     def _step_interval(self, key, i: int):
-        self.state, res = self._step(self.state, key, np.int64(i))
+        if self._uses_device_metrics:
+            self.state, self.dm, res = self._step(self.state, self.dm, key,
+                                                  np.int64(i))
+        else:
+            self.state, res = self._step(self.state, key, np.int64(i))
         return res
 
     def _sync_anchor(self):
@@ -328,12 +367,22 @@ class FusedPipelineDriver:
                                     # separate kernel outside the step
 
     def sync(self) -> int:
-        """Drain all queued device work; returns the anchor scalar."""
+        """Drain all queued device work; returns the anchor scalar. The
+        in-jit DeviceMetrics pytree rides the same fetch (no extra round
+        trip) and its delta folds into the registry as ``device_*``
+        counters."""
         import jax
 
         obs = self.obs
         t0 = time.perf_counter() if obs is not None else 0.0
-        v = int(jax.device_get(self._sync_anchor()))
+        if self.dm is not None:
+            from ..obs import device as _dev
+
+            v, dm_h = jax.device_get((self._sync_anchor(), self.dm))
+        else:
+            dm_h = None
+            v = jax.device_get(self._sync_anchor())
+        v = int(v)
         if obs is not None:
             obs.histogram(_obs.SYNC_MS).observe(
                 (time.perf_counter() - t0) * 1e3)
@@ -341,6 +390,12 @@ class FusedPipelineDriver:
             if self._anchor_is_slices and cap:
                 obs.gauge(_obs.SLICE_OCCUPANCY).set(v / cap)
                 obs.gauge(_obs.SLICE_HEADROOM).set(cap - v)
+        if dm_h is not None:
+            snap = _dev.host_snapshot(dm_h)
+            self._dm_host = snap
+            if obs is not None:
+                self._dm_folded = _dev.fold_into(obs.registry, snap,
+                                                 self._dm_folded)
         return v
 
 
@@ -354,16 +409,21 @@ class StreamPipeline(FusedPipelineDriver):
     cadence; the reference triggers per watermark, not per tuple).
     """
 
+    _uses_device_metrics = True
+
     def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
                  config: Optional[EngineConfig] = None,
                  throughput: int = 50_000_000, wm_period_ms: int = 1000,
                  max_lateness: int = 1000, seed: int = 0,
-                 sub_batch: int = 1 << 18, out_of_order_pct: float = 0.0):
+                 sub_batch: int = 1 << 18, out_of_order_pct: float = 0.0,
+                 collect_device_metrics: bool = True):
         import jax
         import jax.numpy as jnp
 
         from . import core as ec
+        from ..obs import device as _dev
 
+        self.collect_device_metrics = bool(collect_device_metrics)
         self.config = config or EngineConfig()
         self.windows = list(windows)
         self.aggregations = list(aggregations)
@@ -435,13 +495,17 @@ class StreamPipeline(FusedPipelineDriver):
         # previous one. Latent until max_lateness < wm_period.
         first_lw = max(0, P - max_lateness)
 
-        def step(state, key, interval_idx):
+        cdm = self.collect_device_metrics
+
+        def step(state, dm, key, interval_idx):
             base = interval_idx * P
             last_wm = jnp.where(interval_idx > 0, base,
                                 jnp.int64(first_lw))
             wm = base + P
+            n_pre = state.n_slices
 
-            def body(st, g):
+            def body(carry, g):
+                st, dmc = carry
                 kg = jax.random.fold_in(key, g)
                 lo = (base + g * span).astype(jnp.float64)
                 gaps = jax.random.uniform(kg, (B,), dtype=jnp.float32)
@@ -457,21 +521,43 @@ class StreamPipeline(FusedPipelineDriver):
                     lts = (lo_l + jnp.sort(u[0]).astype(jnp.float64)
                            * (lo - lo_l)).astype(jnp.int64)
                     lvals = u[1] * 10_000.0
+                    if cdm:
+                        # the arrival-order running max at this point IS
+                        # st.max_event_time (the base sub-batch just
+                        # folded), so the age calculus matches a host
+                        # replay of the same arrival order exactly
+                        lmask = jnp.asarray(valid_late)
+                        dmc = _dev.record_late_ages(
+                            dmc, st.max_event_time - lts, lmask)
+                        dmc = dmc._replace(
+                            late=dmc.late + jnp.sum(lmask))
                     st = ingest_general(st, lts, lvals,
                                         jnp.asarray(valid_late))
-                return st, None
+                return (st, dmc), None
 
-            state, _ = jax.lax.scan(body, state, jnp.arange(G))
+            (state, dm), _ = jax.lax.scan(body, (state, dm),
+                                          jnp.arange(G))
             if B_late:
                 state = annex_merge(state)
             ws, we, tmask = make_triggers(last_wm, wm)
             is_count = jnp.zeros_like(tmask)
             cnt, results = query(state, ws, we, tmask, is_count)
             bound = wm - max_lateness - max_fixed
+            if cdm:
+                dm = dm._replace(
+                    ingested=dm.ingested
+                    + jnp.int64(G * (B + (n_late if B_late else 0))),
+                    triggers=dm.triggers + jnp.sum(tmask),
+                    windows_nonempty=dm.windows_nonempty
+                    + jnp.sum(tmask & (cnt > 0)),
+                    slices_touched=dm.slices_touched + jnp.maximum(
+                        state.n_slices - n_pre, 0))
             state = gc(state, jnp.int64(bound))
-            return state, (ws, we, cnt, results)
+            if cdm:
+                dm = _dev.record_occupancy(dm, state.n_slices, C)
+            return state, dm, (ws, we, cnt, results)
 
-        self._step = jax.jit(step, donate_argnums=0)
+        self._step = jax.jit(step, donate_argnums=(0, 1))
         self._root = None
         self.state = None
         self._interval = 0
@@ -487,6 +573,52 @@ class StreamPipeline(FusedPipelineDriver):
                 self.obs.counter(_obs.OVERFLOWS).inc()
             raise RuntimeError("slice buffer overflow: raise capacity or "
                                "advance watermarks more often")
+
+    def materialize_interval(self, i: int):
+        """Regenerate interval i's tuple stream on host (testing), in
+        ARRIVAL order: per sub-batch, the B in-order lanes then that
+        sub-batch's late lanes. Uses the exact jnp op sequence of the
+        fused step's generator, so the replay is bit-identical — the
+        oracle face the device-telemetry differential tests replay
+        through the host simulator."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._root is None:
+            self._root = jax.random.PRNGKey(self.seed)
+        key = self._interval_key(i)
+        P, G, B, B_late = self.wm_period_ms, self.G, self.B, self.B_late
+        span = P / G
+        n_late = int(B * self.out_of_order_pct) if B_late else 0
+        base = np.int64(i) * P
+        max_lateness = self.max_lateness
+
+        def one(g):
+            kg = jax.random.fold_in(key, g)
+            lo = (base + g * span).astype(jnp.float64)
+            gaps = jax.random.uniform(kg, (B,), dtype=jnp.float32)
+            gaps = gaps / jnp.sum(gaps) * span
+            ts = lo.astype(jnp.int64) + jnp.cumsum(gaps).astype(jnp.int64)
+            vals = jax.random.uniform(kg, (B,), dtype=jnp.float32) * 10_000
+            if not B_late:
+                return ts, vals
+            kl = jax.random.fold_in(kg, 7)
+            u = jax.random.uniform(kl, (2, B_late), dtype=jnp.float32)
+            lo_l = jnp.maximum(lo - max_lateness, 0.0)
+            lts = (lo_l + jnp.sort(u[0]).astype(jnp.float64)
+                   * (lo - lo_l)).astype(jnp.int64)
+            return ts, vals, lts, u[1] * 10_000.0
+
+        parts_v, parts_t = [], []
+        for g in range(G):
+            out = jax.device_get(one(jnp.int64(g)))
+            parts_v.append(out[1])
+            parts_t.append(out[0])
+            if B_late and n_late:
+                parts_v.append(out[3][:n_late])
+                parts_t.append(out[2][:n_late])
+        return (np.concatenate(parts_v).astype(np.float32),
+                np.concatenate(parts_t).astype(np.int64))
 
     def lowered_results(self, interval_out) -> list:
         """Fetch + lower one interval's window results on host."""
@@ -548,17 +680,32 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                 members.append(int(w.slide))
         return _gcd_all(members)
 
+    _uses_device_metrics = True
+
     def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
                  config: Optional[EngineConfig] = None,
                  throughput: int = 200_000_000, wm_period_ms: int = 1000,
                  max_lateness: int = 1000, seed: int = 0, gc_every: int = 32,
                  max_chunk_elems: int = 1 << 25, value_scale: float = 10_000.0,
-                 out_of_order_pct: float = 0.0):
+                 out_of_order_pct: float = 0.0,
+                 collect_device_metrics: bool = True,
+                 legacy_generator: bool = False):
         import jax
         import jax.numpy as jnp
 
         from . import core as ec
+        from ..obs import device as _dev
 
+        self.collect_device_metrics = bool(collect_device_metrics)
+        #: ADVICE r5: the r5 generator cheapened the benchmark workload
+        #: itself (16-bit half-draws, offset stream dropped), so r4→r5
+        #: cell comparisons mix engine speedup with workload reduction.
+        #: ``legacy_generator=True`` pins the r4-era stream cost — one
+        #: full 32-bit uniform draw per VALUE plus a generated per-tuple
+        #: OFFSET stream (consumed by the row's t_first/t_last extrema,
+        #: which stays containment-identical on the aligned grid) — so
+        #: cross-round sweeps keep one workload-identical anchor cell.
+        self.legacy_generator = bool(legacy_generator)
         self.config = config or EngineConfig()
         self.windows = list(windows)
         self.aggregations = list(aggregations)
@@ -700,8 +847,9 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         first_lw = max(0, P - max_lateness)   # first-watermark clamp
                                               # (WindowManager.java:43-45)
         L = self.n_late
+        cdm = self.collect_device_metrics
 
-        def late_fold(state, key, base):
+        def late_fold(state, dm, key, base):
             """Fold this interval's late tuples into their covering slices.
 
             Runs BEFORE the base append: at this point the top slice is the
@@ -753,11 +901,30 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             n_ok = jnp.where(ok, jnp.int64(L), jnp.int64(0))
             bad = ok & jnp.any((row < 0)
                                | (row >= state.n_slices.astype(jnp.int64)))
+            if cdm:
+                # EXACT arrival-order lateness: the canonical stream (the
+                # materialize_* replay faces) has the base tuples at their
+                # row starts, so the running max entering this fold is
+                # base - g; within the fold it evolves lane by lane
+                # (cummax), and a lane is late iff its ts is strictly
+                # below the running max at ITS arrival — the same
+                # calculus a host replay of the arrival order computes.
+                seed = jnp.reshape(base - g, (1,))
+                rm = jax.lax.cummax(jnp.concatenate([seed, lts[:-1]]))
+                late_m = ok & (lts < rm)
+                dm = _dev.record_late_ages(dm, rm - lts, late_m)
+                dm = dm._replace(
+                    ingested=dm.ingested + n_ok,
+                    late=dm.late + jnp.sum(late_m),
+                    dropped=dm.dropped + jnp.sum(
+                        jnp.where(ok & (row < 0), jnp.int64(1), 0)),
+                    slices_touched=dm.slices_touched
+                    + jnp.sum((d32 > 0).astype(jnp.int64)))
             return state._replace(
                 counts=state.counts + d32.astype(jnp.int64),
                 partials=tuple(partials),
                 current_count=state.current_count + n_ok,
-                overflow=state.overflow | bad)
+                overflow=state.overflow | bad), dm
 
         def gen_rows(key, rows):
             """The paced generator: R tuples per slice row (the reference's
@@ -786,7 +953,7 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         span_l8 = self._late_span
         R_l8 = self._late_R
 
-        def late_fold_segment(state, key, base):
+        def late_fold_segment(state, dm, key, base):
             """Scatter-free late fold (dense aggs): this interval's late
             tuples, R_l8 per slice row over the ``span_l8`` rows covering
             [base - max_lateness, base) — a stratified rendering of the
@@ -843,14 +1010,62 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             needed = (base - lo_l) // g
             have = jnp.minimum(n.astype(jnp.int64), jnp.int64(span_l8))
             bad = (base > 0) & (needed > have)
+            if cdm:
+                # EXACT arrival-order lateness (see late_fold): the
+                # stratified rendering has real per-tuple offsets in the
+                # replay face (materialize_interval_late u[:, 1]); replay
+                # order is rows ascending, lanes in draw order. Running
+                # max enters at base - g (the canonical stream's head)
+                # and evolves by cummax over the flattened lane order.
+                offs = jnp.clip(jnp.floor(u[:, 1] * jnp.float32(g)), 0,
+                                g - 1).astype(jnp.int64)   # [span, R]
+                lts_full = row_ts[:, None] + offs
+                lane_ok = jnp.broadcast_to(valid[:, None], lts_full.shape)
+                flat = jnp.where(lane_ok, lts_full,
+                                 jnp.int64(-(1 << 62))).reshape(-1)
+                seed = jnp.reshape(base - g, (1,))
+                rm = jax.lax.cummax(jnp.concatenate([seed, flat[:-1]]))
+                late_m = lane_ok.reshape(-1) & (flat < rm)
+                dm = _dev.record_late_ages(dm, rm - flat, late_m)
+                dm = dm._replace(
+                    ingested=dm.ingested + jnp.sum(add_cnt),
+                    late=dm.late + jnp.sum(late_m),
+                    slices_touched=dm.slices_touched
+                    + jnp.sum(valid.astype(jnp.int64)))
             return state._replace(
                 counts=counts, partials=tuple(partials),
                 current_count=state.current_count + jnp.sum(add_cnt),
-                overflow=state.overflow | bad)
+                overflow=state.overflow | bad), dm
 
         late_fold_active = late_fold_segment if span_l8 else late_fold
 
         n_sub = self._n_sub
+        legacy = self.legacy_generator
+        if legacy and n_sub > 1:
+            raise NotImplementedError(
+                "legacy_generator: pick a shape whose rows fit the chunk "
+                "budget (sub-row chunking postdates the r4 generator)")
+        if legacy and L:
+            raise NotImplementedError(
+                "legacy_generator: the cross-round anchor cell is "
+                "in-order (out_of_order_pct must be 0)")
+
+        def gen_rows_legacy(key, rows):
+            """The r4-era generator, pinned for the cross-round anchor
+            cell (ADVICE r5): one full 32-bit uniform draw per VALUE and
+            a generated per-tuple OFFSET stream (uniform in [0, g)), both
+            keyed per absolute row. The offsets feed the row's
+            t_first/t_last extrema — containment-identical on the aligned
+            grid, but the draws stay live so the workload cost matches
+            r4, not r5's halved-draw stream."""
+            keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
+            vals = jax.vmap(lambda k: jax.random.uniform(
+                k, (R,), dtype=jnp.float32) * value_scale)(keys)
+            offs = jax.vmap(lambda k: jnp.clip(jnp.floor(
+                jax.random.uniform(jax.random.fold_in(k, 1), (R,),
+                                   dtype=jnp.float32) * g),
+                0, g - 1).astype(jnp.int64))(keys)
+            return vals, offs
 
         def lift_chunk(flat, dd, RR):
             """Per-aggregation [dd, width] partials of a flat [dd*RR]
@@ -904,11 +1119,12 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                     parts.append(red[aspec.kind](lifted, axis=1))
             return parts
 
-        def step_impl(state, key, interval_idx, d):
+        def step_impl(state, dm, key, interval_idx, d):
             base = interval_idx * P
             if L:
-                state = late_fold_active(state, key, base)
+                state, dm = late_fold_active(state, dm, key, base)
 
+            off_first_rows = off_last_rows = None
             if n_sub > 1:
                 # sub-row chunking (see __init__): q lanes of one row per
                 # scan step, keyed per absolute (row, sub) pair. The two
@@ -950,6 +1166,19 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                 parts = tuple(
                     red[a.kind](p.reshape(S, n_sub, -1), axis=1)
                     for a, p in zip(spec.aggs, stacked))
+            elif legacy:
+                def body(_, c):
+                    rows = c * d + jnp.arange(d, dtype=jnp.int64)
+                    vals, offs = gen_rows_legacy(key, rows)
+                    return None, (tuple(lift_chunk(vals.reshape(-1), d, R)),
+                                  jnp.min(offs, axis=1),
+                                  jnp.max(offs, axis=1))
+
+                _, (stacked, off_mins, off_maxs) = jax.lax.scan(
+                    body, None, jnp.arange(S // d))
+                parts = tuple(p.reshape(S, -1) for p in stacked)
+                off_first_rows = off_mins.reshape(S)
+                off_last_rows = off_maxs.reshape(S)
             else:
                 def body(_, c):
                     vals = gen_rows(
@@ -964,9 +1193,13 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             # tuples sit at their row start (the offset stream is
             # unobservable on the aligned grid and not generated — see
             # gen_rows); t_last takes the conservative row bound, which
-            # gives IDENTICAL query containment for grid-aligned edges
-            t_first = row_starts
-            t_last = row_starts + (g - 1)
+            # gives IDENTICAL query containment for grid-aligned edges.
+            # The legacy anchor generates real offsets and uses their
+            # extrema instead (same containment on the aligned grid).
+            t_first = row_starts if off_first_rows is None \
+                else row_starts + off_first_rows
+            t_last = row_starts + (g - 1) if off_last_rows is None \
+                else row_starts + off_last_rows
             n = state.n_slices
 
             def app(buf, rows):
@@ -995,7 +1228,15 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             ws, we, tmask = make_triggers(last_wm, base + P)
             cnt, results = query(state, ws, we, tmask,
                                  jnp.zeros_like(tmask))
-            return state, (ws, we, cnt, results)
+            if cdm:
+                dm = dm._replace(
+                    ingested=dm.ingested + jnp.int64(S * R),
+                    triggers=dm.triggers + jnp.sum(tmask),
+                    windows_nonempty=dm.windows_nonempty
+                    + jnp.sum(tmask & (cnt > 0)),
+                    slices_touched=dm.slices_touched + jnp.int64(S))
+                dm = _dev.record_occupancy(dm, state.n_slices, C)
+            return state, dm, (ws, we, cnt, results)
 
         self._step_impl = step_impl
         self._gen_rows = gen_rows
@@ -1021,10 +1262,10 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         self._n_chunks = self.S // d
         impl = self._step_impl
 
-        def step_at_d(state, key, interval_idx):
-            return impl(state, key, interval_idx, d)
+        def step_at_d(state, dm, key, interval_idx):
+            return impl(state, dm, key, interval_idx, d)
 
-        self._step = jax.jit(step_at_d, donate_argnums=0)
+        self._step = jax.jit(step_at_d, donate_argnums=(0, 1))
         self._pipeline_ready = False
 
     def chunk_candidates(self, k: int = 3) -> list:
@@ -1143,6 +1384,23 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             self._root = jax.random.PRNGKey(self.seed)
         key = self._interval_key(i)
         g, P, S = self.grid, self.wm_period_ms, self.S
+        if self.legacy_generator:
+            # legacy anchor replay: 32-bit value draws + the offset stream
+            # (see gen_rows_legacy) — per-tuple ts = row start + offset
+            keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(
+                jnp.arange(S, dtype=jnp.int64))
+            vals = np.asarray(jax.device_get(jax.vmap(
+                lambda k: jax.random.uniform(
+                    k, (self.R,), dtype=jnp.float32)
+                * self.value_scale)(keys)))
+            offs = np.asarray(jax.device_get(jax.vmap(
+                lambda k: jnp.clip(jnp.floor(jax.random.uniform(
+                    jax.random.fold_in(k, 1), (self.R,),
+                    dtype=jnp.float32) * g), 0, g - 1)
+                .astype(jnp.int64))(keys)))
+            row_starts = i * P + g * np.arange(S, dtype=np.int64)
+            ts = row_starts[:, None] + offs
+            return vals.reshape(-1), ts.reshape(-1)
         if self._n_sub > 1:
             # sub-row chunking: per-(row, sub) keying (see step_impl) —
             # one vmapped generation over all (row, sub) pairs, not a

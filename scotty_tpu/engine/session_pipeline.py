@@ -67,19 +67,24 @@ class SessionStreamPipeline(FusedPipelineDriver):
     to exceed a session gap still does).
     """
 
+    _uses_device_metrics = True
+
     def __init__(self, windows: Sequence, aggregations: Sequence[AggregateFunction],
                  config: Optional[EngineConfig] = None,
                  throughput: int = 32_000_000, wm_period_ms: int = 1000,
                  max_lateness: int = 1000, seed: int = 0,
                  session_config: Optional[dict] = None, gc_every: int = 32,
                  max_chunk_elems: int = 1 << 25,
-                 value_scale: float = 10_000.0):
+                 value_scale: float = 10_000.0,
+                 collect_device_metrics: bool = True):
         import jax
         import jax.numpy as jnp
 
         from . import core as ec
         from . import sessions as es
+        from ..obs import device as _dev
 
+        self.collect_device_metrics = bool(collect_device_metrics)
         self.config = config or EngineConfig()
         self.windows = list(windows)
         self.aggregations = list(aggregations)
@@ -256,10 +261,22 @@ class SessionStreamPipeline(FusedPipelineDriver):
         off_first = 0
         off_last = ((R - 1) * g) // R
 
-        def step(grid_state, sess_states, key, interval_idx, live):
+        cdm = self.collect_device_metrics
+
+        def step(grid_state, sess_states, dm, key, interval_idx, live):
             """live: i1 scalar — False = silent interval (no tuples)."""
             base = interval_idx * P
             wm = base + P
+            if cdm:
+                dm = dm._replace(
+                    ingested=dm.ingested
+                    + jnp.where(live, jnp.int64(S * R), 0),
+                    silent_intervals=dm.silent_intervals
+                    + jnp.where(live, 0, jnp.int64(1)),
+                    slices_touched=dm.slices_touched + jnp.where(
+                        live,
+                        jnp.int64((S if self.has_grid else 0) + len(gaps)),
+                        0))
 
             def gen_and_fold(_):
                 def body(carry, c):
@@ -408,6 +425,13 @@ class SessionStreamPipeline(FusedPipelineDriver):
                 results = tuple(jnp.zeros((0, a.width), jnp.float32)
                                 for a in spec.aggs)
 
+            if cdm and self.has_grid:
+                dm = dm._replace(
+                    triggers=dm.triggers + jnp.sum(tmask),
+                    windows_nonempty=dm.windows_nonempty
+                    + jnp.sum(tmask & (cnt > 0)))
+                dm = _dev.record_occupancy(dm, grid_state.n_slices, C)
+
             # ---- session updates: at most one row per window -------------
             new_states = []
             ws_parts, we_parts, cnt_parts = [ws], [we], [cnt]
@@ -449,16 +473,22 @@ class SessionStreamPipeline(FusedPipelineDriver):
                 we_parts.append(e_e)
                 cnt_parts.append(e_c)
                 res_parts.append(e_p)
+                if cdm:
+                    # every completed session is both a trigger and a
+                    # non-empty window (empty sessions don't exist)
+                    m64 = jnp.asarray(m, jnp.int64)
+                    dm = dm._replace(
+                        triggers=dm.triggers + m64,
+                        windows_nonempty=dm.windows_nonempty + m64)
 
             out = (jnp.concatenate(ws_parts), jnp.concatenate(we_parts),
                    jnp.concatenate(cnt_parts),
                    tuple(jnp.concatenate([r[i] for r in res_parts])
                          for i in range(len(spec.aggs))))
-            return grid_state, new_states, out
+            return grid_state, new_states, dm, out
 
-        self._step = jax.jit(step, donate_argnums=(0, 1),
-                             static_argnames=()) if self.has_grid else \
-            jax.jit(step, donate_argnums=(1,))
+        self._step = jax.jit(step, donate_argnums=(0, 1, 2)) \
+            if self.has_grid else jax.jit(step, donate_argnums=(1, 2))
         self._root = None
         self.state = None
         self.sess_states = None
@@ -470,8 +500,8 @@ class SessionStreamPipeline(FusedPipelineDriver):
         self.sess_states = self._init_sessions()
 
     def _step_interval(self, key, i: int):
-        self.state, self.sess_states, res = self._step(
-            self.state, self.sess_states, key, np.int64(i),
+        self.state, self.sess_states, self.dm, res = self._step(
+            self.state, self.sess_states, self.dm, key, np.int64(i),
             np.bool_(self.live(i)))
         return res
 

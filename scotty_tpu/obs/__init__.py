@@ -15,9 +15,15 @@ package replaces that split with a first-class subsystem:
 * ``python -m scotty_tpu.obs report <file>`` — summarize any export
   (:mod:`.report`).
 
-Every hook is host-side and records at batch/interval boundaries — nothing
-enters a jitted code path, preserving the reference's silent-core
-discipline (the engine itself never prints; tier-1 enforces it).
+Host-side hooks record at batch/interval boundaries; the engine itself
+never prints (tier-1 enforces it). What happens INSIDE a fused interval is
+covered by the in-jit :mod:`.device` layer: a :class:`.device.DeviceMetrics`
+pytree of int64 counters/bucket histograms rides the carried state of every
+fused pipeline and the operator's ingest paths, and is folded into the
+registry (``device_*`` names) at the existing drain points — zero extra
+host syncs. ``python -m scotty_tpu.obs diff <baseline> <candidate>``
+(:mod:`.diff`) turns any two metric/bench exports into a CI-enforceable
+regression gate.
 
 Stable metric-name contract (documented in README.md / docs/API.md):
 
@@ -46,6 +52,17 @@ from __future__ import annotations
 from typing import Optional
 
 from ..utils.metrics import MetricsRegistry
+from .device import (
+    DEVICE_DROPPED_TUPLES,
+    DEVICE_INGEST_TUPLES,
+    DEVICE_LATE_TUPLES,
+    DEVICE_SILENT_INTERVALS,
+    DEVICE_SLICES_TOUCHED,
+    DEVICE_TRIGGERS_FIRED,
+    DEVICE_WINDOWS_NONEMPTY,
+    DeviceMetrics,
+    init_device_metrics,
+)
 from .exporters import JsonlExporter, prometheus_text, write_chrome_trace
 from .spans import Span, SpanRecorder
 
@@ -119,6 +136,10 @@ class Observability:
 __all__ = [
     "Observability", "MetricsRegistry", "SpanRecorder", "Span",
     "JsonlExporter", "prometheus_text", "write_chrome_trace",
+    "DeviceMetrics", "init_device_metrics",
+    "DEVICE_INGEST_TUPLES", "DEVICE_LATE_TUPLES", "DEVICE_DROPPED_TUPLES",
+    "DEVICE_TRIGGERS_FIRED", "DEVICE_WINDOWS_NONEMPTY",
+    "DEVICE_SLICES_TOUCHED", "DEVICE_SILENT_INTERVALS",
     "INGEST_TUPLES", "INGEST_BATCH_SIZE", "LATE_TUPLES", "DROPPED_TUPLES",
     "WATERMARKS", "WATERMARK_LAG_MS", "WATERMARK_DISPATCH_MS",
     "INTERVAL_STEP_MS", "SYNC_MS", "SLICE_OCCUPANCY", "SLICE_HEADROOM",

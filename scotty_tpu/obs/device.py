@@ -1,0 +1,212 @@
+"""In-jit device telemetry: the :class:`DeviceMetrics` pytree.
+
+PR 1's host-side hooks stop at the jit boundary ("nothing enters jitted
+code"), so everything inside a fused interval — late-tuple strata,
+dropped lanes, trigger counts, slice occupancy between sync points — was
+invisible exactly where the headline-vs-general-case gap lives. Scotty's
+own evaluation leans on per-slice accounting to explain throughput
+(Traub et al., TODS 2021 §7); this module is the TPU-native equivalent:
+
+* :class:`DeviceMetrics` — a tiny pytree of int64 counter and
+  bucket-histogram leaves, threaded through the CARRIED STATE of every
+  fused pipeline (``engine/pipeline.py`` StreamPipeline +
+  AlignedStreamPipeline, ``engine/count_pipeline.py``,
+  ``engine/session_pipeline.py``) and updated by
+  ``TpuWindowOperator``'s ingest paths. Updates are a handful of scalar
+  adds plus (on out-of-order intervals only) one small bucket scatter
+  over the LATE lanes — zero host syncs anywhere.
+* At :meth:`FusedPipelineDriver.sync` / ``check_overflow`` (the drain
+  points that already pay a device round trip) the pytree rides the same
+  ``device_get`` and :func:`fold_into` folds the DELTA since the last
+  fold into the host :class:`~scotty_tpu.utils.metrics.MetricsRegistry`
+  under the stable ``device_*`` metric names below.
+
+Stable device-metric names (extending the scotty_tpu.obs contract):
+
+=============================  ==========================================
+``device_ingest_tuples``       tuples folded on device (pipelines count
+                               generated lanes; the operator counts
+                               ingested batch lanes)
+``device_late_tuples``         tuples that arrived below the stream's
+                               max event time, counted IN the jitted step
+``device_late_age_ms_le_<e>``  late tuples with displacement ≤ e ms
+                               (age = max event time − ts at arrival;
+                               bucket edges :data:`LATE_AGE_EDGES_MS`,
+                               last bucket ``device_late_age_ms_inf``)
+``device_dropped_tuples``      late lanes whose covering slice row was
+                               gone (masked to the drop sentinel)
+``device_triggers_fired``      valid trigger-grid entries enumerated
+``device_windows_nonempty``    triggers whose window held ≥ 1 tuple
+``device_slices_touched``      slice/ms rows written (appends + late
+                               fold targets)
+``device_silent_intervals``    session-pipeline intervals with no tuples
+``device_occupancy_bucket_<i>``  intervals that ended with live-slice
+                               occupancy in capacity-octile bucket i
+                               (i of :data:`N_OCC_BUCKETS` = 8)
+=============================  ==========================================
+
+Counter semantics are cumulative within one pipeline/operator lifetime
+(reset() re-zeroes); :func:`fold_into` converts to registry increments.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+#: late-age bucket UPPER edges in ms (ages above the last edge land in the
+#: overflow bucket) — powers of 4 cover sub-slice jitter through
+#: multi-interval lateness
+LATE_AGE_EDGES_MS = (4, 16, 64, 256, 1024, 4096, 16384)
+N_LATE_BUCKETS = len(LATE_AGE_EDGES_MS) + 1
+#: slice-occupancy histogram resolution (bucket i covers
+#: [i/N, (i+1)/N) of capacity)
+N_OCC_BUCKETS = 8
+
+DEVICE_INGEST_TUPLES = "device_ingest_tuples"
+DEVICE_LATE_TUPLES = "device_late_tuples"
+DEVICE_DROPPED_TUPLES = "device_dropped_tuples"
+DEVICE_TRIGGERS_FIRED = "device_triggers_fired"
+DEVICE_WINDOWS_NONEMPTY = "device_windows_nonempty"
+DEVICE_SLICES_TOUCHED = "device_slices_touched"
+DEVICE_SILENT_INTERVALS = "device_silent_intervals"
+
+_SCALAR_FIELDS = (
+    ("ingested", DEVICE_INGEST_TUPLES),
+    ("late", DEVICE_LATE_TUPLES),
+    ("dropped", DEVICE_DROPPED_TUPLES),
+    ("triggers", DEVICE_TRIGGERS_FIRED),
+    ("windows_nonempty", DEVICE_WINDOWS_NONEMPTY),
+    ("slices_touched", DEVICE_SLICES_TOUCHED),
+    ("silent_intervals", DEVICE_SILENT_INTERVALS),
+)
+
+
+def late_bucket_names() -> list:
+    """Registry names of the late-age buckets, in bucket order."""
+    return [f"device_late_age_ms_le_{e}" for e in LATE_AGE_EDGES_MS] \
+        + ["device_late_age_ms_inf"]
+
+
+def occupancy_bucket_names() -> list:
+    return [f"device_occupancy_bucket_{i}" for i in range(N_OCC_BUCKETS)]
+
+
+class DeviceMetrics(NamedTuple):
+    """Counter/histogram leaves carried through a fused step. All leaves
+    int64 device scalars/vectors; never synced except at drain points."""
+
+    ingested: object          # i64 [] — tuples folded on device
+    late: object              # i64 [] — late tuples (arrived below max ts)
+    dropped: object           # i64 [] — late lanes masked to the sentinel
+    triggers: object          # i64 [] — valid trigger-grid entries
+    windows_nonempty: object  # i64 [] — triggers with >= 1 tuple
+    slices_touched: object    # i64 [] — slice/ms rows written
+    silent_intervals: object  # i64 [] — empty intervals (session pipeline)
+    late_age_hist: object     # i64 [N_LATE_BUCKETS]
+    occupancy_hist: object    # i64 [N_OCC_BUCKETS]
+
+
+def init_device_metrics() -> DeviceMetrics:
+    import jax.numpy as jnp
+
+    # distinct buffers per leaf: the step donates the whole pytree, and
+    # aliased zero scalars would be "the same buffer donated twice"
+    def z():
+        return jnp.zeros((), jnp.int64)
+
+    return DeviceMetrics(
+        ingested=z(), late=z(), dropped=z(), triggers=z(),
+        windows_nonempty=z(), slices_touched=z(), silent_intervals=z(),
+        late_age_hist=jnp.zeros((N_LATE_BUCKETS,), jnp.int64),
+        occupancy_hist=jnp.zeros((N_OCC_BUCKETS,), jnp.int64),
+    )
+
+
+# ---------------------------------------------------------------------------
+# In-jit update helpers (call from inside traced step functions only)
+# ---------------------------------------------------------------------------
+
+
+def late_age_bucket(ages):
+    """Bucket index of each age (ms): ``searchsorted`` over the shared
+    edges, so host and device bucket identically."""
+    import jax.numpy as jnp
+
+    edges = jnp.asarray(LATE_AGE_EDGES_MS, jnp.int64)
+    return jnp.searchsorted(edges, ages, side="left").astype(jnp.int32)
+
+
+def record_late_ages(dm: DeviceMetrics, ages, mask,
+                     weight=None) -> DeviceMetrics:
+    """Scatter late-lane ages into the age histogram. ``ages`` i64 [...],
+    ``mask`` bool broadcastable to ages (False lanes dropped), ``weight``
+    optional per-lane i64 tuple multiplicity (default 1)."""
+    import jax.numpy as jnp
+
+    ages = jnp.maximum(jnp.asarray(ages, jnp.int64), 0)
+    m = jnp.broadcast_to(jnp.asarray(mask, bool), ages.shape).reshape(-1)
+    b = late_age_bucket(ages.reshape(-1))
+    b = jnp.where(m, b, N_LATE_BUCKETS)            # out of range = drop
+    w = jnp.int64(1) if weight is None \
+        else jnp.broadcast_to(jnp.asarray(weight, jnp.int64),
+                              b.shape).reshape(-1)
+    hist = dm.late_age_hist.at[b].add(w, mode="drop")
+    return dm._replace(late_age_hist=hist)
+
+
+def record_occupancy(dm: DeviceMetrics, n_live, capacity: int
+                     ) -> DeviceMetrics:
+    """Bump the occupancy bucket for one interval's end-of-step live count
+    (``capacity`` static)."""
+    import jax.numpy as jnp
+
+    n = jnp.asarray(n_live, jnp.int64)
+    b = jnp.clip(n * N_OCC_BUCKETS // max(1, int(capacity)), 0,
+                 N_OCC_BUCKETS - 1).astype(jnp.int32)
+    return dm._replace(occupancy_hist=dm.occupancy_hist.at[b].add(1))
+
+
+def host_late_age_hist(ages) -> np.ndarray:
+    """The HOST mirror of the device bucketing — differential tests bucket
+    oracle-replayed late ages through this to assert exact equality."""
+    ages = np.maximum(np.asarray(ages, np.int64), 0)
+    b = np.searchsorted(np.asarray(LATE_AGE_EDGES_MS, np.int64), ages,
+                        side="left")
+    return np.bincount(b, minlength=N_LATE_BUCKETS).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Host-side fold (drain points)
+# ---------------------------------------------------------------------------
+
+
+def host_snapshot(dm_host: DeviceMetrics) -> dict:
+    """Flatten a fetched (host-side) DeviceMetrics into the stable
+    ``device_*`` name → int mapping."""
+    out = {}
+    for field, name in _SCALAR_FIELDS:
+        out[name] = int(np.asarray(getattr(dm_host, field)))
+    for name, v in zip(late_bucket_names(),
+                       np.asarray(dm_host.late_age_hist).tolist()):
+        out[name] = int(v)
+    for name, v in zip(occupancy_bucket_names(),
+                       np.asarray(dm_host.occupancy_hist).tolist()):
+        out[name] = int(v)
+    return out
+
+
+def fold_into(registry, snapshot: dict, prev: Optional[dict]) -> dict:
+    """Fold the delta between ``snapshot`` and ``prev`` (the last folded
+    snapshot; None = fold everything) into ``registry`` as counter
+    increments. Returns ``snapshot`` — store it as the next ``prev``.
+    Negative deltas (a pipeline reset between folds) re-fold from zero."""
+    for name, cur in snapshot.items():
+        base = 0 if prev is None else prev.get(name, 0)
+        delta = cur - base
+        if delta < 0:                   # reset() re-zeroed the pytree
+            delta = cur
+        if delta:
+            registry.counter(name).inc(delta)
+    return snapshot

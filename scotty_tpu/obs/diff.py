@@ -1,0 +1,198 @@
+"""``python -m scotty_tpu.obs diff <baseline> <candidate>`` — the metrics
+regression gate.
+
+Turns the structured exports (bench ``result_*.json`` cell lists, registry
+snapshot dicts, JSONL time series) into a CI-enforceable check instead of
+eyeballed BENCH_*.json diffs: a threshold file declares which metrics are
+gated, in which direction, and with how much slack; the command exits
+nonzero iff any gated metric regressed. ``--json`` emits the finding list
+for tooling; the default output is a human-readable table.
+
+Threshold file format (JSON)::
+
+    {
+      "metrics": {
+        "tuples_per_sec": {"direction": "higher", "rel_tol": 0.10},
+        "p99_emit_ms":    {"direction": "lower",  "rel_tol": 0.50,
+                           "abs_tol": 2.0},
+        "windows_emitted": {"direction": "equal"}
+      },
+      "require_cells": true
+    }
+
+* ``direction``: ``"higher"`` (candidate must not drop below baseline by
+  more than the tolerance), ``"lower"`` (must not rise), ``"equal"``
+  (must match within tolerance — default 0).
+* ``rel_tol`` / ``abs_tol``: slack; a change is a regression only when it
+  exceeds BOTH ``rel_tol * |baseline|`` and ``abs_tol`` (defaults 0).
+* ``require_cells`` (default true): a baseline cell missing from the
+  candidate is itself a regression (a silently dropped bench cell must
+  not pass the gate).
+
+With no threshold file, :data:`DEFAULT_THRESHOLDS` gates the headline
+bench fields (throughput down >10%, latency up >50%, errors appearing).
+Cells are matched by (name, windows, engine, aggregation); metric values
+are looked up in the cell row first, then its ``metrics`` section.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+DEFAULT_THRESHOLDS = {
+    "metrics": {
+        "tuples_per_sec": {"direction": "higher", "rel_tol": 0.10},
+        "p99_emit_ms": {"direction": "lower", "rel_tol": 0.50,
+                        "abs_tol": 2.0},
+        "emit_ms_device": {"direction": "lower", "rel_tol": 0.25,
+                           "abs_tol": 1.0},
+        "windows_emitted": {"direction": "equal"},
+    },
+    "require_cells": True,
+}
+
+
+def load_thresholds(path: Optional[str]) -> dict:
+    if path is None:
+        return DEFAULT_THRESHOLDS
+    with open(path) as f:
+        raw = json.load(f)
+    if "metrics" not in raw or not isinstance(raw["metrics"], dict):
+        raise ValueError(
+            f"threshold file {path}: needs a 'metrics' object "
+            "({name: {direction, rel_tol, abs_tol}})")
+    raw.setdefault("require_cells", True)
+    return raw
+
+
+def _cells(path: str) -> dict:
+    """Load an export as {cell_key: flat metric dict}.
+
+    Bench result JSON (a list of cell rows) keys cells by
+    (name|windows|engine|aggregation); snapshot dicts and JSONL series
+    collapse to one cell (JSONL: the LAST row, the end-of-run snapshot).
+    """
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "[":
+            rows = json.load(f)
+            out = {}
+            for row in rows:
+                key = "|".join(str(row.get(k, "")) for k in
+                               ("name", "windows", "engine", "aggregation"))
+                flat = {k: v for k, v in row.items()
+                        if isinstance(v, (int, float))
+                        and not isinstance(v, bool)}
+                m = row.get("metrics")
+                if isinstance(m, dict):
+                    inner = m.get("metrics", m)
+                    for k, v in inner.items():
+                        if isinstance(v, (int, float)) \
+                                and not isinstance(v, bool):
+                            flat.setdefault(k, v)
+                if "error" in row:
+                    flat["error"] = 1.0
+                out[key] = flat
+            return out
+        try:
+            obj = json.load(f)
+            rows = [obj]
+        except json.JSONDecodeError:
+            f.seek(0)
+            rows = [json.loads(line) for line in f if line.strip()]
+    last = rows[-1] if rows else {}
+    return {"": {k: v for k, v in last.items()
+                 if isinstance(v, (int, float)) and not isinstance(v, bool)}}
+
+
+def _check(spec: dict, base: float, cand: float):
+    """(regressed, rel_change). rel_change signed in the HARMFUL direction
+    (positive = worse)."""
+    direction = spec.get("direction", "equal")
+    rel_tol = float(spec.get("rel_tol", 0.0))
+    abs_tol = float(spec.get("abs_tol", 0.0))
+    if direction == "higher":
+        harm = base - cand
+    elif direction == "lower":
+        harm = cand - base
+    else:
+        harm = abs(cand - base)
+    rel = harm / abs(base) if base else (float("inf") if harm > 0 else 0.0)
+    regressed = harm > abs_tol and harm > rel_tol * abs(base)
+    return regressed, rel
+
+
+def diff_exports(baseline_path: str, candidate_path: str,
+                 thresholds: Optional[dict] = None) -> List[dict]:
+    """Compare two exports under a threshold spec; returns findings
+    (one per gated metric per matched cell, plus missing-cell rows)."""
+    th = thresholds or DEFAULT_THRESHOLDS
+    base_cells = _cells(baseline_path)
+    cand_cells = _cells(candidate_path)
+    findings = []
+    for key, base in base_cells.items():
+        cand = cand_cells.get(key)
+        if cand is None:
+            findings.append({
+                "cell": key, "metric": "(cell)", "status":
+                "regressed" if th.get("require_cells", True) else "missing",
+                "detail": "cell missing from candidate"})
+            continue
+        if cand.get("error") and not base.get("error"):
+            findings.append({"cell": key, "metric": "error",
+                             "status": "regressed",
+                             "detail": "candidate cell errored"})
+        for name, spec in th["metrics"].items():
+            if name not in base or name not in cand:
+                continue
+            regressed, rel = _check(spec, float(base[name]),
+                                    float(cand[name]))
+            findings.append({
+                "cell": key, "metric": name,
+                "baseline": float(base[name]),
+                "candidate": float(cand[name]),
+                "rel_change": rel,
+                "status": "regressed" if regressed else "ok"})
+    return findings
+
+
+def render_findings(findings: List[dict]) -> str:
+    lines = [f"  {'cell':44s} {'metric':22s} {'baseline':>14s} "
+             f"{'candidate':>14s} {'change':>9s}  status"]
+    for f in findings:
+        if "baseline" in f:
+            chg = f"{f['rel_change']:+.1%}" \
+                if f["rel_change"] != float("inf") else "inf"
+            lines.append(
+                f"  {f['cell'][:44]:44s} {f['metric'][:22]:22s} "
+                f"{f['baseline']:14,.2f} {f['candidate']:14,.2f} "
+                f"{chg:>9s}  {f['status'].upper()}")
+        else:
+            lines.append(
+                f"  {f['cell'][:44]:44s} {f['metric'][:22]:22s} "
+                f"{'':14s} {'':14s} {'':9s}  {f['status'].upper()} "
+                f"({f.get('detail', '')})")
+    return "\n".join(lines)
+
+
+def diff_main(baseline: str, candidate: str,
+              thresholds_path: Optional[str] = None,
+              as_json: bool = False, echo=None) -> int:
+    """The ``obs diff`` entry: 0 = no regression, 1 = regression found."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    th = load_thresholds(thresholds_path)
+    findings = diff_exports(baseline, candidate, th)
+    n_reg = sum(1 for f in findings if f["status"] == "regressed")
+    if as_json:
+        echo(json.dumps({"findings": findings, "regressions": n_reg},
+                        indent=1, default=float))
+    else:
+        echo(f"{baseline} -> {candidate} "
+             f"({len(findings)} checks, {n_reg} regressions)")
+        echo(render_findings(findings))
+    return 1 if n_reg else 0

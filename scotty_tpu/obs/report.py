@@ -159,15 +159,32 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="python -m scotty_tpu.obs",
-        description="Observability tools: summarize exported metrics files")
+        description="Observability tools: summarize exported metrics "
+                    "files, gate regressions between two exports")
     sub = ap.add_subparsers(dest="cmd", required=True)
     rp = sub.add_parser(
         "report", help="summarize a JSONL/bench-result/Chrome-trace export")
     rp.add_argument("file", help="path to the exported metrics file")
     rp.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of the table")
+    dp = sub.add_parser(
+        "diff", help="threshold-gated comparison of two metric/bench "
+                     "exports; exits nonzero on regression (the CI gate)")
+    dp.add_argument("baseline", help="baseline export (result_*.json, "
+                                     "snapshot dict, or JSONL)")
+    dp.add_argument("candidate", help="candidate export to gate")
+    dp.add_argument("--thresholds", default=None, metavar="FILE",
+                    help="threshold JSON (see obs/diff.py docstring); "
+                         "default gates the headline bench fields")
+    dp.add_argument("--json", action="store_true",
+                    help="machine-readable finding list")
     args = ap.parse_args(argv)
     if args.cmd == "report":
         print(render(args.file, as_json=args.json))
         return 0
+    if args.cmd == "diff":
+        from .diff import diff_main
+
+        return diff_main(args.baseline, args.candidate, args.thresholds,
+                         as_json=args.json)
     return 2                                            # pragma: no cover

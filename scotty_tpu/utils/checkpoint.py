@@ -18,6 +18,22 @@ from typing import Any
 import numpy as np
 
 
+def _device_copy(tree):
+    """XLA-OWNED copies of every leaf of a restored pytree.
+
+    ``jax.device_put`` of an aligned numpy array is zero-copy on CPU, so
+    a restored state fed straight into the engine's DONATING kernels lets
+    XLA recycle memory that Python/numpy (and any collected result
+    handles) still reference — observed as garbled resumed window bounds
+    and segfaults mid-step (tests/test_checkpoint_pipelines.py). An
+    explicit ``copy=True`` materialization guarantees fresh XLA-owned
+    buffers that are safe to donate."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda l: jnp.array(l, copy=True), tree)
+
+
 def _state_to_host(state) -> dict:
     import jax
 
@@ -118,7 +134,7 @@ def restore_engine_operator(op, path: str) -> None:
             "(they lack the record buffer); re-run from source data")
     cast = [np.asarray(l, dtype=np.asarray(t).dtype)
             for l, t in zip(leaves, template)]
-    _set_full_state(op, jax.tree.unflatten(treedef, cast))
+    _set_full_state(op, _device_copy(jax.tree.unflatten(treedef, cast)))
     _restore_meta(op, meta)
 
 
@@ -154,7 +170,7 @@ def restore_engine_operator_orbax(op, path: str) -> None:
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(os.path.join(os.path.abspath(path), "orbax"),
                              item=_full_state(op))
-    _set_full_state(op, restored)
+    _set_full_state(op, _device_copy(restored))
     _restore_meta(op, meta)
 
 
@@ -227,7 +243,7 @@ def restore_keyed_operator(op, path: str) -> None:
             "with the same windows/aggregations/config as saved")
     cast = [np.asarray(l, dtype=np.asarray(t).dtype)
             for l, t in zip(leaves, template)]
-    op._state = jax.tree.unflatten(treedef, cast)
+    op._state = _device_copy(jax.tree.unflatten(treedef, cast))
     if op.mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -310,7 +326,7 @@ def restore_pipeline(p, path: str) -> None:
     treedef = jax.tree.structure(tree)
     cast = [np.asarray(l, dtype=np.asarray(t).dtype)
             for l, t in zip(leaves, template)]
-    restored = jax.tree.unflatten(treedef, cast)
+    restored = _device_copy(jax.tree.unflatten(treedef, cast))
     p.state = restored["state"]
     if restored["sessions"]:
         p.sess_states = restored["sessions"]

@@ -2,12 +2,60 @@
 the keyed operator and the fused pipelines — the modes every benchmark
 actually runs — snapshot mid-sweep and reproduce IDENTICAL window
 results after restore (the stream is a pure function of (seed,
-interval), so a restored pipeline continues the exact tuple sequence)."""
+interval), so a restored pipeline continues the exact tuple sequence).
+
+SUBPROCESS ISOLATION (ISSUE 2 satellite). Root cause of the pre-existing
+tier-1 abort: each resume case traces a fused pipeline THREE times (the
+killed run, the restored run, and the uninterrupted reference), and by
+this point in a full sweep the process has already traced dozens of other
+pipeline variants. JAX tracing + XLA lowering of the deeply-nested fused
+steps (scan-of-ingest with per-aggregation fold chains) recurses on the C
+stack; the cumulative depth eventually exhausts it and the interpreter
+dies with a hard SIGABRT mid-trace ("Fatal Python error: Aborted" inside
+run_resume_case) — an abort no pytest hook can catch, so the WHOLE sweep
+used to stop here with every later test unreported. The same tests pass
+in a fresh interpreter. Until the upstream tracing recursion is bounded,
+the resume cases run in ONE pytest subprocess (fresh C stack, this module
+only) driven by ``test_checkpoint_suite_in_subprocess``; set
+``SCOTTY_CHECKPOINT_ISOLATED=1`` (the driver does) to run them directly.
+"""
+
+import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
 
 import jax
+
+ISOLATED = os.environ.get("SCOTTY_CHECKPOINT_ISOLATED") == "1"
+#: the resume cases only run inside the isolation subprocess (or when a
+#: user invokes the module directly with the env var set)
+_inner = pytest.mark.skipif(
+    not ISOLATED,
+    reason="runs inside the fresh-interpreter subprocess driver "
+           "(C-stack exhaustion in cumulative JAX tracing — see module "
+           "docstring)")
+
+
+def test_checkpoint_suite_in_subprocess():
+    """Drive every resume case in ONE fresh interpreter: a crash there
+    (the known C-stack abort) fails THIS test with the subprocess tail
+    instead of killing the whole tier-1 sweep."""
+    if ISOLATED:
+        pytest.skip("already inside the isolation subprocess")
+    # the child inherits the caller's JAX backend (tier-1 sets
+    # JAX_PLATFORMS=cpu itself; on accelerator machines the resume
+    # cases keep running against the real device)
+    env = dict(os.environ, SCOTTY_CHECKPOINT_ISOLATED="1")
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "-p", "no:randomly", os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, (
+        f"isolated checkpoint suite failed (rc={r.returncode}):\n"
+        f"{r.stdout[-3000:]}\n{r.stderr[-1500:]}")
 
 from scotty_tpu import (
     HyperLogLogAggregation,
@@ -70,6 +118,7 @@ def run_resume_case(make, n_before=3, n_after=3, rows=rows_of,
     assert rows(full[n_before:]) == got_tail, "resumed tail diverged"
 
 
+@_inner
 def test_aligned_pipeline_resume(tmp_path):
     from scotty_tpu.engine.pipeline import AlignedStreamPipeline
 
@@ -81,6 +130,7 @@ def test_aligned_pipeline_resume(tmp_path):
     run_resume_case(make, tmp_path=tmp_path)
 
 
+@_inner
 def test_count_pipeline_resume(tmp_path):
     from scotty_tpu.engine.count_pipeline import CountStreamPipeline
 
@@ -92,6 +142,7 @@ def test_count_pipeline_resume(tmp_path):
     run_resume_case(make, tmp_path=tmp_path)
 
 
+@_inner
 def test_session_pipeline_resume(tmp_path):
     from scotty_tpu.engine.session_pipeline import SessionStreamPipeline
 
@@ -104,6 +155,7 @@ def test_session_pipeline_resume(tmp_path):
     run_resume_case(make, n_before=4, n_after=6, tmp_path=tmp_path)
 
 
+@_inner
 def test_keyed_pipeline_resume(tmp_path):
     from scotty_tpu.parallel.keyed import KeyedAlignedPipeline
 
@@ -116,6 +168,7 @@ def test_keyed_pipeline_resume(tmp_path):
     run_resume_case(make, rows=keyed_rows, tmp_path=tmp_path)
 
 
+@_inner
 def test_keyed_operator_resume(tmp_path):
     from scotty_tpu.parallel.keyed import KeyedTpuWindowOperator
 
@@ -154,6 +207,7 @@ def test_keyed_operator_resume(tmp_path):
     assert b2 == b
 
 
+@_inner
 def test_pipeline_restore_guards(tmp_path):
     from scotty_tpu.engine.pipeline import AlignedStreamPipeline
 

@@ -1,0 +1,505 @@
+"""Differential tests for the in-jit device telemetry (ISSUE 2 tentpole).
+
+The DeviceMetrics pytree rides the carried state of every fused pipeline;
+its counters must EXACTLY match host oracle replays of the same streams:
+
+* late counts + age strata: a numpy arrival-order replay of the
+  pipeline's ``materialize_interval*`` faces (running-max calculus,
+  bucketed through the shared ``host_late_age_hist`` edges);
+* triggers fired / non-empty windows: the reference-semantics
+  ``simulator/`` operator fed the SAME materialized stream with the same
+  watermark cadence (the count pipeline's OOO case uses the device
+  operator instead — the simulator's TreeSet record dedup at equal ts is
+  a reproduced reference artifact the pipelines deliberately don't share,
+  tests/test_count_pipeline.py).
+
+Also covered here: the ``obs diff`` regression gate (exit 0 on self-diff,
+nonzero on an injected 10% throughput regression — tier-1, ISSUE 2
+satellite) and the pinned legacy-generator anchor cell.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from scotty_tpu import (
+    HyperLogLogAggregation,
+    SessionWindow,
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+from scotty_tpu.obs import device as dev
+
+Time, Count = WindowMeasure.Time, WindowMeasure.Count
+CFG = EngineConfig(capacity=1 << 12, annex_capacity=256, min_trigger_pad=32)
+
+
+# ---------------------------------------------------------------------------
+# Oracles
+# ---------------------------------------------------------------------------
+
+
+def replay_lateness(p, n_iv, with_late_face=True):
+    """Numpy arrival-order replay: (n_tuples, n_late, age_hist) over the
+    pipeline's materialized stream — the host mirror of the in-jit
+    running-max calculus."""
+    met = np.iinfo(np.int64).min
+    n_tup = n_late = 0
+    ages = []
+    for i in range(n_iv):
+        parts = []
+        if with_late_face and hasattr(p, "materialize_interval_late"):
+            parts.append(p.materialize_interval_late(i)[1])
+        parts.append(p.materialize_interval(i)[1])
+        for ts in parts:
+            for t in ts:
+                n_tup += 1
+                if t < met:
+                    n_late += 1
+                    ages.append(met - t)
+                met = max(met, int(t))
+    return n_tup, n_late, dev.host_late_age_hist(ages)
+
+
+def oracle_trigger_counts(make_op, p, n_iv, with_late_face=True):
+    """(triggers, nonempty) totals from an operator oracle fed the same
+    materialized arrival stream, one watermark per interval."""
+    op = make_op()
+    triggers = nonempty = 0
+    for i in range(n_iv):
+        if with_late_face and hasattr(p, "materialize_interval_late"):
+            lv, lts = p.materialize_interval_late(i)
+            if len(lv):
+                op.process_elements(lv, lts)
+        vs, ts = p.materialize_interval(i)
+        op.process_elements(vs, ts)
+        res = op.process_watermark((i + 1) * p.wm_period_ms)
+        triggers += len(res)
+        nonempty += sum(1 for w in res if w.has_value())
+    return triggers, nonempty
+
+
+def make_sim(windows, agg, lateness):
+    def build():
+        op = SlicingWindowOperator()
+        for w in windows:
+            op.add_window_assigner(w)
+        op.add_aggregation(agg)
+        op.set_max_lateness(lateness)
+        return op
+    return build
+
+
+def make_dev_op(windows, agg, lateness, record_capacity=0):
+    def build():
+        op = TpuWindowOperator(config=EngineConfig(
+            capacity=1 << 12, batch_size=64, annex_capacity=256,
+            min_trigger_pad=32, record_capacity=record_capacity))
+        for w in windows:
+            op.add_window_assigner(w)
+        op.add_aggregation(agg)
+        op.set_max_lateness(lateness)
+        return op
+    return build
+
+
+def assert_counters_match(p, n_iv, make_oracle, with_late_face=True):
+    d = p.device_metrics()
+    n_tup, n_late, hist = replay_lateness(p, n_iv, with_late_face)
+    assert d["device_ingest_tuples"] == n_tup, (
+        "ingest", d["device_ingest_tuples"], n_tup)
+    assert d["device_late_tuples"] == n_late, (
+        "late", d["device_late_tuples"], n_late)
+    got_hist = [d[n] for n in dev.late_bucket_names()]
+    assert got_hist == hist.tolist(), ("strata", got_hist, hist.tolist())
+    assert sum(got_hist) == d["device_late_tuples"]
+    assert d["device_dropped_tuples"] == 0
+    triggers, nonempty = oracle_trigger_counts(make_oracle, p, n_iv,
+                                               with_late_face)
+    assert d["device_triggers_fired"] == triggers, (
+        "triggers", d["device_triggers_fired"], triggers)
+    assert d["device_windows_nonempty"] == nonempty, (
+        "nonempty", d["device_windows_nonempty"], nonempty)
+
+
+# ---------------------------------------------------------------------------
+# The three OOO-capable fused pipelines vs the oracle
+# ---------------------------------------------------------------------------
+
+
+def test_stream_pipeline_counters_match_simulator():
+    from scotty_tpu.engine.pipeline import StreamPipeline
+
+    windows = [TumblingWindow(Time, 50)]
+    agg = SumAggregation()
+    p = StreamPipeline(windows, [agg], config=CFG, throughput=30_000,
+                       wm_period_ms=100, max_lateness=100, seed=3,
+                       sub_batch=1 << 10, out_of_order_pct=0.1)
+    p.run(3, collect=False)
+    p.sync()
+    assert_counters_match(p, 3, make_sim(windows, agg, 100))
+
+
+@pytest.mark.parametrize("agg_factory", [SumAggregation,
+                                         lambda: HyperLogLogAggregation(6)])
+def test_aligned_pipeline_counters_match_simulator(agg_factory):
+    """Both late folds: dense aggs take the scatter-free SEGMENT fold,
+    sparse (HLL) aggs the bounded lane-scatter fold — each must agree
+    with the same arrival-order oracle."""
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    windows = [TumblingWindow(Time, 50), SlidingWindow(Time, 200, 50)]
+    agg = agg_factory()
+    p = AlignedStreamPipeline(
+        windows, [agg], config=CFG, throughput=20_000, wm_period_ms=100,
+        max_lateness=100, seed=5, gc_every=10 ** 9, out_of_order_pct=0.1)
+    p.run(4, collect=False)
+    p.sync()
+    assert_counters_match(p, 4, make_sim(windows, agg, 100))
+
+
+def test_count_pipeline_counters_inorder_match_simulator():
+    from scotty_tpu.engine.count_pipeline import CountStreamPipeline
+
+    windows = [TumblingWindow(Count, 7), TumblingWindow(Time, 50)]
+    agg = SumAggregation()
+    p = CountStreamPipeline(windows, [agg], throughput=2000,
+                            wm_period_ms=100, max_lateness=100, seed=3)
+    p.run(5, collect=False)
+    p.sync()
+    assert_counters_match(p, 5, make_sim(windows, agg, 100))
+
+
+def test_count_pipeline_counters_ooo_match_engine_oracle():
+    """OOO count: the device operator is the trigger oracle (the
+    simulator's TreeSet dedup drops the stratified stream's equal-ts
+    records — a reproduced reference artifact, not pipeline behavior)."""
+    from scotty_tpu.engine.count_pipeline import CountStreamPipeline
+
+    windows = [TumblingWindow(Count, 7), TumblingWindow(Time, 50)]
+    agg = SumAggregation()
+    p = CountStreamPipeline(windows, [agg], throughput=2000,
+                            wm_period_ms=100, max_lateness=300, seed=3,
+                            out_of_order_pct=0.3)
+    p.run(5, collect=False)
+    p.check_overflow()
+    p.sync()
+    assert_counters_match(
+        p, 5, make_dev_op(windows, agg, 300, record_capacity=1 << 12))
+
+
+# ---------------------------------------------------------------------------
+# Session pipeline + invariants
+# ---------------------------------------------------------------------------
+
+
+def test_session_pipeline_counters():
+    """Ingest/silence are closed-form-checkable; triggers/nonempty must
+    equal what the pipeline itself emitted (every completed session is a
+    non-empty window)."""
+    from scotty_tpu.engine.session_pipeline import SessionStreamPipeline
+
+    p = SessionStreamPipeline(
+        [SessionWindow(Time, 300), SlidingWindow(Time, 500, 100)],
+        [SumAggregation()], config=CFG, throughput=20_000,
+        wm_period_ms=100, max_lateness=100, seed=2,
+        session_config={"count": 3, "minGapMs": 300, "maxGapMs": 700})
+    fetched = jax.device_get(p.run(12))
+    p.sync()
+    d = p.device_metrics()
+    assert d["device_ingest_tuples"] == sum(
+        len(p.materialize_interval(i)[0]) for i in range(12))
+    assert d["device_silent_intervals"] == sum(
+        0 if p.live(i) else 1 for i in range(12))
+    emitted = sum(int((np.asarray(f[2]) > 0).sum()) for f in fetched)
+    assert d["device_windows_nonempty"] == emitted
+    assert d["device_triggers_fired"] >= emitted
+    assert d["device_late_tuples"] == 0
+
+
+def test_collect_device_metrics_off_is_inert():
+    """The A/B flag: metrics off must produce BIT-IDENTICAL window
+    results (the telemetry can never perturb the data path) and leave
+    the pytree at zero."""
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    def run(flag):
+        p = AlignedStreamPipeline(
+            [TumblingWindow(Time, 50)], [SumAggregation()], config=CFG,
+            throughput=20_000, wm_period_ms=100, max_lateness=100, seed=9,
+            gc_every=10 ** 9, out_of_order_pct=0.1,
+            collect_device_metrics=flag)
+        outs = jax.device_get(p.run(3))
+        p.sync()
+        return outs, p.device_metrics()
+
+    on_outs, on_dm = run(True)
+    off_outs, off_dm = run(False)
+    for a, b in zip(on_outs, off_outs):
+        for x, y in zip(a[:3], b[:3]):
+            assert np.array_equal(np.asarray(x), np.asarray(y))
+    assert sum(off_dm.values()) == 0
+    assert on_dm["device_ingest_tuples"] > 0
+
+
+def test_device_metrics_fold_into_registry():
+    """sync() folds the delta into the registry under the device_*
+    names; attaching obs mid-run baselines at the attach point."""
+    from scotty_tpu import obs as obs_mod
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    p = AlignedStreamPipeline(
+        [TumblingWindow(Time, 50)], [SumAggregation()], config=CFG,
+        throughput=20_000, wm_period_ms=100, seed=1, gc_every=10 ** 9)
+    p.run(2, collect=False)
+    p.sync()                                   # pre-attach ("warmup")
+    obs = obs_mod.Observability()
+    p.set_observability(obs)
+    p.run(3, collect=False)
+    p.sync()
+    snap = obs.snapshot()
+    # only the post-attach intervals folded (2000 tuples/interval)
+    assert snap[dev.DEVICE_INGEST_TUPLES] == 3 * p.tuples_per_interval
+    assert snap[dev.DEVICE_TRIGGERS_FIRED] > 0
+
+
+# ---------------------------------------------------------------------------
+# Operator ingest paths
+# ---------------------------------------------------------------------------
+
+
+def test_operator_device_batches_counters_match_replay():
+    """Device-resident batches: ts are host-opaque, so the jitted cummax
+    kernel is the only exact source — it must agree with a host replay
+    of the same arrays."""
+    import jax.numpy as jnp
+
+    B = 64
+    # no Observability attached -> force collection (default is AUTO:
+    # a bare operator stays zero-overhead)
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=1 << 12, batch_size=B, annex_capacity=256,
+        min_trigger_pad=32), collect_device_metrics=True)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(1000)
+
+    rng = np.random.default_rng(5)
+    lo, batches = 0, []
+    for _ in range(6):
+        base = np.sort(rng.integers(lo, lo + 100, size=B)).astype(np.int64)
+        late = rng.random(B) < 0.2
+        ts = np.sort(np.where(late, np.maximum(
+            base - rng.integers(0, 80, size=B), 0), base)).astype(np.int64)
+        vals = rng.integers(1, 9, size=B).astype(np.float32)
+        op.ingest_device_batch(jax.device_put(jnp.asarray(vals)),
+                               jax.device_put(jnp.asarray(ts)),
+                               int(ts.min()), int(ts.max()))
+        batches.append(ts)
+        lo += 100
+    op.process_watermark(lo + 500)
+    d = op.device_metrics()
+    met = np.iinfo(np.int64).min
+    late, ages = 0, []
+    for ts in batches:
+        for t in ts:
+            if t < met:
+                late += 1
+                ages.append(met - t)
+            met = max(met, int(t))
+    assert d["device_ingest_tuples"] == 6 * B
+    assert d["device_late_tuples"] == late
+    assert [d.get(n, 0) for n in dev.late_bucket_names()] == \
+        dev.host_late_age_hist(ages).tolist()
+
+
+def test_operator_auto_mode_collects_only_with_obs():
+    """Default AUTO: a bare operator (no Observability) collects nothing
+    — zero-overhead contract preserved; attaching obs turns it on."""
+    from scotty_tpu import obs as obs_mod
+
+    def feed(op):
+        op.add_window_assigner(TumblingWindow(Time, 10))
+        op.add_aggregation(SumAggregation())
+        op.process_elements(np.arange(50, dtype=np.float32),
+                            np.arange(50, dtype=np.int64))
+        op.process_watermark(100)
+
+    bare = TpuWindowOperator(config=EngineConfig(
+        capacity=1 << 10, batch_size=64))
+    feed(bare)
+    assert bare.device_metrics() == {}
+
+    watched = TpuWindowOperator(config=EngineConfig(
+        capacity=1 << 10, batch_size=64), obs=obs_mod.Observability())
+    feed(watched)
+    assert watched.device_metrics()["device_ingest_tuples"] == 50
+
+
+def test_operator_host_batches_counters_match_replay():
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=1 << 12, batch_size=64, annex_capacity=256,
+        min_trigger_pad=32), collect_device_metrics=True)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(10_000)
+    rng = np.random.default_rng(7)
+    base = np.cumsum(rng.integers(0, 5, size=300)).astype(np.int64)
+    ts = np.maximum(base - rng.integers(0, 40, size=300), 0)
+    vals = rng.integers(1, 50, size=300).astype(np.float32)
+    op.process_elements(vals, ts)
+    op.process_watermark(int(ts.max()) + 1)
+    d = op.device_metrics()
+    met = np.iinfo(np.int64).min
+    late, ages = 0, []
+    for t in ts:
+        if t < met:
+            late += 1
+            ages.append(met - t)
+        met = max(met, int(t))
+    assert d["device_ingest_tuples"] == 300
+    assert d["device_late_tuples"] == late
+    assert [d.get(n, 0) for n in dev.late_bucket_names()] == \
+        dev.host_late_age_hist(ages).tolist()
+
+
+# ---------------------------------------------------------------------------
+# obs diff gate (tier-1, ISSUE 2 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _cells(tps):
+    return [{"name": "t", "windows": "Tumbling(1000)", "engine": "TpuEngine",
+             "aggregation": "sum", "tuples_per_sec": tps,
+             "p99_emit_ms": 5.0, "windows_emitted": 10}]
+
+
+def test_obs_diff_exits_zero_on_identical(tmp_path):
+    from scotty_tpu.obs.diff import diff_main
+
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    pa.write_text(json.dumps(_cells(1e9)))
+    pb.write_text(json.dumps(_cells(1e9)))
+    assert diff_main(str(pa), str(pb), echo=lambda s: None) == 0
+
+
+def test_obs_diff_fails_on_injected_throughput_regression(tmp_path):
+    """A 10% throughput drop must trip the default gate (rel_tol 0.10 is
+    the boundary; 10.01% clears it strictly)."""
+    from scotty_tpu.obs.diff import diff_main
+
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    pa.write_text(json.dumps(_cells(1e9)))
+    pb.write_text(json.dumps(_cells(1e9 * 0.8999)))
+    assert diff_main(str(pa), str(pb), echo=lambda s: None) == 1
+
+
+def test_obs_diff_cli_and_thresholds(tmp_path, capsys):
+    """End-to-end through the module CLI with a custom threshold file,
+    plus missing-cell detection."""
+    from scotty_tpu.obs.report import main as obs_main
+
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    th = tmp_path / "th.json"
+    pa.write_text(json.dumps(_cells(1e9) + [
+        dict(_cells(1e9)[0], windows="Sliding(60,20)")]))
+    pb.write_text(json.dumps(_cells(0.97e9)))   # -3% + one cell dropped
+    th.write_text(json.dumps(
+        {"metrics": {"tuples_per_sec":
+                     {"direction": "higher", "rel_tol": 0.05}}}))
+    # -3% within tolerance, but the dropped cell regresses
+    assert obs_main(["diff", str(pa), str(pb),
+                     "--thresholds", str(th)]) == 1
+    out = capsys.readouterr().out
+    assert "missing from candidate" in out
+    # same single cell, within tolerance: passes
+    pa.write_text(json.dumps(_cells(1e9)))
+    assert obs_main(["diff", str(pa), str(pb),
+                     "--thresholds", str(th)]) == 0
+
+
+def test_runner_gate_flag(tmp_path):
+    """--gate end to end: first run records the baseline (exit 0), an
+    injected regression in the baseline file makes the rerun fail."""
+    from scotty_tpu.bench.runner import main as bench_main
+
+    cfg = tmp_path / "tiny.json"
+    cfg.write_text(json.dumps({
+        "name": "gatetiny", "throughput": 20_000, "runtime": 2,
+        "windowConfigurations": ["Tumbling(100)"],
+        "configurations": ["TpuEngine"], "aggFunctions": ["sum"],
+        "watermarkPeriodMs": 100, "capacity": 4096,
+    }))
+    out = tmp_path / "out"
+    assert bench_main([str(cfg), "--out-dir", str(out),
+                       "--gate", "default"]) == 0   # no baseline yet
+    # doctor the recorded result into an inflated baseline -> rerun regresses
+    res_path = out / "result_gatetiny.json"
+    rows = json.loads(res_path.read_text())
+    rows[0]["tuples_per_sec"] *= 100.0
+    base_dir = tmp_path / "base"
+    base_dir.mkdir()
+    (base_dir / "result_gatetiny.json").write_text(json.dumps(rows))
+    assert bench_main([str(cfg), "--out-dir", str(out),
+                       "--gate", "default",
+                       "--baseline-dir", str(base_dir)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Legacy-generator anchor cell (ADVICE r5)
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_generator_anchor_cell():
+    """The pinned r4-workload generator: 32-bit value draws + a real
+    offset stream. Window values must match a brute-force recomputation
+    over the materialized (offset-bearing) stream."""
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    p = AlignedStreamPipeline(
+        [TumblingWindow(Time, 50), SlidingWindow(Time, 200, 50)],
+        [SumAggregation()], config=CFG, throughput=20_000,
+        wm_period_ms=100, seed=7, gc_every=10 ** 9, legacy_generator=True)
+    outs = p.run(3)
+    vs, ts = [], []
+    for i in range(3):
+        v, t = p.materialize_interval(i)
+        vs.append(v)
+        ts.append(t)
+    vs, ts = np.concatenate(vs), np.concatenate(ts)
+    assert np.unique(ts).size > 200      # offsets really exist
+    checked = 0
+    for (s, e, c, vals) in p.lowered_results(outs[-1]):
+        m = (ts >= s) & (ts < e)
+        if not m.any():
+            continue
+        checked += 1
+        assert c == int(m.sum())
+        want = float(vs[m].sum())
+        assert abs(float(vals[0]) - want) <= 2e-4 * max(1.0, abs(want))
+    assert checked > 0
+
+
+def test_legacy_anchor_config_bundled():
+    """The pinned anchor config ships with the runner and routes to the
+    aligned pipeline with the legacy generator."""
+    import os
+
+    from scotty_tpu.bench import load_config
+
+    here = os.path.join(os.path.dirname(
+        __import__("scotty_tpu.bench", fromlist=["runner"]).__file__),
+        "configurations", "legacy_anchor.json")
+    cfg = load_config(here)
+    assert cfg.legacy_generator
+    assert cfg.configurations == ["TpuEngine"]

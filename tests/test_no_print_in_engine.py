@@ -1,10 +1,14 @@
-"""Tier-1 lint: the engine core stays silent (ISSUE 1 satellite).
+"""Tier-1 lint: the engine core stays silent (ISSUE 1 satellite; extended
+to connectors/ and bench/ in ISSUE 2).
 
 The reference's engine never logs — its only output was the benchmark-side
 throughput logger (SURVEY.md §5). The port preserves that discipline: all
-output from ``scotty_tpu/engine/`` and ``scotty_tpu/core/`` must flow
-through the metrics registry / sinks (scotty_tpu.obs), never a bare
-``print(``. AST-based so strings/comments mentioning print don't trip it.
+output from ``scotty_tpu/engine/``, ``scotty_tpu/core/``,
+``scotty_tpu/connectors/`` and ``scotty_tpu/bench/`` must flow through the
+metrics registry / overridable echo sinks (scotty_tpu.obs), never a bare
+``print(`` — bench output in particular must stay capturable so the
+``obs diff`` gate and tests can consume it. AST-based so strings/comments
+mentioning print don't trip it.
 """
 
 import ast
@@ -13,7 +17,7 @@ import pathlib
 import scotty_tpu
 
 PKG_ROOT = pathlib.Path(scotty_tpu.__file__).parent
-SILENT_DIRS = ("engine", "core")
+SILENT_DIRS = ("engine", "core", "connectors", "bench")
 
 
 def _print_calls(path: pathlib.Path):
