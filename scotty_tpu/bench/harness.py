@@ -153,6 +153,10 @@ class BenchmarkConfig:
     #: stream) so cross-round comparisons keep one workload-identical
     #: anchor cell (ADVICE r5); aligned-pipeline cells only
     legacy_generator: bool = False
+    #: EngineConfig.overflow_policy for every engine the cells build:
+    #: "fail" (the benchmarked default — BASELINE.md numbers are FAIL),
+    #: "shed" or "grow" (scotty_tpu.resilience) for degraded-mode A/Bs
+    overflow_policy: str = "fail"
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -175,6 +179,7 @@ class BenchmarkConfig:
             seed=raw.get("seed", 42),
             session_config=raw.get("sessionConfig"),
             legacy_generator=raw.get("legacyGenerator", False),
+            overflow_policy=raw.get("overflowPolicy", "fail"),
         )
 
 

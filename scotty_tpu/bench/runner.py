@@ -248,7 +248,8 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             from ..engine.pipeline import AlignedStreamPipeline, StreamPipeline
 
             econf = EngineConfig(capacity=cfg.capacity, annex_capacity=8,
-                                 min_trigger_pad=32)
+                                 min_trigger_pad=32,
+                                 overflow_policy=cfg.overflow_policy)
             try:
                 tp = _round_throughput(
                     cfg.throughput,
@@ -346,9 +347,10 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                 try:
                     p = SessionStreamPipeline(
                         windows, [make_aggregation(agg_name)],
-                        config=EngineConfig(capacity=cfg.capacity,
-                                            annex_capacity=8,
-                                            min_trigger_pad=32),
+                        config=EngineConfig(
+                            capacity=cfg.capacity, annex_capacity=8,
+                            min_trigger_pad=32,
+                            overflow_policy=cfg.overflow_policy),
                         throughput=cfg.throughput,
                         wm_period_ms=cfg.watermark_period_ms,
                         max_lateness=cfg.max_lateness, seed=cfg.seed,
@@ -415,7 +417,8 @@ def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
         packed.append(HostFeed.pack(vals, ts) + (int(ts[0]), int(ts[-1])))
 
     op = TpuWindowOperator(config=EngineConfig(
-        capacity=cfg.capacity, batch_size=B))
+        capacity=cfg.capacity, batch_size=B,
+        overflow_policy=cfg.overflow_policy))
     for w in windows:
         op.add_window_assigner(w)
     op.add_aggregation(make_aggregation(agg_name))
@@ -871,6 +874,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="where baseline result_<name>.json files live "
                          "(with --gate; default: --out-dir, snapshotted "
                          "before each run overwrites it)")
+    ap.add_argument("--overflow-policy", default=None, metavar="POLICY",
+                    choices=("fail", "shed", "grow"),
+                    help="override every config's EngineConfig."
+                         "overflow_policy (scotty_tpu.resilience); "
+                         "'fail' is the benchmarked default")
     args = ap.parse_args(argv)
 
     paths = args.configs
@@ -882,6 +890,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     gate_failures = 0
     for path in paths:
         cfg = load_config(path)
+        if args.overflow_policy:
+            cfg.overflow_policy = args.overflow_policy
         _stdout(f"== {cfg.name} ({path})")
         baseline_snap = None
         if args.gate:

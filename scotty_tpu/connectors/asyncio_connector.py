@@ -44,14 +44,47 @@ async def run_keyed_async(
                 await r
 
 
-async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None):
+async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None,
+                       depth_sample_every: int = 16,
+                       stall_timeout_s: Optional[float] = None,
+                       on_stall=None, max_stalls: Optional[int] = None):
     """Adapt an asyncio.Queue into an async iterator (terminates on
-    ``sentinel``). With ``obs``, the queue depth is recorded as a gauge
-    per item — backpressure made visible."""
+    ``sentinel``). With ``obs``, the queue depth gauge is sampled AFTER
+    each blocking ``get`` (sampling before it reported the depth seen
+    before a possibly-long wait — a perpetually stale value on an idle
+    consumer) and throttled to every ``depth_sample_every``-th item.
+
+    ``stall_timeout_s`` arms the preemptive no-progress watchdog: every
+    ``get`` that exceeds the timeout counts a ``resilience_stall_events``
+    and calls ``on_stall(seconds_waited)``; after ``max_stalls``
+    consecutive timeouts (None = keep waiting forever) the source raises
+    ``SourceStalled`` so a supervisor can restart the producer."""
+    from ..resilience.connectors import SourceStalled
+
+    n = 0
     while True:
-        if obs is not None:
+        if stall_timeout_s is None:
+            item = await queue.get()
+        else:
+            stalls = 0
+            while True:
+                try:
+                    item = await asyncio.wait_for(queue.get(),
+                                                  stall_timeout_s)
+                    break
+                except asyncio.TimeoutError:
+                    stalls += 1
+                    if obs is not None:
+                        obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
+                    if on_stall is not None:
+                        on_stall(stalls * stall_timeout_s)
+                    if max_stalls is not None and stalls >= max_stalls:
+                        raise SourceStalled(
+                            f"queue source made no progress for "
+                            f"{stalls * stall_timeout_s:.3f}s") from None
+        if obs is not None and n % max(1, depth_sample_every) == 0:
             obs.gauge(_obs.QUEUE_DEPTH).set(queue.qsize())
-        item = await queue.get()
+        n += 1
         if item is sentinel:
             return
         yield item

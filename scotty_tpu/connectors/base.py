@@ -205,6 +205,42 @@ class KeyedScottyWindowOperator:
             return self.process_watermark(wm)
         return []
 
+    # -- resilience (ISSUE 3): connector-level snapshot/restore ------------
+    def save(self, path: str) -> None:
+        """Snapshot the keyed state (host backend: every per-key operator
+        + the watermark policy — plain-Python pickles through the
+        StateFactory seam, like utils.checkpoint.save_host_operator).
+        The Supervisor's connector mode checkpoints through this; the
+        device backend snapshots via utils.checkpoint.save_keyed_operator
+        instead."""
+        import os
+        import pickle
+
+        if self.backend == "device":
+            raise NotImplementedError(
+                "device-backend connectors checkpoint through "
+                "utils.checkpoint.save_keyed_operator")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "keyed_connector.pkl"), "wb") as f:
+            pickle.dump({"host_ops": self._host_ops, "policy": self.policy,
+                         "allowed_lateness": self.allowed_lateness}, f)
+
+    def restore(self, path: str) -> None:
+        """Restore a :meth:`save` snapshot into a freshly-configured
+        connector operator (same windows/aggregations)."""
+        import os
+        import pickle
+
+        with open(os.path.join(path, "keyed_connector.pkl"), "rb") as f:
+            snap = pickle.load(f)
+        if snap["allowed_lateness"] != self.allowed_lateness:
+            raise ValueError(
+                "snapshot was taken with allowed_lateness="
+                f"{snap['allowed_lateness']}, this operator has "
+                f"{self.allowed_lateness} — configure them identically")
+        self._host_ops = snap["host_ops"]
+        self.policy = snap["policy"]
+
     def process_watermark(self, wm: int) -> List[Tuple[Hashable, AggregateWindow]]:
         out: List[Tuple[Hashable, AggregateWindow]] = []
         if self.backend == "device":

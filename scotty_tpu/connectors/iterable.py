@@ -21,12 +21,28 @@ from .base import GlobalScottyWindowOperator, KeyedScottyWindowOperator
 
 
 def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
-              obs=None) -> Iterator[Tuple]:
+              obs=None, dead_letter=None,
+              poison_limit: int | None = None) -> Iterator[Tuple]:
     """Drive a keyed operator from an iterable of (key, value, ts); yields
-    (key, AggregateWindow) results as watermarks fire."""
+    (key, AggregateWindow) results as watermarks fire.
+
+    Records that fail to destructure or whose ts is not integral are
+    POISON (ISSUE 3): counted, handed to ``dead_letter(record, exc)`` and
+    skipped instead of killing the loop — engine errors still propagate.
+    """
+    from ..resilience.connectors import PoisonHandler
+
     own_obs = obs if obs is not None and obs is not operator.obs else None
-    for key, value, ts in source:
-        items = operator.process_element(key, value, int(ts))
+    poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
+                           obs=obs if obs is not None else operator.obs)
+    for rec in source:
+        try:
+            key, value, ts = rec
+            ts = int(ts)
+        except (TypeError, ValueError) as e:
+            poison.handle(rec, e)
+            continue
+        items = operator.process_element(key, value, ts)
         if own_obs is not None:
             own_obs.counter(_obs.INGEST_TUPLES).inc()
             if items:
@@ -36,11 +52,23 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
 
 
 def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
-               obs=None) -> Iterator:
-    """Drive a global operator from an iterable of (value, ts)."""
+               obs=None, dead_letter=None,
+               poison_limit: int | None = None) -> Iterator:
+    """Drive a global operator from an iterable of (value, ts) — same
+    poison-record contract as :func:`run_keyed`."""
+    from ..resilience.connectors import PoisonHandler
+
     own_obs = obs if obs is not None and obs is not operator.obs else None
-    for value, ts in source:
-        items = operator.process_element(value, int(ts))
+    poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
+                           obs=obs if obs is not None else operator.obs)
+    for rec in source:
+        try:
+            value, ts = rec
+            ts = int(ts)
+        except (TypeError, ValueError) as e:
+            poison.handle(rec, e)
+            continue
+        items = operator.process_element(value, ts)
         if own_obs is not None:
             own_obs.counter(_obs.INGEST_TUPLES).inc()
             if items:

@@ -6,6 +6,15 @@ records and forwarding window results
 event-time tick). Requires ``kafka-python`` or ``confluent-kafka`` at
 runtime; the adapter logic is complete and library-agnostic — it only needs
 a consumer that yields records with key/value/timestamp.
+
+Hardening (ISSUE 3): a record whose payload fails to deserialize —
+non-UTF-8 bytes, non-JSON non-numeric text, missing fields — used to kill
+the whole ``run()`` loop with an uncaught ``ValueError``. Deserialization
+errors are now POISON records: counted (``resilience_poison_records``),
+handed to an optional ``dead_letter(record, exc)`` callback, and skipped —
+up to an optional ``poison_limit`` (an all-garbage stream should not fail
+silently). An optional ``stall_timeout_s`` wraps the consumer in the
+no-progress watchdog (``resilience_stall_events``).
 """
 
 from __future__ import annotations
@@ -18,7 +27,8 @@ from .base import KeyedScottyWindowOperator, PeriodicWatermarks
 
 def _default_deserialize(record) -> Tuple:
     """(key, value, ts) from a Kafka record: JSON value with 'value' field,
-    record timestamp as event time."""
+    record timestamp as event time. Raises on payloads that are neither
+    JSON nor numeric — ``run()`` routes that through the poison path."""
     key = record.key.decode() if isinstance(record.key, bytes) else record.key
     raw = record.value.decode() if isinstance(record.value, bytes) else record.value
     try:
@@ -50,15 +60,37 @@ class KafkaScottyWindowOperator:
         self.deserialize = deserialize
 
     def run(self, consumer: Iterable, on_result: Callable[[Tuple], None],
-            max_records: Optional[int] = None) -> int:
+            max_records: Optional[int] = None,
+            dead_letter: Optional[Callable] = None,
+            poison_limit: Optional[int] = None,
+            stall_timeout_s: Optional[float] = None,
+            clock=None) -> int:
         """``consumer``: any iterable of Kafka-like records (KafkaConsumer
-        instances are iterables of ConsumerRecord). Returns records consumed."""
+        instances are iterables of ConsumerRecord). Returns records
+        consumed (poison records count — they were consumed, then
+        dead-lettered).
+
+        A record whose ``deserialize`` raises is handled per the module
+        docstring instead of killing the loop; ``stall_timeout_s`` flags
+        no-progress gaps on the (injectable) ``clock``.
+        """
+        from ..resilience.connectors import PoisonHandler, watchdog_source
+
+        poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
+                               obs=self.operator.obs)
+        if stall_timeout_s is not None:
+            consumer = watchdog_source(consumer, stall_timeout_s,
+                                       clock=clock, obs=self.operator.obs)
         n = 0
         for record in consumer:
-            key, value, ts = self.deserialize(record)
-            for item in self.operator.process_element(key, value, ts):
-                on_result(item)
             n += 1
+            try:
+                key, value, ts = self.deserialize(record)
+            except Exception as e:       # noqa: BLE001 — poison boundary
+                poison.handle(record, e)
+            else:
+                for item in self.operator.process_element(key, value, ts):
+                    on_result(item)
             if max_records is not None and n >= max_records:
                 break
         return n

@@ -59,6 +59,35 @@ class EngineConfig:
     #: the batch's time span and the minimum grid period. 0 disables.
     dense_ingest_runs: int = 16
 
+    #: Overflow policy at the engine's admission/drain points
+    #: (scotty_tpu.resilience.policy): ``"fail"`` (the default — today's
+    #: hard RuntimeError, the benchmarked mode), ``"shed"`` (drop the
+    #: lowest-watermark-impact tuples at the host ingest boundary,
+    #: exactly counted in DeviceMetrics + ``resilience_shed_tuples``) or
+    #: ``"grow"`` (checkpoint-snapshot the carried state, rebuild the
+    #: jitted kernels at 2× capacity, restore, resume — bounded by
+    #: ``max_capacity``). Policies are PREVENTIVE: a raised device
+    #: overflow flag means data was already clamped and stays fatal.
+    overflow_policy: str = "fail"
+
+    #: Hard bound for the GROW policy (0 = 8 × ``capacity``, i.e. three
+    #: doublings) so an unbounded overload cannot OOM-spiral.
+    max_capacity: int = 0
+
+    #: Live-slice occupancy fraction at which a GROW fused pipeline
+    #: doubles capacity at its sync/drain points (growth must fire before
+    #: the overflow flag can — see resilience.policy).
+    grow_occupancy: float = 0.85
+
+    def __post_init__(self):
+        # literal check, NOT an import of resilience.policy.OverflowPolicy:
+        # the engine config must not pull the whole resilience package in
+        # (layering: resilience depends on engine, not the reverse)
+        if self.overflow_policy not in ("fail", "shed", "grow"):
+            raise ValueError(
+                f"unknown overflow_policy {self.overflow_policy!r}: "
+                "expected one of ('fail', 'shed', 'grow')")
+
     def trigger_pad(self, n: int) -> int:
         """Next power-of-two bucket ≥ n (≥ min_trigger_pad)."""
         p = self.min_trigger_pad

@@ -529,7 +529,10 @@ class CountStreamPipeline(FusedPipelineDriver):
             raise RuntimeError(
                 "count row-window underrun: a trigger reached below the "
                 "retained per-ms rows — widen the retention model "
-                "(windows larger than expected?)")
+                "(windows larger than expected?). Overflow policies do "
+                "not apply here: the ring is sized by the window spec, "
+                "not by load, so shedding/growing cannot repair a "
+                "mis-sized retention model")
 
     # -- test/replay face --------------------------------------------------
     def materialize_interval(self, i: int):

@@ -294,6 +294,11 @@ class TpuWindowOperator(WindowOperator):
         #: disables entirely (the overhead A/B baseline — run_benchmark
         #: propagates its collect_metrics flag here).
         self.collect_device_metrics = collect_device_metrics
+        #: SHED policy hook: called as ``shed_callback(vals, ts)`` with the
+        #: numpy arrays of every tuple the admission control dropped — the
+        #: auditable dead-letter face (the chaos differential suite replays
+        #: the surviving complement through the host oracle).
+        self.shed_callback = None
         self.windows: List[ContextFreeWindow] = []
         self.aggregations: List[AggregateFunction] = []
         self.max_lateness = 1000            # WindowManager.java:24 default
@@ -598,6 +603,22 @@ class TpuWindowOperator(WindowOperator):
         self._valid_dev = None          # cached all-true lane mask
         self._host_open = None          # mirror of the open slice's start
         self._device_fed = False        # device batches bypass the mirror
+        # overflow-policy admission mirrors (resilience.policy): host-side
+        # UPPER BOUNDS on live slices / pending annex rows, grown per
+        # admitted batch and re-synced exactly (one device round trip)
+        # only when a batch's projected need approaches capacity. Under
+        # the default FAIL policy none of this runs.
+        if self.config.overflow_policy != "fail" and (
+                not self._has_grid or self._has_count or self._ctx_windows):
+            raise UnsupportedOnDevice(
+                f"overflow_policy={self.config.overflow_policy!r} covers "
+                "time-grid (optionally session-mixed) workloads; count/"
+                "context/pure-session workloads run policy 'fail' — the "
+                "host admission mirror has no exact occupancy bound for "
+                "their buffers")
+        self._pol_slices_ub = 0
+        self._pol_annex_ub = 0
+        self._pol_seen_start = None
         self._built = True
 
     # -- device telemetry --------------------------------------------------
@@ -681,6 +702,13 @@ class TpuWindowOperator(WindowOperator):
         self._n_pending -= take
 
         met_pre = self._host_met            # max event time BEFORE this batch
+        if take and self.config.overflow_policy != "fail":
+            # SHED/GROW admission control (resilience.policy) — before any
+            # telemetry, so counters reflect what was actually ingested
+            batch_v, batch_t, take = self._policy_admit(batch_v, batch_t,
+                                                        take, met_pre)
+            if take == 0:
+                return
         if self.obs is not None and take and met_pre is not None:
             # late = below the stream's max event time at batch start
             # (host-side count; the device late/annex path handles them)
@@ -1037,6 +1065,142 @@ class TpuWindowOperator(WindowOperator):
                 return self._ingest_dense
         return self._ingest_inorder
 
+    # -- overflow policy (resilience.policy) -------------------------------
+    #: admission slack: slices the mirror always keeps free so an exact
+    #: bound slip (e.g. the annex merge materializing a boundary row) can
+    #: never push the device buffers over
+    _POL_SLACK = 2
+
+    def _pol_refresh(self) -> None:
+        """Re-sync the admission mirrors exactly (one deliberate device
+        round trip — only paid when a batch's projected need approaches
+        capacity). Pending annex rows count against the slice bound too:
+        the watermark merge materializes up to one new slice per row."""
+        import jax
+
+        if self._state is None:
+            return
+        n, na = jax.device_get((self._state.n_slices, self._state.n_annex))
+        self._pol_annex_ub = int(na)
+        self._pol_slices_ub = int(n) + int(na)
+
+    def _policy_admit(self, vals: np.ndarray, ts: np.ndarray, take: int,
+                      met_pre):
+        """SHED/GROW admission control at the host ingest boundary.
+
+        The host mirror tracks UPPER BOUNDS on live slices and pending
+        annex rows: an in-order batch opens at most one slice per distinct
+        union-grid start above the stream head; a late tuple claims at
+        most one annex row per distinct grid start (which the watermark
+        merge may turn into a slice). When a batch's projected need
+        exceeds the remaining headroom the mirror re-syncs exactly, then:
+
+        * ``grow`` — double capacity (checkpoint → rebuild → restore)
+          until the batch fits or ``max_capacity`` raises;
+        * ``shed`` — drop late tuples first (they can only repair
+          already-old windows — the lowest-watermark-impact rows), then
+          tuples opening grid slices beyond the remaining headroom,
+          admitting starts in ascending order. Drops are exact and
+          auditable: ``resilience_shed_tuples`` + ``device_dropped_tuples``
+          counters and the ``shed_callback(vals, ts)`` hook — the engine's
+          results equal an oracle replay of precisely the survivors.
+        """
+        from . import core as ec
+        from ..obs import device as _dev
+        from ..resilience.policy import OverflowPolicy
+
+        cfg = self.config
+        vals, ts = vals[:take], ts[:take]
+        starts = ec.host_grid_start(self._grid_spec, ts)
+        late_m = (ts < met_pre) if met_pre is not None \
+            else np.zeros(take, bool)
+        seen = self._pol_seen_start
+        io_starts = np.unique(starts[~late_m])
+        if seen is not None:
+            io_starts = io_starts[io_starts > seen]
+        late_starts = np.unique(starts[late_m])
+        slack = self._POL_SLACK
+        cap_s = cfg.capacity - slack
+        cap_a = cfg.annex_capacity - slack
+
+        def over():
+            return (self._pol_slices_ub + io_starts.size + late_starts.size
+                    > cap_s
+                    or self._pol_annex_ub + late_starts.size > cap_a)
+
+        if over():
+            self._pol_refresh()
+        if over() and cfg.overflow_policy == OverflowPolicy.GROW:
+            while over():
+                self._grow_capacity()       # raises at max_capacity
+                cap_s = self.config.capacity - slack
+                cap_a = self.config.annex_capacity - slack
+        elif over():                        # SHED
+            drop = np.zeros(take, bool)
+            if late_starts.size:            # late lanes first
+                drop |= late_m
+                late_starts = late_starts[:0]
+            if self._pol_slices_ub + io_starts.size > cap_s:
+                allowed = max(0, cap_s - self._pol_slices_ub)
+                if allowed < io_starts.size:
+                    drop |= (~late_m) & (starts >= io_starts[allowed])
+                    io_starts = io_starts[:allowed]
+            n_drop = int(drop.sum())
+            if n_drop:
+                if self.obs is not None:
+                    self.obs.counter(_obs.RESILIENCE_SHED_TUPLES).inc(n_drop)
+                if self._dm_active:
+                    self._dm_host_add(_dev.DEVICE_DROPPED_TUPLES, n_drop)
+                if self.shed_callback is not None:
+                    self.shed_callback(vals[drop].copy(), ts[drop].copy())
+                keep = ~drop
+                vals, ts, starts = vals[keep], ts[keep], starts[keep]
+                take = int(vals.shape[0])
+        # mirror the admitted batch
+        self._pol_slices_ub += io_starts.size + late_starts.size
+        self._pol_annex_ub += late_starts.size
+        if take and io_starts.size:
+            self._pol_seen_start = int(max(
+                seen if seen is not None else np.iinfo(np.int64).min,
+                io_starts[-1]))
+        return vals, ts, take
+
+    def _grow_capacity(self) -> None:
+        """GROW one step: snapshot the full device state via the
+        checkpoint pytree machinery, rebuild every jitted kernel at the
+        doubled capacity, corner-paste the old state into the fresh
+        (larger) buffers and resume — host clock mirrors carry over, so
+        the continued run is bit-identical to one pre-sized at the larger
+        capacity (tests/test_resilience_policy.py)."""
+        import contextlib
+
+        import jax
+
+        from ..resilience.policy import grow_engine_config, pad_tree
+        from ..utils import checkpoint as _ck
+
+        new_cfg = grow_engine_config(self.config)   # raises at max_capacity
+        span = self.obs.span(_obs.RESILIENCE_GROW_SPAN) \
+            if self.obs is not None else contextlib.nullcontext()
+        with span:
+            old_leaves = jax.device_get(
+                jax.tree.flatten(_ck._full_state(self))[0])
+            mirrors = {k: getattr(self, k) for k in (
+                "_host_met", "_host_min_ts", "_host_first_ts", "_host_count",
+                "_last_count", "_annex_dirty", "_count_late_seen",
+                "_host_open", "_device_fed", "_last_watermark", "_dm",
+                "_dm_host_acc", "_dm_folded", "_pol_seen_start")}
+            self.config = new_cfg
+            self._built = False
+            self._build()                   # fresh kernels + state at 2×
+            for k, v in mirrors.items():
+                setattr(self, k, v)
+            _ck._set_full_state(
+                self, pad_tree(old_leaves, _ck._full_state(self)))
+        self._pol_refresh()
+        if self.obs is not None:
+            self.obs.counter(_obs.RESILIENCE_GROW_EVENTS).inc()
+
     def _flush(self) -> None:
         while self._n_pending > 0:
             self._launch_batch(min(self._n_pending, self.config.batch_size))
@@ -1051,6 +1215,11 @@ class TpuWindowOperator(WindowOperator):
         device-side sources — host→device bandwidth never caps throughput."""
         if not self._built:
             self._build()
+        if self.config.overflow_policy != "fail":
+            raise UnsupportedOnDevice(
+                "overflow policies need host-visible timestamps for the "
+                "admission mirror; device-resident ingest runs policy "
+                "'fail'")
         import jax
 
         B = self.config.batch_size
@@ -1149,6 +1318,11 @@ class TpuWindowOperator(WindowOperator):
         disorder from the in-order base stream."""
         if not self._built:
             self._build()
+        if self.config.overflow_policy != "fail":
+            raise UnsupportedOnDevice(
+                "overflow policies need host-visible timestamps for the "
+                "admission mirror; device-resident ingest runs policy "
+                "'fail'")
         if self._has_count or self._session_states or self._ctx_states:
             raise UnsupportedOnDevice(
                 "out-of-order device batches with count-measure, session "
@@ -1409,11 +1583,16 @@ class TpuWindowOperator(WindowOperator):
         if bool(ovf):
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
+            note = "" if self.config.overflow_policy == "fail" else (
+                f" (overflow_policy={self.config.overflow_policy!r} could "
+                "not prevent it — the raised device flag means writes were "
+                "already clamped, which is unrecoverable under any policy)")
             raise RuntimeError(
                 "slice/session buffer overflow: raise EngineConfig.capacity "
                 "(slice rows, session rows) / annex_capacity (late annex & "
-                "session orphan buffer) / batch sizing, or advance "
-                "watermarks more often")
+                "session orphan buffer) / batch sizing, advance watermarks "
+                "more often, or set EngineConfig.overflow_policy to "
+                "'shed'/'grow' (scotty_tpu.resilience)" + note)
 
     def check_overflow(self) -> None:
         """One deliberate sync validating the run (async users call this
@@ -1461,10 +1640,18 @@ class TpuWindowOperator(WindowOperator):
         for (m, ws_h, we_h, cnt_h, res_h) in gap_outs:
             m = int(m)
             if m > self._emit_cap:
+                # the second overflow raise path (ISSUE 3 satellite):
+                # counted like the buffer-overflow path so dashboards and
+                # the obs diff gate see it, with an actionable hint
+                if self.obs is not None:
+                    self.obs.counter(_obs.OVERFLOWS).inc()
                 raise RuntimeError(
                     f"{m} sessions completed in one watermark exceeds the "
                     f"emission buffer ({self._emit_cap}); raise "
-                    "EngineConfig.min_trigger_pad")
+                    "EngineConfig.min_trigger_pad, advance watermarks more "
+                    "often (fewer sessions complete per sweep), or run "
+                    "under a scotty_tpu.resilience.Supervisor to restart "
+                    "from the last checkpoint")
             ws_parts.append(ws_h[:m])
             we_parts.append(we_h[:m])
             cnt_parts.append(cnt_h[:m])

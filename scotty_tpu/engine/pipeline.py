@@ -398,6 +398,45 @@ class FusedPipelineDriver:
                                                  self._dm_folded)
         return v
 
+    def enforce_overflow_policy(self, factory=None, obs=None):
+        """Apply ``EngineConfig.overflow_policy`` at a drain point and
+        return the pipeline to continue with.
+
+        ``fail`` (default) — :meth:`check_overflow` as today. ``grow`` —
+        when the live-slice occupancy (read at the sync this method
+        performs) reaches ``config.grow_occupancy``, snapshot the carried
+        state via the checkpoint pytree machinery, rebuild through
+        ``factory(grown_config)`` at 2× capacity and hand back the grown
+        replacement (same interval counter / RNG root / DeviceMetrics —
+        the continued run is bit-identical to one pre-sized larger);
+        growth is preventive and bounded by ``config.max_capacity``.
+        ``shed`` has no pipeline meaning (fused pipelines generate their
+        own load in-jit — there is nothing external to shed; admission-
+        boundary shedding lives in TpuWindowOperator/connectors) and
+        behaves like ``fail`` here.
+
+        This method owns the drain: it always performs ONE
+        :meth:`sync` (which also folds the DeviceMetrics delta and, under
+        GROW, doubles as the occupancy read) before the overflow check —
+        callers like the Supervisor need no separate ``sync()`` per
+        checkpoint chunk. Without a ``factory`` the method degrades to
+        drain + :meth:`check_overflow`.
+        """
+        from ..resilience.policy import OverflowPolicy, grow_pipeline
+
+        policy = getattr(self.config, "overflow_policy", OverflowPolicy.FAIL)
+        n = self.sync()
+        p = self
+        if (policy == OverflowPolicy.GROW and factory is not None
+                and self._anchor_is_slices):
+            cap = self.config.capacity
+            if n >= int(cap * getattr(self.config, "grow_occupancy", 0.85)):
+                p = grow_pipeline(
+                    self, factory,
+                    obs=obs if obs is not None else self.obs)
+        p.check_overflow()
+        return p
+
 
 class StreamPipeline(FusedPipelineDriver):
     """One fused XLA step per watermark interval.

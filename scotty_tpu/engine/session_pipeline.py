@@ -540,7 +540,11 @@ class SessionStreamPipeline(FusedPipelineDriver):
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
             raise RuntimeError(
-                "slice/session buffer overflow: raise capacity")
+                "slice/session buffer overflow: raise capacity. (GROW's "
+                "occupancy trigger watches the slice anchor only, so "
+                "session-row pressure on this pipeline cannot be "
+                "prevented by overflow_policy='grow'; a raised flag is "
+                "unrecoverable under any policy)")
 
     def materialize_interval(self, i: int):
         """Regenerate interval i's tuple stream on host (testing): returns

@@ -45,6 +45,22 @@ Stable metric-name contract (documented in README.md / docs/API.md):
 ``silent_intervals``      counter: session-pipeline intervals with no tuples
 ``emit_latency_ms``       histogram: sampled dispatch→results-on-host time
 ========================  ====================================================
+
+Resilience contract (ISSUE 3 — counters emitted by the
+:mod:`scotty_tpu.resilience` subsystem and the policy hooks in engine/
+connectors; spans ``resilience_checkpoint`` / ``resilience_restore`` /
+``resilience_backoff`` / ``resilience_grow`` ride the same recorder):
+
+==============================  ==============================================
+``resilience_shed_tuples``      counter: tuples dropped by the SHED policy
+                                (also counted as ``device_dropped_tuples``)
+``resilience_grow_events``      counter: GROW capacity doublings
+``resilience_checkpoints``      counter: automatic supervisor checkpoints
+``resilience_restarts``         counter: supervisor restarts after a failure
+``resilience_source_retries``   counter: retrying-source reconnect attempts
+``resilience_poison_records``   counter: records routed to dead-letter
+``resilience_stall_events``     counter: no-progress watchdog detections
+==============================  ==============================================
 """
 
 from __future__ import annotations
@@ -83,6 +99,20 @@ WINDOWS_EMITTED = "windows_emitted"
 OVERFLOWS = "overflows"
 SILENT_INTERVALS = "silent_intervals"
 EMIT_LATENCY_MS = "emit_latency_ms"
+
+# resilience contract (scotty_tpu.resilience — counters)
+RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
+RESILIENCE_GROW_EVENTS = "resilience_grow_events"
+RESILIENCE_CHECKPOINTS = "resilience_checkpoints"
+RESILIENCE_RESTARTS = "resilience_restarts"
+RESILIENCE_SOURCE_RETRIES = "resilience_source_retries"
+RESILIENCE_POISON_RECORDS = "resilience_poison_records"
+RESILIENCE_STALL_EVENTS = "resilience_stall_events"
+# resilience spans
+RESILIENCE_CHECKPOINT_SPAN = "resilience_checkpoint"
+RESILIENCE_RESTORE_SPAN = "resilience_restore"
+RESILIENCE_BACKOFF_SPAN = "resilience_backoff"
+RESILIENCE_GROW_SPAN = "resilience_grow"
 
 
 class Observability:
@@ -145,4 +175,10 @@ __all__ = [
     "INTERVAL_STEP_MS", "SYNC_MS", "SLICE_OCCUPANCY", "SLICE_HEADROOM",
     "QUEUE_DEPTH", "WINDOWS_EMITTED", "OVERFLOWS", "SILENT_INTERVALS",
     "EMIT_LATENCY_MS",
+    "RESILIENCE_SHED_TUPLES", "RESILIENCE_GROW_EVENTS",
+    "RESILIENCE_CHECKPOINTS", "RESILIENCE_RESTARTS",
+    "RESILIENCE_SOURCE_RETRIES", "RESILIENCE_POISON_RECORDS",
+    "RESILIENCE_STALL_EVENTS", "RESILIENCE_CHECKPOINT_SPAN",
+    "RESILIENCE_RESTORE_SPAN", "RESILIENCE_BACKOFF_SPAN",
+    "RESILIENCE_GROW_SPAN",
 ]

@@ -25,6 +25,10 @@ Threshold file format (JSON)::
   (must match within tolerance — default 0).
 * ``rel_tol`` / ``abs_tol``: slack; a change is a regression only when it
   exceeds BOTH ``rel_tol * |baseline|`` and ``abs_tol`` (defaults 0).
+* ``default``: value substituted when ONE side lacks the metric (without
+  it, one-sided metrics are skipped). Used by the ``resilience_*``
+  entries so degraded-mode counters APPEARING in a candidate gate even
+  though a clean baseline never exported the key.
 * ``require_cells`` (default true): a baseline cell missing from the
   candidate is itself a regression (a silently dropped bench cell must
   not pass the gate).
@@ -48,6 +52,19 @@ DEFAULT_THRESHOLDS = {
         "emit_ms_device": {"direction": "lower", "rel_tol": 0.25,
                            "abs_tol": 1.0},
         "windows_emitted": {"direction": "equal"},
+        # resilience contract (ISSUE 3): degraded-mode events appearing
+        # (or multiplying) between baseline and candidate are regressions
+        # even when throughput held — a run that silently started
+        # shedding, restarting or dead-lettering must not pass the gate.
+        # "default": 0 covers the appearing case: these counters are
+        # created lazily, so a clean baseline export has no key at all.
+        "overflows": {"direction": "lower", "default": 0},
+        "resilience_shed_tuples": {"direction": "lower", "default": 0},
+        "resilience_grow_events": {"direction": "lower", "default": 0},
+        "resilience_restarts": {"direction": "lower", "default": 0},
+        "resilience_poison_records": {"direction": "lower", "default": 0},
+        "resilience_source_retries": {"direction": "lower", "default": 0},
+        "resilience_stall_events": {"direction": "lower", "default": 0},
     },
     "require_cells": True,
 }
@@ -145,14 +162,22 @@ def diff_exports(baseline_path: str, candidate_path: str,
                              "status": "regressed",
                              "detail": "candidate cell errored"})
         for name, spec in th["metrics"].items():
-            if name not in base or name not in cand:
+            if name not in base and name not in cand:
                 continue
-            regressed, rel = _check(spec, float(base[name]),
-                                    float(cand[name]))
+            if (name not in base or name not in cand) \
+                    and "default" not in spec:
+                # one-sided metrics are skipped unless the spec declares a
+                # default for the absent side — the resilience counters do
+                # (they are created lazily, so a clean FAIL baseline has
+                # no key; the candidate STARTING to shed must still gate)
+                continue
+            bval = float(base.get(name, spec.get("default", 0.0)))
+            cval = float(cand.get(name, spec.get("default", 0.0)))
+            regressed, rel = _check(spec, bval, cval)
             findings.append({
                 "cell": key, "metric": name,
-                "baseline": float(base[name]),
-                "candidate": float(cand[name]),
+                "baseline": bval,
+                "candidate": cval,
                 "rel_change": rel,
                 "status": "regressed" if regressed else "ok"})
     return findings

@@ -1,0 +1,62 @@
+"""Resilience subsystem: overflow policies, supervised recovery, chaos.
+
+Three pillars (ISSUE 3), threaded through engine, connectors and obs:
+
+* **Overflow policies** (:mod:`.policy`) — ``EngineConfig.overflow_policy``
+  selects ``fail`` (seed behavior, still the default), ``shed`` (drop the
+  lowest-watermark-impact tuples at the host ingest boundary, exactly
+  counted) or ``grow`` (checkpoint → rebuild at 2× capacity → restore,
+  bounded by ``max_capacity``).
+* **Supervised execution** (:mod:`.supervisor`) — periodic automatic
+  checkpoints + restart-from-checkpoint with bounded backoff/jitter on an
+  injectable :mod:`.clock`; source-offset replay makes recovered runs
+  bit-match uninterrupted ones. :mod:`.connectors` adds the retrying
+  source, poison/dead-letter handling and the stall watchdog the
+  concrete adapters build on.
+* **Chaos harness** (:mod:`.chaos`) — seeded, deterministic fault
+  injectors (overload bursts, late storms, transient exceptions, record
+  corruption, source stalls) driving the differential suite.
+
+All recovery events surface as ``resilience_*`` counters/spans through
+:mod:`scotty_tpu.obs` (names in the obs contract table).
+"""
+
+from .chaos import (
+    ChaosError,
+    CrashInjector,
+    FlakySource,
+    StallingSource,
+    burst,
+    corrupt_records,
+    late_storm,
+    make_records,
+)
+from .clock import Clock, ManualClock, SystemClock
+from .connectors import (
+    PoisonHandler,
+    PoisonLimitExceeded,
+    SourceExhaustedRetries,
+    SourceStalled,
+    retrying_source,
+    watchdog_source,
+)
+from .policy import (
+    OverflowPolicy,
+    backoff_delay,
+    grow_engine_config,
+    grow_pipeline,
+    max_capacity_of,
+    pad_tree,
+)
+from .supervisor import ELEMENTS, WATERMARK, Supervisor, SupervisorGaveUp
+
+__all__ = [
+    "OverflowPolicy", "grow_engine_config", "grow_pipeline", "pad_tree",
+    "max_capacity_of", "backoff_delay",
+    "Supervisor", "SupervisorGaveUp", "ELEMENTS", "WATERMARK",
+    "Clock", "SystemClock", "ManualClock",
+    "PoisonHandler", "PoisonLimitExceeded", "SourceExhaustedRetries",
+    "SourceStalled", "retrying_source", "watchdog_source",
+    "ChaosError", "CrashInjector", "FlakySource", "StallingSource",
+    "burst", "late_storm", "corrupt_records", "make_records",
+]

@@ -1,0 +1,173 @@
+"""Deterministic fault injection — the chaos harness.
+
+Seeded injectors producing the failure modes the resilience subsystem
+claims to survive, used by the differential suite
+(tests/test_resilience_*.py) to *prove* degrade-and-recover behavior
+against the host simulator oracle:
+
+* :func:`burst` / :func:`late_storm` — overload streams that force slice
+  or annex pressure (overflow under ``FAIL``).
+* :class:`FlakySource` — transient exceptions at exact stream offsets
+  (each fires once, so a retried/replayed pass succeeds — the
+  "transient" contract).
+* :class:`CrashInjector` — one-shot mid-stream crash hooks for the
+  Supervisor (raise at interval/offset k, then never again).
+* :func:`corrupt_records` — malformed payload injection for the
+  connector poison/dead-letter path.
+* :class:`StallingSource` — a source that goes silent for a configured
+  span on an injectable clock (watchdog fodder; no wall-clock waits
+  under :class:`~scotty_tpu.resilience.clock.ManualClock`).
+
+Everything is a pure function of its seed: two runs with the same seed
+inject byte-identical faults, which is what lets the differential tests
+compare a chaos run against an oracle replay exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from .clock import Clock, SystemClock
+
+
+class ChaosError(RuntimeError):
+    """The injected transient failure type (so tests and supervisors can
+    tell injected faults from real bugs)."""
+
+
+def rng_of(seed: int) -> np.random.Generator:
+    return np.random.default_rng(seed)
+
+
+def burst(seed: int, n: int, t0: int, t1: int, value_lo: int = 0,
+          value_hi: int = 256):
+    """An in-order overload burst: ``n`` tuples with sorted integer-valued
+    event times uniform over ``[t0, t1)`` and small-integer values
+    (exactly representable in float32, so any aggregation order produces
+    bit-identical sums — the chaos differential suite compares results
+    bit-for-bit). Returns ``(vals f32, ts i64)``."""
+    rng = rng_of(seed)
+    ts = np.sort(rng.integers(t0, t1, size=n)).astype(np.int64)
+    vals = rng.integers(value_lo, value_hi, size=n).astype(np.float32)
+    return vals, ts
+
+
+def late_storm(seed: int, n: int, now_ts: int, max_lateness: int,
+               value_lo: int = 0, value_hi: int = 256):
+    """A storm of LATE tuples: event times uniform over
+    ``[now_ts - max_lateness, now_ts)`` (within the lateness contract but
+    behind the stream head — annex pressure), small-integer values."""
+    rng = rng_of(seed)
+    lo = max(0, now_ts - max_lateness)
+    ts = rng.integers(lo, max(lo + 1, now_ts), size=n).astype(np.int64)
+    vals = rng.integers(value_lo, value_hi, size=n).astype(np.float32)
+    return vals, ts
+
+
+class FlakySource:
+    """Wrap an indexable record sequence as an iterable that raises
+    :class:`ChaosError` just before yielding the configured offsets —
+    each offset fires ONCE across the object's lifetime, so a retrying
+    consumer that resumes from its last good offset completes.
+
+    ``make()`` (or calling the object with an offset) yields records from
+    that offset — the factory face :func:`resilience.connectors.
+    retrying_source` consumes.
+    """
+
+    def __init__(self, records: Sequence, fail_at: Iterable[int],
+                 exc: type = ChaosError):
+        self.records = records
+        self._remaining = set(int(i) for i in fail_at)
+        self.exc = exc
+        self.failures: list = []
+
+    def __call__(self, offset: int = 0) -> Iterator:
+        for i in range(int(offset), len(self.records)):
+            if i in self._remaining:
+                self._remaining.discard(i)
+                self.failures.append(i)
+                raise self.exc(f"injected transient failure at offset {i}")
+            yield self.records[i]
+
+    def __iter__(self) -> Iterator:
+        return self(0)
+
+
+class CrashInjector:
+    """One-shot crash hook for the Supervisor: raises :class:`ChaosError`
+    the first time it is called with ``pos >= at``; later calls (the
+    recovered replay) pass. ``fired`` records the position."""
+
+    def __init__(self, at: int, exc: type = ChaosError):
+        self.at = int(at)
+        self.exc = exc
+        self.fired: Optional[int] = None
+
+    def __call__(self, pos: int) -> None:
+        if self.fired is None and pos >= self.at:
+            self.fired = int(pos)
+            raise self.exc(f"injected crash at {pos}")
+
+
+class _Record:
+    """Kafka-like record (key/value/timestamp) for connector chaos."""
+
+    __slots__ = ("key", "value", "timestamp")
+
+    def __init__(self, key, value, timestamp):
+        self.key, self.value, self.timestamp = key, value, timestamp
+
+
+def make_records(seed: int, n: int, keys: int = 4,
+                 period_ms: int = 10) -> list:
+    """A clean keyed record stream (numeric string payloads, ascending
+    timestamps) for connector tests."""
+    rng = rng_of(seed)
+    return [_Record(f"k{int(rng.integers(keys))}",
+                    str(int(rng.integers(0, 100))),
+                    i * period_ms)
+            for i in range(n)]
+
+
+def corrupt_records(records: Sequence, seed: int, pct: float,
+                    payload: bytes = b"\xff{not-json-not-a-number"):
+    """Replace a seeded ``pct`` fraction of record VALUES with a payload
+    that is neither JSON nor numeric (the poison class that used to kill
+    ``KafkaScottyWindowOperator.run``). Returns ``(records, poisoned_idx)``
+    — the injected offsets, so tests can assert the dead-letter path saw
+    exactly these."""
+    rng = rng_of(seed)
+    out = list(records)
+    # at least one poison record for any POSITIVE pct (tiny streams still
+    # exercise the path), but pct=0.0 is an honest clean control arm
+    n_bad = max(1, int(len(out) * pct)) if out and pct > 0 else 0
+    idx = sorted(rng.choice(len(out), size=n_bad, replace=False).tolist()) \
+        if n_bad else []
+    for i in idx:
+        r = out[i]
+        out[i] = _Record(r.key, payload, r.timestamp)
+    return out, idx
+
+
+class StallingSource:
+    """Iterate ``records``, going silent for ``stall_s`` clock-seconds
+    before the configured offsets (the clock is injectable, so tests
+    advance a :class:`ManualClock` instead of sleeping). A no-progress
+    watchdog wrapped around this source must flag exactly
+    ``len(stall_at)`` stalls."""
+
+    def __init__(self, records: Sequence, stall_at: Iterable[int],
+                 stall_s: float, clock: Optional[Clock] = None):
+        self.records = records
+        self.stall_at = set(int(i) for i in stall_at)
+        self.stall_s = float(stall_s)
+        self.clock = clock or SystemClock()
+
+    def __iter__(self) -> Iterator:
+        for i, r in enumerate(self.records):
+            if i in self.stall_at:
+                self.clock.sleep(self.stall_s)
+            yield r

@@ -1,0 +1,58 @@
+"""Injectable clock — the single place wall-clock waits are allowed.
+
+Every backoff, watchdog and stall timeout in scotty_tpu goes through a
+:class:`Clock` so the chaos/differential tests can drive recovery logic
+deterministically with :class:`ManualClock` (tier-1 lint enforces it:
+``tests/test_no_print_in_engine.py::test_no_bare_time_sleep`` rejects any
+``time.sleep`` outside this module). The reference has no equivalent —
+its connectors inherit the host engine's retry machinery (SURVEY.md §2.4);
+here scotty_tpu *is* the engine, so the waits are ours to own and to test.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """Monotonic now() + sleep() pair. Implementations must keep
+    ``now()`` consistent with ``sleep()`` (after ``sleep(d)``, ``now()``
+    advanced by at least ``d``) so watchdog/backoff logic is
+    implementation-independent."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """Real wall clock (monotonic)."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+class ManualClock(Clock):
+    """Deterministic test clock: ``sleep`` advances virtual time instantly
+    and logs the requested delays (``sleeps``), so backoff schedules are
+    asserted exactly and chaos tests never wait on the wall."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self.sleeps: list = []
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(float(seconds))
+        self._now += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        self._now += float(seconds)

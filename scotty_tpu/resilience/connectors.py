@@ -1,0 +1,138 @@
+"""Connector hardening: retrying sources, poison records, stall watchdog.
+
+The reference connectors adapt a host engine that already owns retries
+and dead-letter queues; scotty_tpu's connectors talk to raw iterables /
+queues, where the seed behavior was die-on-first-error. This module
+provides the shared wrappers the concrete adapters
+(``connectors/kafka.py``, ``connectors/asyncio_connector.py``,
+``connectors/iterable.py``) build on:
+
+* :func:`retrying_source` — resume a flaky source from its last good
+  offset with bounded backoff (``resilience_source_retries``).
+* :class:`PoisonHandler` — per-record poison handling with a dead-letter
+  callback and optional hard limit (``resilience_poison_records``).
+* :func:`watchdog_source` — no-progress detection on an injectable clock
+  (``resilience_stall_events``).
+
+All waits go through :mod:`~scotty_tpu.resilience.clock` (tier-1 lint).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .. import obs as _obs
+from .clock import Clock, SystemClock
+from .policy import backoff_delay
+
+
+class SourceExhaustedRetries(RuntimeError):
+    """A retrying source failed more than ``max_retries`` consecutive
+    times without yielding a record in between."""
+
+
+class SourceStalled(RuntimeError):
+    """A watched source made no progress past its stall budget
+    (the asyncio ``queue_source`` preemptive watchdog)."""
+
+
+class PoisonLimitExceeded(RuntimeError):
+    """More poison records than the configured hard limit."""
+
+
+def retrying_source(make_source: Callable[[int], Iterator],
+                    max_retries: int = 3, backoff_base_s: float = 0.05,
+                    backoff_max_s: float = 2.0, jitter: float = 0.5,
+                    clock: Optional[Clock] = None, obs=None,
+                    seed: int = 0) -> Iterator:
+    """Iterate ``make_source(offset)``, transparently restarting it from
+    the next unseen offset when it raises mid-stream. Consecutive-failure
+    counting resets on progress, so a long stream with occasional
+    transient faults keeps flowing; ``max_retries`` consecutive failures
+    raise :class:`SourceExhaustedRetries` (with the last failure as
+    ``__cause__``). Backoff is bounded-exponential with seeded jitter on
+    the injectable ``clock``."""
+    clock = clock or SystemClock()
+    rng = np.random.default_rng(seed)
+    offset = 0
+    failures = 0
+    while True:
+        try:
+            for item in make_source(offset):
+                yield item
+                offset += 1
+                failures = 0               # progress resets the budget
+            return
+        except Exception as e:                 # noqa: BLE001 — source edge
+            failures += 1
+            if obs is not None:
+                obs.counter(_obs.RESILIENCE_SOURCE_RETRIES).inc()
+            if failures > max_retries:
+                raise SourceExhaustedRetries(
+                    f"source failed {failures} consecutive times at "
+                    f"offset {offset}") from e
+            clock.sleep(backoff_delay(failures, backoff_base_s,
+                                      backoff_max_s, jitter, rng))
+
+
+class PoisonHandler:
+    """Per-record poison policy shared by the adapters: count the record,
+    hand it (with its error) to the dead-letter callback, and keep the
+    stream alive — up to ``limit`` poison records (None = unbounded),
+    after which :class:`PoisonLimitExceeded` propagates (a stream that is
+    ALL garbage should not fail silently)."""
+
+    def __init__(self, dead_letter: Optional[Callable] = None,
+                 limit: Optional[int] = None, obs=None):
+        self.dead_letter = dead_letter
+        self.limit = limit
+        self.obs = obs
+        self.count = 0
+
+    def handle(self, record, exc: BaseException) -> None:
+        self.count += 1
+        if self.obs is not None:
+            self.obs.counter(_obs.RESILIENCE_POISON_RECORDS).inc()
+        if self.dead_letter is not None:
+            self.dead_letter(record, exc)
+        if self.limit is not None and self.count > self.limit:
+            raise PoisonLimitExceeded(
+                f"{self.count} poison records exceeds limit "
+                f"{self.limit}") from exc
+
+
+def watchdog_source(source, stall_timeout_s: float,
+                    clock: Optional[Clock] = None, obs=None,
+                    on_stall: Optional[Callable[[float], None]] = None
+                    ) -> Iterator:
+    """No-progress watchdog for pull-based sources: measures the clock
+    time between consecutive yields and flags every gap above
+    ``stall_timeout_s`` (counter ``resilience_stall_events`` + optional
+    ``on_stall(gap_seconds)`` callback). Detection is post-hoc — a
+    synchronous iterator cannot be preempted — which is exactly what the
+    chaos tests need: a :class:`~scotty_tpu.resilience.chaos.
+    StallingSource` on a ManualClock is flagged deterministically. The
+    asyncio adapter's ``queue_source`` does the preemptive (timeout)
+    variant.
+
+    Only the SOURCE's pull time is measured — the window opens just
+    before resuming the underlying iterator and closes when the item
+    arrives, so a slow CONSUMER (heavy processing between pulls) is
+    never misreported as a producer stall."""
+    clock = clock or SystemClock()
+    it = iter(source)
+    while True:
+        t_pull = clock.now()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        gap = clock.now() - t_pull
+        if gap > stall_timeout_s:
+            if obs is not None:
+                obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
+            if on_stall is not None:
+                on_stall(gap)
+        yield item

@@ -1,0 +1,290 @@
+"""Supervised execution: periodic checkpoints + restart-from-checkpoint.
+
+Scotty assumes the host engine provides fault tolerance (the slicing
+paper defers checkpoint/restore to Flink-style snapshots — Carbone et
+al.); scotty_tpu is its own engine, so :class:`Supervisor` closes the
+loop around the checkpoint machinery that already exists
+(utils/checkpoint.py): wrap a fused pipeline or a
+:class:`~scotty_tpu.engine.operator.TpuWindowOperator` + replayable
+source, checkpoint every N units of progress, and on failure restart
+from the last checkpoint with bounded exponential backoff + jitter on an
+injectable clock.
+
+Exactness contract: the fused pipelines' streams are pure functions of
+``(seed, interval)`` and the operator mode replays its source from the
+checkpointed offset, so a recovered run's final windows BIT-MATCH an
+uninterrupted run (tests/test_resilience_supervisor.py asserts it).
+Results are keyed by position and replays overwrite identically, so a
+crash between checkpoints never double-emits.
+
+Recovery events are exported through the existing Observability layer:
+``resilience_checkpoints`` / ``resilience_restarts`` counters and
+``resilience_checkpoint`` / ``resilience_restore`` /
+``resilience_backoff`` spans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .. import obs as _obs
+from .clock import Clock, SystemClock
+from .policy import backoff_delay
+
+#: source event kinds for :meth:`Supervisor.run_operator`
+ELEMENTS = "elements"
+WATERMARK = "watermark"
+
+
+class SupervisorGaveUp(RuntimeError):
+    """Raised when ``max_restarts`` consecutive recoveries failed; carries
+    the last failure as ``__cause__``."""
+
+
+class Supervisor:
+    """Checkpoint/restart wrapper (see module docstring).
+
+    ``checkpoint_every`` counts pipeline intervals (``run_pipeline``) or
+    source events (``run_operator``) between automatic checkpoints.
+    ``clock`` is injectable (chaos tests pass a
+    :class:`~scotty_tpu.resilience.clock.ManualClock`); ``seed`` fixes the
+    backoff jitter draws, keeping recovery schedules deterministic.
+    """
+
+    def __init__(self, checkpoint_dir: str, clock: Optional[Clock] = None,
+                 obs=None, checkpoint_every: int = 4, max_restarts: int = 3,
+                 backoff_base_s: float = 0.05, backoff_max_s: float = 2.0,
+                 jitter: float = 0.5, seed: int = 0):
+        self.dir = checkpoint_dir
+        self.clock = clock or SystemClock()
+        self.obs = obs
+        self.checkpoint_every = int(checkpoint_every)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.jitter = float(jitter)
+        self._rng = np.random.default_rng(seed)
+        self.restarts = 0          # consecutive failed recoveries
+        self.total_restarts = 0    # lifetime (telemetry mirror)
+
+    # -- shared plumbing ---------------------------------------------------
+    def _count(self, name: str, n: int = 1) -> None:
+        if self.obs is not None:
+            self.obs.counter(name).inc(n)
+
+    def _span(self, name: str):
+        if self.obs is not None:
+            return self.obs.span(name)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+    def _backoff(self, exc: BaseException) -> None:
+        # `restarts` counts CONSECUTIVE failed recoveries: a successful
+        # checkpoint (progress) resets it, so a long stream with occasional
+        # transient faults keeps flowing — only max_restarts failures in a
+        # row (no checkpoint in between) give up. `total_restarts` and the
+        # registry counter stay cumulative for telemetry.
+        self.restarts += 1
+        self.total_restarts += 1
+        self._count(_obs.RESILIENCE_RESTARTS)
+        if self.restarts > self.max_restarts:
+            raise SupervisorGaveUp(
+                f"gave up after {self.max_restarts} restarts "
+                f"(last failure: {exc})") from exc
+        delay = backoff_delay(self.restarts, self.backoff_base_s,
+                              self.backoff_max_s, self.jitter, self._rng)
+        with self._span(_obs.RESILIENCE_BACKOFF_SPAN):
+            self.clock.sleep(delay)
+
+    # -- atomic checkpoint commit ------------------------------------------
+    # Each checkpoint writes into its own ``ckpt-<pos>`` subdirectory
+    # (state + config sidecar + offset), and only then an atomic
+    # ``os.replace`` flips the LATEST pointer. A crash mid-write leaves
+    # the pointer at the previous fully-committed checkpoint, so a
+    # restart can never pair new state with a stale offset (silent
+    # double-ingestion) or grown-shape state with a stale config (an
+    # unrecoverable restore loop) — the sidecars commit WITH the state
+    # or not at all.
+
+    _POINTER = "LATEST.json"
+
+    def _current_ckpt(self) -> Optional[str]:
+        ptr = os.path.join(self.dir, self._POINTER)
+        if not os.path.exists(ptr):
+            return None
+        with open(ptr) as f:
+            return os.path.join(self.dir, json.load(f)["dir"])
+
+    def _new_ckpt_dir(self, pos: int) -> str:
+        path = os.path.join(self.dir, f"ckpt-{pos}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _commit_ckpt(self, path: str) -> None:
+        prev = self._current_ckpt()
+        ptr = os.path.join(self.dir, self._POINTER)
+        tmp = ptr + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"dir": os.path.basename(path)}, f)
+        os.replace(tmp, ptr)                  # the atomic commit point
+        if prev and os.path.abspath(prev) != os.path.abspath(path):
+            import shutil
+
+            shutil.rmtree(prev, ignore_errors=True)
+
+    def _save_config_sidecar(self, path: str, config) -> None:
+        """The engine config rides inside the checkpoint directory: the
+        GROW policy may have doubled capacity since the factory's
+        default, and a restart must rebuild at the CHECKPOINTED shapes or
+        the restore leaf-shape check rejects the snapshot."""
+        import dataclasses
+
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(dataclasses.asdict(config), f)
+
+    def _load_config_sidecar(self, ckpt: Optional[str]):
+        if ckpt is None:
+            return None
+        path = os.path.join(ckpt, "config.json")
+        if not os.path.exists(path):
+            return None
+        from ..engine.config import EngineConfig
+
+        with open(path) as f:
+            return EngineConfig(**json.load(f))
+
+    # -- pipeline mode -----------------------------------------------------
+    def run_pipeline(self, factory: Callable, n_intervals: int,
+                     fault: Optional[Callable[[int], None]] = None) -> list:
+        """Run a fused pipeline for ``n_intervals`` under supervision.
+
+        ``factory(config=None)`` builds a fresh pipeline (same seed and
+        constructor arguments each call; a non-None config overrides the
+        engine config — the GROW policy rebuilds through it).
+        ``fault(completed)`` is the chaos hook, called after each interval
+        — an exception it raises is treated as a mid-stream crash.
+        Returns the per-interval lowered window rows, in interval order.
+        """
+        from ..utils.checkpoint import save_pipeline
+
+        results: dict = {}
+        p = self._pipeline_start(factory)
+        while True:
+            try:
+                i = int(getattr(p, "_interval", 0))
+                while i < n_intervals:
+                    out = p.run(1)[0]
+                    results[i] = p.lowered_results(out)
+                    i += 1
+                    if fault is not None:
+                        fault(i)
+                    if i % self.checkpoint_every == 0 or i == n_intervals:
+                        # enforce_overflow_policy owns the single drain
+                        # (its sync folds DeviceMetrics and reads the
+                        # GROW occupancy anchor in one round trip)
+                        p = p.enforce_overflow_policy(
+                            factory=factory, obs=self.obs)
+                        with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
+                            d = self._new_ckpt_dir(i)
+                            save_pipeline(p, d)
+                            self._save_config_sidecar(d, p.config)
+                            self._commit_ckpt(d)
+                        self._count(_obs.RESILIENCE_CHECKPOINTS)
+                        self.restarts = 0          # progress made
+                return [results[k] for k in range(n_intervals)]
+            except Exception as e:            # noqa: BLE001 — supervised edge
+                self._backoff(e)
+                p = self._pipeline_start(factory)
+
+    def _pipeline_start(self, factory: Callable):
+        from ..utils.checkpoint import restore_pipeline
+
+        ckpt = self._current_ckpt()
+        p = factory(config=self._load_config_sidecar(ckpt))
+        if self.obs is not None and hasattr(p, "set_observability"):
+            p.set_observability(self.obs)
+        if ckpt is not None:
+            with self._span(_obs.RESILIENCE_RESTORE_SPAN):
+                restore_pipeline(p, ckpt)
+        return p
+
+    # -- operator + source mode --------------------------------------------
+    def run_operator(self, make_operator: Callable, events: Sequence,
+                     fault: Optional[Callable[[int], None]] = None) -> list:
+        """Run a TpuWindowOperator over a replayable event log under
+        supervision.
+
+        ``make_operator(config=None)`` builds a fresh operator (a
+        non-None config overrides the engine config — after a GROW the
+        restart rebuilds at the checkpointed capacity). ``events`` is an
+        indexable sequence of ``(ELEMENTS, vals, ts)`` /
+        ``(WATERMARK, wm_ts)`` tuples — the source-offset replay
+        contract: after a crash the supervisor restores the last operator
+        snapshot and resumes from the checkpointed offset, so the
+        recovered run's emissions bit-match an uninterrupted run. Returns
+        one entry per WATERMARK event:
+        ``(starts, ends, counts, [per-agg values])`` as plain lists.
+        """
+        from ..utils.checkpoint import (restore_engine_operator,
+                                        save_engine_operator)
+
+        results: dict = {}
+        op, offset = self._operator_start(make_operator)
+        while True:
+            try:
+                idx = offset
+                while idx < len(events):
+                    ev = events[idx]
+                    if ev[0] == ELEMENTS:
+                        op.process_elements(ev[1], ev[2])
+                    elif ev[0] == WATERMARK:
+                        ws, we, cnt, low = op.process_watermark_arrays(
+                            int(ev[1]))
+                        results[idx] = (
+                            np.asarray(ws).tolist(), np.asarray(we).tolist(),
+                            np.asarray(cnt).tolist(),
+                            [np.asarray(lw).tolist() for lw in low])
+                    else:
+                        raise ValueError(f"unknown event kind {ev[0]!r}")
+                    idx += 1
+                    if fault is not None:
+                        fault(idx)
+                    if (idx % self.checkpoint_every == 0
+                            or idx == len(events)) and op._built:
+                        op.check_overflow()
+                        with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
+                            d = self._new_ckpt_dir(idx)
+                            save_engine_operator(op, d)
+                            self._save_config_sidecar(d, op.config)
+                            with open(os.path.join(d, "offset.json"),
+                                      "w") as f:
+                                json.dump({"offset": idx}, f)
+                            self._commit_ckpt(d)
+                        self._count(_obs.RESILIENCE_CHECKPOINTS)
+                        offset = idx
+                        self.restarts = 0          # progress made
+                return [results[k] for k in sorted(results)]
+            except Exception as e:            # noqa: BLE001 — supervised edge
+                self._backoff(e)
+                op, offset = self._operator_start(make_operator)
+
+    def _operator_start(self, make_operator: Callable):
+        from ..utils.checkpoint import restore_engine_operator
+
+        ckpt = self._current_ckpt()
+        op = make_operator(config=self._load_config_sidecar(ckpt))
+        offset = 0
+        if ckpt is not None:
+            with self._span(_obs.RESILIENCE_RESTORE_SPAN):
+                restore_engine_operator(op, ckpt)
+            with open(os.path.join(ckpt, "offset.json")) as f:
+                offset = int(json.load(f)["offset"])
+        if self.obs is not None and op.obs is None:
+            op.set_observability(self.obs)
+        return op, offset

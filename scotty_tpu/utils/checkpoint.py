@@ -85,6 +85,12 @@ def _restore_meta(op, meta: dict) -> None:
         op._last_count = meta["last_count"]
         op._annex_dirty = meta["annex_dirty"]
         op._count_late_seen = meta.get("count_late_seen", False)
+    if getattr(op.config, "overflow_policy", "fail") != "fail":
+        # the SHED/GROW admission mirror must reflect the RESTORED device
+        # occupancy — a fresh operator's zeroed upper bounds would admit
+        # past capacity and die on the fatal overflow the policy exists
+        # to prevent (post-restart supervision). One deliberate sync.
+        op._pol_refresh()
 
 
 def save_engine_operator(op, path: str) -> None:
@@ -132,6 +138,14 @@ def restore_engine_operator(op, path: str) -> None:
             f"revision expects {len(template)} — snapshots from older "
             "revisions of a count-measure operator cannot be migrated "
             "(they lack the record buffer); re-run from source data")
+    for i, (l, t) in enumerate(zip(leaves, template)):
+        if np.asarray(l).shape != np.asarray(t).shape:
+            raise ValueError(
+                f"checkpoint leaf {i} has shape {np.asarray(l).shape}, "
+                f"this operator expects {np.asarray(t).shape} — construct "
+                "the operator with the same windows/aggregations/config "
+                "as saved (capacity shapes the state; after a GROW, "
+                "restore at the grown capacity)")
     cast = [np.asarray(l, dtype=np.asarray(t).dtype)
             for l, t in zip(leaves, template)]
     _set_full_state(op, _device_copy(jax.tree.unflatten(treedef, cast)))

@@ -1,0 +1,129 @@
+"""Checkpoint error paths the Supervisor depends on (ISSUE 3 satellite):
+seed mismatch, treedef/shape mismatch, and snapshot-before-build — each
+asserting the SPECIFIC ValueError message survives, since the Supervisor's
+recovery loop (and its operators) route users by these strings.
+
+Deliberately light: no pipeline ever runs an interval (reset() allocates
+state without tracing a fused step), so this module adds no JAX-tracing
+C-stack pressure to the tier-1 sweep (see test_checkpoint_pipelines.py's
+isolation note).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.operator import TpuWindowOperator
+from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+from scotty_tpu.utils.checkpoint import (
+    restore_engine_operator,
+    restore_keyed_operator,
+    restore_pipeline,
+    save_engine_operator,
+    save_keyed_operator,
+    save_pipeline,
+)
+
+Time, Count = WindowMeasure.Time, WindowMeasure.Count
+CFG = EngineConfig(capacity=1 << 8, batch_size=64, annex_capacity=32,
+                   min_trigger_pad=32)
+
+
+def make_pipeline(seed=5, capacity=1 << 8):
+    import dataclasses
+
+    return AlignedStreamPipeline(
+        [TumblingWindow(Time, 50)], [SumAggregation()],
+        config=dataclasses.replace(CFG, capacity=capacity),
+        throughput=20_000, wm_period_ms=100, max_lateness=100, seed=seed,
+        gc_every=10 ** 9)
+
+
+def make_op(count=False):
+    op = TpuWindowOperator(config=CFG)
+    op.add_window_assigner(TumblingWindow(Count if count else Time,
+                                          7 if count else 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(100)
+    return op
+
+
+def test_save_pipeline_before_start_names_the_problem(tmp_path):
+    with pytest.raises(ValueError, match="pipeline not started"):
+        save_pipeline(make_pipeline(), str(tmp_path / "x"))
+
+
+def test_restore_pipeline_seed_mismatch_message_survives(tmp_path):
+    p = make_pipeline(seed=5)
+    p.reset()                               # allocates state; no tracing
+    save_pipeline(p, str(tmp_path / "x"))
+    with pytest.raises(ValueError, match="seed mismatch: the restored "
+                                         "stream would differ"):
+        restore_pipeline(make_pipeline(seed=6), str(tmp_path / "x"))
+
+
+def test_restore_pipeline_wrong_class_message_survives(tmp_path):
+    p = make_pipeline()
+    p.reset()
+    save_pipeline(p, str(tmp_path / "x"))
+    meta_path = os.path.join(str(tmp_path / "x"), "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["cls"] = "StreamPipeline"
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError,
+                       match="StreamPipeline checkpoint, not "
+                             "AlignedStreamPipeline"):
+        restore_pipeline(make_pipeline(), str(tmp_path / "x"))
+
+
+def test_restore_pipeline_shape_mismatch_message_survives(tmp_path):
+    p = make_pipeline(capacity=1 << 8)
+    p.reset()
+    save_pipeline(p, str(tmp_path / "x"))
+    with pytest.raises(ValueError, match="same configuration as saved"):
+        restore_pipeline(make_pipeline(capacity=1 << 9),
+                         str(tmp_path / "x"))
+
+
+def test_save_engine_operator_before_build_names_the_problem(tmp_path):
+    with pytest.raises(ValueError, match="not built yet"):
+        save_engine_operator(make_op(), str(tmp_path / "op"))
+
+
+def test_restore_engine_operator_treedef_mismatch_message_survives(tmp_path):
+    op_count = make_op(count=True)          # leaves include the record buffer
+    op_count.process_elements(np.ones(4, np.float32),
+                              np.arange(4, dtype=np.int64))
+    save_engine_operator(op_count, str(tmp_path / "op"))
+    with pytest.raises(ValueError, match="cannot be migrated"):
+        restore_engine_operator(make_op(count=False), str(tmp_path / "op"))
+
+
+def test_restore_keyed_rejects_non_keyed_snapshot(tmp_path):
+    from scotty_tpu.parallel.keyed import KeyedTpuWindowOperator
+
+    op = make_op()
+    op.process_elements(np.ones(4, np.float32),
+                        np.arange(4, dtype=np.int64))
+    save_engine_operator(op, str(tmp_path / "op"))
+    kop = KeyedTpuWindowOperator(4, config=CFG)
+    kop.add_window_assigner(TumblingWindow(Time, 10))
+    kop.add_aggregation(SumAggregation())
+    with pytest.raises(ValueError, match="not a matching keyed checkpoint"):
+        restore_keyed_operator(kop, str(tmp_path / "op"))
+
+
+def test_save_keyed_before_build_names_the_problem(tmp_path):
+    from scotty_tpu.parallel.keyed import KeyedTpuWindowOperator
+
+    kop = KeyedTpuWindowOperator(4, config=CFG)
+    kop.add_window_assigner(TumblingWindow(Time, 10))
+    kop.add_aggregation(SumAggregation())
+    with pytest.raises(ValueError, match="not built yet"):
+        save_keyed_operator(kop, str(tmp_path / "k"))

@@ -1,0 +1,244 @@
+"""Connector hardening (ISSUE 3): poison records/dead-letter, retrying
+sources, stall watchdogs, queue-depth sampling, connector snapshots —
+all chaos driven and deterministic (seeded injectors, ManualClock).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.connectors.base import (
+    AscendingWatermarks,
+    KeyedScottyWindowOperator,
+)
+from scotty_tpu.connectors.kafka import KafkaScottyWindowOperator
+from scotty_tpu.obs import Observability
+from scotty_tpu.resilience import (
+    FlakySource,
+    ManualClock,
+    PoisonLimitExceeded,
+    SourceExhaustedRetries,
+    SourceStalled,
+    StallingSource,
+    backoff_delay,
+    corrupt_records,
+    make_records,
+    retrying_source,
+    watchdog_source,
+)
+
+Time = WindowMeasure.Time
+
+
+def keyed_op(obs=None):
+    return KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 100)],
+        aggregations=[SumAggregation()],
+        watermark_policy=AscendingWatermarks(), obs=obs)
+
+
+# -- kafka poison path (ISSUE 3 satellite: malformed-record regression) ----
+
+def test_kafka_malformed_record_no_longer_kills_run():
+    """Seed bug: a payload that is neither JSON nor numeric raised an
+    uncaught ValueError out of _default_deserialize and killed run().
+    It now routes through the poison/dead-letter path and the stream
+    keeps flowing."""
+    records, bad_idx = corrupt_records(make_records(seed=7, n=60), seed=8,
+                                       pct=0.1)
+    obs = Observability()
+    adapter = KafkaScottyWindowOperator(operator=keyed_op(obs=obs))
+    letters = []
+    results = []
+    n = adapter.run(records, results.append,
+                    dead_letter=lambda rec, exc: letters.append((rec, exc)))
+    assert n == 60                          # every record consumed
+    assert len(letters) == len(bad_idx)
+    assert all(isinstance(e, Exception) for _, e in letters)
+    assert {id(r) for r, _ in letters} == {id(records[i]) for i in bad_idx}
+    assert obs.registry.snapshot()["resilience_poison_records"] == len(bad_idx)
+    assert results                          # clean records still windowed
+
+
+def test_kafka_malformed_record_without_dead_letter_still_flows():
+    records, bad_idx = corrupt_records(make_records(seed=7, n=40), seed=9,
+                                       pct=0.1)
+    adapter = KafkaScottyWindowOperator(operator=keyed_op())
+    assert adapter.run(records, lambda item: None) == 40
+
+
+def test_kafka_poison_limit():
+    records, _ = corrupt_records(make_records(seed=7, n=30), seed=10, pct=0.5)
+    adapter = KafkaScottyWindowOperator(operator=keyed_op())
+    with pytest.raises(PoisonLimitExceeded):
+        adapter.run(records, lambda item: None, poison_limit=3)
+
+
+def test_iterable_poison_records_are_dead_lettered():
+    from scotty_tpu.connectors.iterable import run_keyed
+
+    good = [("a", 1.0, t * 10) for t in range(20)]
+    src = good[:5] + [("a", 1.0), None, ("a", 1.0, "NaN-ish")] + good[5:]
+    letters = []
+    out = list(run_keyed(src, keyed_op(),
+                         dead_letter=lambda r, e: letters.append(r)))
+    assert len(letters) == 3
+    assert out                              # stream survived the poison
+
+
+# -- retrying source -------------------------------------------------------
+
+def test_retrying_source_resumes_from_last_good_offset():
+    records = list(range(20))
+    flaky = FlakySource(records, fail_at={5, 11})
+    obs = Observability()
+    clock = ManualClock()
+    got = list(retrying_source(flaky, max_retries=3, clock=clock, obs=obs,
+                               seed=2))
+    assert got == records                   # nothing lost, nothing doubled
+    assert flaky.failures == [5, 11]
+    assert obs.registry.snapshot()["resilience_source_retries"] == 2
+    # each failure had made progress since the last → attempt resets to 1
+    rng = np.random.default_rng(2)
+    assert clock.sleeps == [
+        pytest.approx(backoff_delay(1, 0.05, 2.0, 0.5, rng)),
+        pytest.approx(backoff_delay(1, 0.05, 2.0, 0.5, rng))]
+
+
+def test_retrying_source_exhausts_on_persistent_failure():
+    def dead_source(offset):
+        raise ConnectionError("down")
+        yield                               # pragma: no cover
+
+    with pytest.raises(SourceExhaustedRetries) as ei:
+        list(retrying_source(dead_source, max_retries=2,
+                             clock=ManualClock()))
+    assert isinstance(ei.value.__cause__, ConnectionError)
+
+
+# -- stall watchdog --------------------------------------------------------
+
+def test_watchdog_flags_exactly_the_injected_stalls():
+    clock = ManualClock()
+    src = StallingSource(list(range(30)), stall_at={7, 19}, stall_s=5.0,
+                         clock=clock)
+    obs = Observability()
+    gaps = []
+    got = list(watchdog_source(src, stall_timeout_s=1.0, clock=clock,
+                               obs=obs, on_stall=gaps.append))
+    assert got == list(range(30))
+    assert obs.registry.snapshot()["resilience_stall_events"] == 2
+    assert [pytest.approx(g) for g in gaps] == [5.0, 5.0]
+
+
+def test_watchdog_ignores_slow_consumer():
+    """The stall window measures only the SOURCE pull — a consumer that
+    spends longer than the stall budget processing each record must not
+    be misreported as a producer stall."""
+    clock = ManualClock()
+    obs = Observability()
+    wd = watchdog_source(iter(range(10)), stall_timeout_s=1.0, clock=clock,
+                         obs=obs)
+    got = []
+    for item in wd:
+        got.append(item)
+        clock.advance(10.0)                 # heavy per-record processing
+    assert got == list(range(10))
+    assert "resilience_stall_events" not in obs.registry.snapshot()
+
+
+def test_corrupt_records_pct_zero_is_a_clean_control_arm():
+    records, idx = corrupt_records(make_records(seed=1, n=10), seed=2,
+                                   pct=0.0)
+    assert idx == []
+    records, idx = corrupt_records(make_records(seed=1, n=10), seed=2,
+                                   pct=0.01)
+    assert len(idx) == 1                    # positive pct floors at one
+
+
+def test_kafka_run_with_watchdog():
+    clock = ManualClock()
+    records = make_records(seed=3, n=20)
+    src = StallingSource(records, stall_at={10}, stall_s=9.0, clock=clock)
+    obs = Observability()
+    adapter = KafkaScottyWindowOperator(operator=keyed_op(obs=obs))
+    adapter.run(src, lambda item: None, stall_timeout_s=2.0, clock=clock)
+    assert obs.registry.snapshot()["resilience_stall_events"] == 1
+
+
+# -- asyncio queue source (ISSUE 3 satellite: depth gauge + stalls) --------
+
+def test_queue_source_samples_depth_after_get_and_throttled():
+    from scotty_tpu.connectors.asyncio_connector import queue_source
+
+    async def main():
+        obs = Observability()
+        q = asyncio.Queue()
+        for i in range(40):
+            q.put_nowait(("k", 1.0, i * 10))
+        q.put_nowait(None)                  # sentinel
+        seen = 0
+        async for _ in queue_source(q, obs=obs, depth_sample_every=8):
+            seen += 1
+        return seen, obs.registry.snapshot()["queue_depth"]
+
+    seen, depth = asyncio.run(main())
+    assert seen == 40
+    # sampled AFTER the final (sentinel) get: an idle consumer reports the
+    # drained queue, not the stale pre-wait depth (seed bug)
+    assert depth == 0
+
+
+def test_queue_source_stall_watchdog_preempts():
+    from scotty_tpu.connectors.asyncio_connector import queue_source
+
+    async def main():
+        obs = Observability()
+        q = asyncio.Queue()                 # never fed: a stalled producer
+        stalls = []
+        with pytest.raises(SourceStalled):
+            async for _ in queue_source(q, obs=obs, stall_timeout_s=0.01,
+                                        on_stall=stalls.append,
+                                        max_stalls=2):
+                pass                        # pragma: no cover
+        return stalls, obs.registry.snapshot()["resilience_stall_events"]
+
+    stalls, n = asyncio.run(main())
+    assert len(stalls) == 2 and n == 2
+
+
+# -- connector snapshot/restore --------------------------------------------
+
+def test_keyed_connector_save_restore_continues_identically(tmp_path):
+    stream = [(f"k{i % 3}", float(i % 7), i * 25) for i in range(80)]
+
+    def feed(op, items):
+        out = []
+        for k, v, t in items:
+            out.extend((kk, w.start, w.end, tuple(w.agg_values))
+                       for kk, w in op.process_element(k, v, t))
+        return out
+
+    ref = keyed_op()
+    ref_out = feed(ref, stream)
+
+    op1 = keyed_op()
+    head = feed(op1, stream[:40])
+    op1.save(str(tmp_path / "conn"))
+    op2 = keyed_op()
+    op2.restore(str(tmp_path / "conn"))
+    tail = feed(op2, stream[40:])
+    assert head + tail == ref_out
+
+
+def test_keyed_connector_restore_rejects_mismatched_lateness(tmp_path):
+    op = keyed_op()
+    op.process_element("a", 1.0, 10)
+    op.save(str(tmp_path / "conn"))
+    other = KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 100)],
+        aggregations=[SumAggregation()], allowed_lateness=77)
+    with pytest.raises(ValueError, match="allowed_lateness"):
+        other.restore(str(tmp_path / "conn"))

@@ -403,6 +403,27 @@ def test_obs_diff_fails_on_injected_throughput_regression(tmp_path):
     assert diff_main(str(pa), str(pb), echo=lambda s: None) == 1
 
 
+def test_obs_diff_gates_appearing_resilience_counters(tmp_path):
+    """ISSUE 3: the resilience counters are created lazily, so a clean
+    FAIL-policy baseline export has no key at all — a candidate that
+    STARTED shedding must still trip the default gate (the threshold
+    spec's ``default: 0`` covers the absent side)."""
+    from scotty_tpu.obs.diff import diff_main
+
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    base = _cells(1e9)
+    cand = json.loads(json.dumps(base))
+    cand[0]["metrics"] = {"resilience_shed_tuples": 10_000}
+    pa.write_text(json.dumps(base))
+    pb.write_text(json.dumps(cand))
+    assert diff_main(str(pa), str(pb), echo=lambda s: None) == 1
+    # and the reverse (counter vanishing) is not a regression
+    pa.write_text(json.dumps(cand))
+    pb.write_text(json.dumps(base))
+    assert diff_main(str(pa), str(pb), echo=lambda s: None) == 0
+
+
 def test_obs_diff_cli_and_thresholds(tmp_path, capsys):
     """End-to-end through the module CLI with a custom threshold file,
     plus missing-cell detection."""

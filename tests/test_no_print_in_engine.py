@@ -1,5 +1,6 @@
 """Tier-1 lint: the engine core stays silent (ISSUE 1 satellite; extended
-to connectors/ and bench/ in ISSUE 2).
+to connectors/ and bench/ in ISSUE 2), and nothing sleeps on the wall
+clock outside the injectable-clock module (ISSUE 3 satellite).
 
 The reference's engine never logs — its only output was the benchmark-side
 throughput logger (SURVEY.md §5). The port preserves that discipline: all
@@ -9,6 +10,12 @@ metrics registry / overridable echo sinks (scotty_tpu.obs), never a bare
 ``print(`` — bench output in particular must stay capturable so the
 ``obs diff`` gate and tests can consume it. AST-based so strings/comments
 mentioning print don't trip it.
+
+The sleep lint covers ALL of ``scotty_tpu/``: every backoff/watchdog wait
+must go through :mod:`scotty_tpu.resilience.clock` (the one exempt
+module), so chaos tests can drive recovery deterministically with a
+ManualClock — a bare ``time.sleep`` anywhere would reintroduce
+wall-clock nondeterminism into the resilience paths.
 """
 
 import ast
@@ -18,6 +25,8 @@ import scotty_tpu
 
 PKG_ROOT = pathlib.Path(scotty_tpu.__file__).parent
 SILENT_DIRS = ("engine", "core", "connectors", "bench")
+#: the single module allowed to call time.sleep (SystemClock lives there)
+SLEEP_EXEMPT = PKG_ROOT / "resilience" / "clock.py"
 
 
 def _print_calls(path: pathlib.Path):
@@ -37,4 +46,37 @@ def test_engine_core_have_no_bare_print():
     assert not offenders, (
         "bare print( in the silent engine core — route output through "
         "the scotty_tpu.obs registry/sinks instead: "
+        + ", ".join(offenders))
+
+
+def _sleep_calls(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # time.sleep(...)
+        if (isinstance(f, ast.Attribute) and f.attr == "sleep"
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"):
+            yield f"{path}:{node.lineno}"
+        # from time import sleep; sleep(...)
+        elif isinstance(f, ast.Name) and f.id == "sleep":
+            yield f"{path}:{node.lineno}"
+
+
+def test_no_bare_time_sleep():
+    """All waits go through the injectable clock
+    (scotty_tpu.resilience.clock) so backoff/watchdog logic stays
+    deterministic under chaos tests; ``asyncio.sleep``/``Clock.sleep``
+    calls are fine — only the wall-clock ``time.sleep`` (and a bare
+    imported ``sleep``) are rejected, everywhere but clock.py itself."""
+    offenders = []
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        if path == SLEEP_EXEMPT:
+            continue
+        offenders.extend(_sleep_calls(path))
+    assert not offenders, (
+        "bare time.sleep in scotty_tpu — route waits through "
+        "scotty_tpu.resilience.clock (injectable Clock): "
         + ", ".join(offenders))

@@ -1,0 +1,316 @@
+"""Chaos differential suite — overflow policies (ISSUE 3 tentpole).
+
+Seeded burst overload (resilience.chaos) against a deliberately tiny
+engine, asserted against the host simulator oracle:
+
+* ``FAIL`` (default) raises exactly as the seed did, now counting the
+  ``overflows`` metric on BOTH raise paths (buffer overflow + the session
+  emission-buffer exceed — the ISSUE 3 satellite).
+* ``SHED`` completes; the shed counts match exactly and the engine's
+  results equal an oracle replay of precisely the surviving tuples.
+* ``GROW`` completes with results bit-identical to a run pre-sized at the
+  grown capacity — for the host-fed operator AND a fused pipeline grown
+  mid-stream through the checkpoint pytree machinery.
+
+All chaos is a pure function of its seed: CPU-deterministic, tier-1 speed.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    SessionWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.operator import TpuWindowOperator, UnsupportedOnDevice
+from scotty_tpu.obs import Observability
+from scotty_tpu.resilience import burst, grow_engine_config
+from scotty_tpu.simulator import SlicingWindowOperator
+
+Time, Count = WindowMeasure.Time, WindowMeasure.Count
+
+#: burst: 512 tuples over [0, 5000) ms on a 10 ms tumbling grid → ~500
+#: slices against capacity 32 — hard overload. Values are small integers
+#: (exact in float32), so sums are association-independent and results
+#: compare bit-for-bit across capacities and against the oracle.
+BURST_VALS, BURST_TS = burst(seed=0, n=512, t0=0, t1=5000)
+WM = 5000
+
+
+def make_op(policy="fail", capacity=32, max_capacity=0, obs=None):
+    op = TpuWindowOperator(
+        config=EngineConfig(capacity=capacity, batch_size=64,
+                            annex_capacity=8, min_trigger_pad=32,
+                            overflow_policy=policy,
+                            max_capacity=max_capacity),
+        obs=obs)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(10_000)
+    return op
+
+
+def run_burst(op):
+    op.process_elements(BURST_VALS, BURST_TS)
+    ws, we, cnt, low = op.process_watermark_arrays(WM)
+    op.check_overflow()
+    return [(int(a), int(b), float(v)) for a, b, c, v in
+            zip(ws, we, cnt, low[0]) if c > 0]
+
+
+def oracle_rows(vals, ts):
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(TumblingWindow(Time, 10))
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(10_000)
+    for v, t in zip(vals, ts):
+        sim.process_element(float(v), int(t))
+    return [(w.start, w.end, float(w.agg_values[0]))
+            for w in sim.process_watermark(WM) if w.has_value()]
+
+
+def test_fail_policy_raises_exactly_as_before_and_counts_overflow():
+    obs = Observability()
+    op = make_op("fail", obs=obs)
+    op.process_elements(BURST_VALS, BURST_TS)
+    with pytest.raises(RuntimeError, match="slice/session buffer overflow"):
+        op.process_watermark_arrays(WM)
+    assert obs.registry.snapshot()["overflows"] == 1
+
+
+def test_session_emission_buffer_exceed_counts_overflow():
+    """The second raise path (operator.py _fetch_sessions): exceeding the
+    session emission buffer must increment ``overflows`` and name the
+    actionable knobs. The buffer bound is host-checked against
+    ``_emit_cap``, which is lowered after build to hit the path without
+    sweeping >1024 sessions through a tier-1 test."""
+    obs = Observability()
+    op = TpuWindowOperator(
+        config=EngineConfig(capacity=256, batch_size=64, annex_capacity=16,
+                            min_trigger_pad=32), obs=obs)
+    op.add_window_assigner(SessionWindow(Time, 5))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(1000)
+    ts = np.arange(8, dtype=np.int64) * 20          # 8 gap-separated sessions
+    op.process_elements(np.ones(8, np.float32), ts)
+    op._flush()
+    assert op._built
+    op._emit_cap = 2
+    with pytest.raises(RuntimeError, match="emission buffer"):
+        op.process_watermark_arrays(1000)
+    assert obs.registry.snapshot()["overflows"] == 1
+
+
+def test_shed_completes_and_matches_surviving_tuple_oracle_replay():
+    obs = Observability()
+    op = make_op("shed", obs=obs)
+    shed = []
+    op.shed_callback = lambda v, t: shed.append((v.copy(), t.copy()))
+    rows = run_burst(op)
+
+    n_shed = sum(v.shape[0] for v, _ in shed)
+    assert n_shed > 0
+    snap = obs.registry.snapshot()
+    assert snap["resilience_shed_tuples"] == n_shed
+    assert "overflows" not in snap or snap["overflows"] == 0
+    # exact in-jit auditability: drops ride DeviceMetrics too
+    assert op.device_metrics()["device_dropped_tuples"] == n_shed
+
+    # survivors = offered multiset minus the shed multiset, in offer order
+    budget: dict = {}
+    for v, t in shed:
+        for vv, tt in zip(v, t):
+            k = (float(vv), int(tt))
+            budget[k] = budget.get(k, 0) + 1
+    surv_v, surv_t = [], []
+    for vv, tt in zip(BURST_VALS, BURST_TS):
+        k = (float(vv), int(tt))
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            continue
+        surv_v.append(vv)
+        surv_t.append(tt)
+    assert len(surv_v) + n_shed == BURST_VALS.shape[0]
+    assert rows == oracle_rows(surv_v, surv_t)
+
+
+def test_shed_is_deterministic():
+    def one():
+        op = make_op("shed")
+        shed = []
+        op.shed_callback = lambda v, t: shed.append((v.tolist(), t.tolist()))
+        rows = run_burst(op)
+        return rows, shed
+
+    assert one() == one()
+
+
+def test_grow_completes_bit_identical_to_presized_run():
+    obs = Observability()
+    op = make_op("grow", max_capacity=4096, obs=obs)
+    rows = run_burst(op)
+
+    snap = obs.registry.snapshot()
+    assert snap["resilience_grow_events"] >= 1
+    assert op.config.capacity > 32
+
+    ref = TpuWindowOperator(config=EngineConfig(
+        capacity=op.config.capacity, batch_size=64,
+        annex_capacity=op.config.annex_capacity, min_trigger_pad=32))
+    ref.add_window_assigner(TumblingWindow(Time, 10))
+    ref.add_aggregation(SumAggregation())
+    ref.set_max_lateness(10_000)
+    assert rows == run_burst(ref)
+    # nothing was dropped on the way
+    assert "resilience_shed_tuples" not in snap
+
+
+def test_grow_respects_max_capacity():
+    op = make_op("grow", max_capacity=64)      # one doubling only
+    with pytest.raises(RuntimeError, match="max_capacity"):
+        run_burst(op)
+
+
+def test_grow_preserves_mid_stream_watermark_state():
+    """Growth between two watermarks must carry the host clock mirrors:
+    the second watermark's trigger range continues from the first."""
+    op = make_op("grow", max_capacity=4096)
+    half = BURST_TS.shape[0] // 2
+    op.process_elements(BURST_VALS[:half], BURST_TS[:half])
+    ws1, we1, cnt1, low1 = op.process_watermark_arrays(2500)
+    op.process_elements(BURST_VALS[half:], BURST_TS[half:])
+    ws2, we2, cnt2, low2 = op.process_watermark_arrays(WM)
+    op.check_overflow()
+
+    ref = make_op("fail", capacity=4096)
+    ref.process_elements(BURST_VALS[:half], BURST_TS[:half])
+    r1 = ref.process_watermark_arrays(2500)
+    ref.process_elements(BURST_VALS[half:], BURST_TS[half:])
+    r2 = ref.process_watermark_arrays(WM)
+    assert np.array_equal(ws1, r1[0]) and np.array_equal(cnt1, r1[2])
+    assert np.array_equal(ws2, r2[0]) and np.array_equal(cnt2, r2[2])
+    assert all(np.array_equal(a, b) for a, b in zip(low1, r1[3]))
+    assert all(np.array_equal(a, b) for a, b in zip(low2, r2[3]))
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="unknown overflow_policy"):
+        EngineConfig(overflow_policy="bogus")
+    # unsupported workload classes reject policies explicitly at build
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=256, batch_size=64, min_trigger_pad=32,
+        overflow_policy="shed"))
+    op.add_window_assigner(TumblingWindow(Count, 7))
+    op.add_aggregation(SumAggregation())
+    with pytest.raises(UnsupportedOnDevice, match="overflow_policy"):
+        op.process_elements(np.ones(4, np.float32),
+                            np.arange(4, dtype=np.int64))
+
+
+def test_grow_engine_config_doubles_and_bounds():
+    cfg = EngineConfig(capacity=32, annex_capacity=8, max_capacity=128)
+    g = grow_engine_config(cfg)
+    assert g.capacity == 64 and g.annex_capacity == 16
+    g2 = grow_engine_config(g)
+    assert g2.capacity == 128
+    with pytest.raises(RuntimeError, match="max_capacity"):
+        grow_engine_config(g2)
+
+
+def test_grow_default_bound_anchors_to_original_capacity():
+    """max_capacity=0 means 8× the ORIGINAL capacity — the implicit bound
+    must not drift upward with each doubling (that would grow forever
+    under sustained overload, the OOM spiral the bound exists to stop)."""
+    cfg = EngineConfig(capacity=32, annex_capacity=8)     # bound = 256
+    for expect in (64, 128, 256):
+        cfg = grow_engine_config(cfg)
+        assert cfg.capacity == expect
+    with pytest.raises(RuntimeError, match="max_capacity=256"):
+        grow_engine_config(cfg)
+
+
+def test_restore_refreshes_shed_admission_mirror(tmp_path):
+    """Supervisor-restart path: a restored operator's admission mirror
+    must reflect the checkpointed device occupancy — a zeroed mirror
+    would admit past capacity and die on the fatal overflow SHED exists
+    to prevent."""
+    from scotty_tpu.utils.checkpoint import (restore_engine_operator,
+                                             save_engine_operator)
+
+    op = make_op("shed", capacity=32)
+    shed0 = []
+    op.shed_callback = lambda v, t: shed0.append(t)
+    # ~25 distinct 10ms grid slices, under capacity: nothing shed yet
+    ts1 = np.arange(25, dtype=np.int64) * 10
+    op.process_elements(np.ones(25, np.float32), ts1)
+    op._flush()
+    assert not shed0
+    save_engine_operator(op, str(tmp_path / "op"))
+
+    op2 = make_op("shed", capacity=32)
+    restore_engine_operator(op2, str(tmp_path / "op"))
+    shed = []
+    op2.shed_callback = lambda v, t: shed.append(t)
+    ts2 = 250 + np.arange(25, dtype=np.int64) * 10      # 25 MORE new slices
+    op2.process_elements(np.ones(25, np.float32), ts2)
+    op2.process_watermark_arrays(1000)
+    op2.check_overflow()                                # no fatal overflow
+    assert shed                                         # mirror was live
+
+
+def test_pipeline_grow_bit_identical_to_presized(tmp_path):
+    """GROW on a fused pipeline: enforce_overflow_policy at the drain
+    points doubles capacity through the checkpoint pytree machinery
+    BEFORE the overflow flag can rise; the full interval stream is
+    bit-identical to a run pre-sized at the final capacity."""
+    import dataclasses
+
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    def make(config):
+        return AlignedStreamPipeline(
+            [TumblingWindow(Time, 50)], [SumAggregation()], config=config,
+            throughput=20_000, wm_period_ms=100, max_lateness=100, seed=5,
+            gc_every=10 ** 9, value_scale=1024.0)
+
+    cfg = EngineConfig(capacity=64, batch_size=256, annex_capacity=8,
+                       min_trigger_pad=32, overflow_policy="grow",
+                       max_capacity=1024)
+    obs = Observability()
+    p = make(cfg)
+    p.set_observability(obs)
+    N = 40                                  # 80 slices offered vs capacity 64
+    rows = []
+    for _ in range(N // 4):
+        rows.extend(p.lowered_results(o) for o in p.run(4))
+        p = p.enforce_overflow_policy(factory=make)
+    assert p.config.capacity > 64
+    assert obs.registry.snapshot()["resilience_grow_events"] >= 1
+
+    big = dataclasses.replace(cfg, capacity=p.config.capacity,
+                              annex_capacity=p.config.annex_capacity,
+                              overflow_policy="fail")
+    q = make(big)
+    rows_q = [q.lowered_results(o) for o in q.run(N)]
+    q.check_overflow()
+    assert rows == rows_q
+
+    # the same load under FAIL at the original capacity overflows —
+    # the exact seed behavior GROW is proven to prevent
+    pf = make(dataclasses.replace(cfg, overflow_policy="fail"))
+    pf.run(N)
+    with pytest.raises(RuntimeError, match="overflow"):
+        pf.check_overflow()
+
+
+def test_device_resident_ingest_rejects_policies():
+    op = make_op("shed", capacity=256)
+    import jax
+
+    ts = jax.numpy.arange(64, dtype=jax.numpy.int64)
+    vals = jax.numpy.ones((64,), jax.numpy.float32)
+    with pytest.raises(UnsupportedOnDevice, match="host-visible"):
+        op.ingest_device_batch(vals, ts, 0, 63)

@@ -1,0 +1,218 @@
+"""Supervised recovery (ISSUE 3): a mid-stream injected crash recovered
+by the Supervisor produces final windows bit-identical to an
+uninterrupted run — for a fused pipeline (stream = pure function of
+(seed, interval)) and for a TpuWindowOperator + replayable source
+(source-offset replay). Backoff is deterministic on a ManualClock with
+seeded jitter, and recovery events surface as ``resilience_*``
+counters/spans.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.operator import TpuWindowOperator
+from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+from scotty_tpu.obs import Observability
+from scotty_tpu.resilience import (
+    ELEMENTS,
+    WATERMARK,
+    ChaosError,
+    CrashInjector,
+    ManualClock,
+    Supervisor,
+    SupervisorGaveUp,
+    backoff_delay,
+    burst,
+)
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=1 << 12, batch_size=256, annex_capacity=256,
+                   min_trigger_pad=32)
+
+
+def pipeline_factory(config=None):
+    return AlignedStreamPipeline(
+        [TumblingWindow(Time, 50)], [SumAggregation()],
+        config=config or CFG, throughput=20_000, wm_period_ms=100,
+        max_lateness=100, seed=5, gc_every=10 ** 9, value_scale=1024.0)
+
+
+def test_pipeline_crash_recovery_bit_matches_uninterrupted(tmp_path):
+    obs = Observability()
+    clock = ManualClock()
+    sup = Supervisor(str(tmp_path / "ckpt"), clock=clock, obs=obs,
+                     checkpoint_every=2, max_restarts=2, seed=9)
+    crash = CrashInjector(at=5)            # mid-chunk: after 5 intervals,
+    rows = sup.run_pipeline(pipeline_factory, 8, fault=crash)
+    assert crash.fired == 5                # between checkpoints at 4 and 6
+
+    ref = pipeline_factory()
+    ref_rows = [ref.lowered_results(o) for o in ref.run(8)]
+    assert rows == ref_rows                # bit-identical tail AND head
+
+    snap = obs.registry.snapshot()
+    assert snap["resilience_restarts"] == 1
+    assert snap["resilience_checkpoints"] >= 4
+    # the backoff slept exactly the seeded schedule on the injected clock
+    expect = backoff_delay(1, sup.backoff_base_s, sup.backoff_max_s,
+                           sup.jitter, np.random.default_rng(9))
+    assert clock.sleeps == [pytest.approx(expect)]
+    summary = obs.spans.summary()
+    assert "resilience_checkpoint" in summary
+    assert "resilience_restore" in summary
+    assert "resilience_backoff" in summary
+
+
+def test_pipeline_supervisor_gives_up_after_bounded_restarts(tmp_path):
+    clock = ManualClock()
+    sup = Supervisor(str(tmp_path / "ckpt"), clock=clock,
+                     checkpoint_every=2, max_restarts=2, seed=1)
+
+    def always_crash(pos):
+        raise ChaosError("permanent failure")
+
+    with pytest.raises(SupervisorGaveUp, match="gave up after 2 restarts"):
+        sup.run_pipeline(pipeline_factory, 8, fault=always_crash)
+    assert len(clock.sleeps) == 2          # backoff per allowed restart
+    # bounded exponential: second delay drew from the same seeded rng
+    rng = np.random.default_rng(1)
+    assert clock.sleeps == [
+        pytest.approx(backoff_delay(1, sup.backoff_base_s,
+                                    sup.backoff_max_s, sup.jitter, rng)),
+        pytest.approx(backoff_delay(2, sup.backoff_base_s,
+                                    sup.backoff_max_s, sup.jitter, rng))]
+
+
+def make_operator(config=None):
+    op = TpuWindowOperator(config=config or EngineConfig(
+        capacity=1 << 10, batch_size=64, annex_capacity=32,
+        min_trigger_pad=32))
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(1000)
+    return op
+
+
+def make_events(n_batches=6, per=50):
+    vals, ts = burst(seed=3, n=n_batches * per, t0=0, t1=n_batches * 500)
+    events = []
+    for b in range(n_batches):
+        lo = b * per
+        events.append((ELEMENTS, vals[lo:lo + per], ts[lo:lo + per]))
+        events.append((WATERMARK, int(ts[lo + per - 1])))
+    events.append((WATERMARK, n_batches * 500 + 1000))
+    return events
+
+
+def test_operator_source_offset_replay_bit_matches(tmp_path):
+    events = make_events()
+    obs = Observability()
+    sup = Supervisor(str(tmp_path / "ckpt"), clock=ManualClock(), obs=obs,
+                     checkpoint_every=3, max_restarts=2, seed=4)
+    crash = CrashInjector(at=8)            # between checkpoints at 6 and 9
+    got = sup.run_operator(make_operator, events, fault=crash)
+    assert crash.fired == 8
+
+    ref_sup = Supervisor(str(tmp_path / "ref"), clock=ManualClock(),
+                         checkpoint_every=10 ** 9)
+    ref = ref_sup.run_operator(make_operator, events)
+    assert got == ref                      # bit-identical emissions
+    assert obs.registry.snapshot()["resilience_restarts"] == 1
+
+
+def test_supervisor_recovers_after_grow(tmp_path):
+    """A crash AFTER a GROW doubling must recover: the checkpoint was
+    saved from the grown pipeline, so the restart rebuilds at the
+    checkpointed (grown) capacity via the config sidecar — rebuilding at
+    the factory default would fail the restore leaf-shape check."""
+    small = EngineConfig(capacity=64, batch_size=256, annex_capacity=8,
+                         min_trigger_pad=32, overflow_policy="grow",
+                         max_capacity=1024)
+
+    def factory(config=None):
+        return AlignedStreamPipeline(
+            [TumblingWindow(Time, 50)], [SumAggregation()],
+            config=config or small, throughput=20_000, wm_period_ms=100,
+            max_lateness=100, seed=5, gc_every=10 ** 9, value_scale=1024.0)
+
+    N = 40                      # 2 slices/interval vs capacity 64 → grows
+    obs = Observability()
+    sup = Supervisor(str(tmp_path / "a"), clock=ManualClock(), obs=obs,
+                     checkpoint_every=4, max_restarts=2, seed=3)
+    crash = CrashInjector(at=34)           # well after growth (~interval 28)
+    rows = sup.run_pipeline(factory, N, fault=crash)
+    assert crash.fired == 34
+    assert obs.registry.snapshot()["resilience_grow_events"] >= 1
+
+    ref_sup = Supervisor(str(tmp_path / "b"), clock=ManualClock(),
+                         checkpoint_every=4, max_restarts=0, seed=3)
+    assert rows == ref_sup.run_pipeline(factory, N)
+
+
+def test_restart_budget_resets_on_progress(tmp_path):
+    """max_restarts bounds CONSECUTIVE failed recoveries, not the
+    lifetime total: two faults far apart, each recovered through a
+    checkpoint in between, complete under max_restarts=1."""
+    sup = Supervisor(str(tmp_path / "ckpt"), clock=ManualClock(),
+                     checkpoint_every=2, max_restarts=1, seed=6)
+    fired = []
+
+    def two_faults(pos):
+        if pos in (3, 7) and pos not in fired:
+            fired.append(pos)
+            raise ChaosError(f"transient at {pos}")
+
+    rows = sup.run_pipeline(pipeline_factory, 8, fault=two_faults)
+    assert fired == [3, 7]
+    assert sup.total_restarts == 2 and sup.restarts <= 1
+
+    ref = pipeline_factory()
+    assert rows == [ref.lowered_results(o) for o in ref.run(8)]
+
+
+def test_checkpoint_commit_is_atomic(tmp_path):
+    """A torn checkpoint write (crash between the state files and the
+    pointer flip) must be invisible: restarts restore the last COMMITTED
+    checkpoint — never new state paired with a stale offset (silent
+    double-ingestion) or grown state with a stale config."""
+    import os
+
+    events = make_events(n_batches=2)
+    d = str(tmp_path / "ckpt")
+    sup = Supervisor(d, clock=ManualClock(), checkpoint_every=2)
+    sup.run_operator(make_operator, events)
+    committed = sup._current_ckpt()
+    assert committed is not None
+    assert os.path.exists(os.path.join(committed, "offset.json"))
+
+    # torn write: a newer checkpoint directory full of garbage, pointer
+    # never flipped
+    torn = os.path.join(d, "ckpt-999")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "meta.json"), "w") as f:
+        f.write("{not json")
+
+    sup2 = Supervisor(d, clock=ManualClock(), checkpoint_every=2)
+    assert sup2._current_ckpt() == committed     # torn dir ignored
+    op, offset = sup2._operator_start(make_operator)
+    assert offset == len(events)                 # committed offset, intact
+
+
+def test_operator_supervisor_without_faults_is_transparent(tmp_path):
+    events = make_events(n_batches=3)
+    sup = Supervisor(str(tmp_path / "ckpt"), clock=ManualClock(),
+                     checkpoint_every=2)
+    got = sup.run_operator(make_operator, events)
+
+    op = make_operator()
+    plain = []
+    for ev in events:
+        if ev[0] == ELEMENTS:
+            op.process_elements(ev[1], ev[2])
+        else:
+            ws, we, cnt, low = op.process_watermark_arrays(int(ev[1]))
+            plain.append((ws.tolist(), we.tolist(), cnt.tolist(),
+                          [np.asarray(lw).tolist() for lw in low]))
+    assert got == plain
