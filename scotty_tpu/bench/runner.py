@@ -225,14 +225,22 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
 
 def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
              engine: str,
-             collect_metrics: bool = True) -> BenchResult:
+             collect_metrics: bool = True,
+             make_obs: Optional[callable] = None) -> BenchResult:
     """One (windowConfiguration × engine × aggFunction) cell. Unless
     ``collect_metrics=False``, a fresh per-cell
     :class:`scotty_tpu.obs.Observability` rides the run and its export is
-    embedded in the result (``metrics`` section)."""
+    embedded in the result (``metrics`` section). ``make_obs`` overrides
+    how that per-cell Observability is built (the runner's
+    ``--flight-capacity``/``--serve-port`` wiring passes a factory that
+    attaches a FlightRecorder and publishes the live instance to the
+    shared endpoint)."""
     windows = parse_window_spec(window_spec, seed=cfg.seed)
     engine = {"Slicing": "TpuEngine", "Flink": "Buckets"}.get(engine, engine)
-    obs = _obs.Observability() if collect_metrics else None
+    if not collect_metrics:
+        obs = None
+    else:
+        obs = make_obs() if make_obs is not None else _obs.Observability()
     if cfg.legacy_generator and (engine != "TpuEngine"
                                  or cfg.session_config):
         # the anchor cell must never silently substitute a different
@@ -761,12 +769,24 @@ def _run_keyed_rounds_cell(cfg: BenchmarkConfig, windows, window_spec: str,
 
 def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
                echo=None, collect_metrics: bool = True,
-               obs_dir: Optional[str] = None) -> List[dict]:
+               obs_dir: Optional[str] = None,
+               serve_port: Optional[int] = None,
+               flight_capacity: Optional[int] = None,
+               health_lag_ms: Optional[float] = None) -> List[dict]:
     """All cells of one config; writes result_<name>.json (each cell row
     carries a ``metrics`` section unless ``collect_metrics=False``). With
     ``obs_dir``, additionally exports a per-config JSONL time series (one
     snapshot row per cell — ``python -m scotty_tpu.obs report`` summarizes
-    it) and per-cell Chrome-trace span files."""
+    it) and per-cell Chrome-trace span files.
+
+    ``serve_port`` (ISSUE 4) starts ONE live ``/metrics``·``/vars``·
+    ``/healthz`` endpoint for the whole config run, always answering for
+    the currently-running cell's registry (503 before the first cell,
+    between cells, and after the last — the live reference is cleared as
+    each cell completes); ``flight_capacity`` attaches a FlightRecorder
+    of that many ring slots to every cell's Observability (wraparound
+    drops surface as the gated ``flight_dropped_events`` counter);
+    ``health_lag_ms`` arms the ``/healthz`` watermark-lag check."""
     if echo is None:
         echo = _stdout
     rows = []
@@ -783,13 +803,45 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
         # sibling JSONL must not accumulate stale rows across runs
         open(os.path.join(obs_dir, f"metrics_{cfg.name}.jsonl"),
              "w").close()
+    live = {"obs": None}                 # the endpoint reads the live cell
+
+    def make_obs():
+        flight = None
+        if flight_capacity:
+            flight = _obs.FlightRecorder(capacity=flight_capacity)
+        o = _obs.Observability(flight=flight)
+        live["obs"] = o
+        return o
+
+    server = None
+    if serve_port is not None and collect_metrics:
+        from ..obs.server import HealthPolicy, serve as _serve
+
+        health = HealthPolicy(max_watermark_lag_ms=health_lag_ms)
+        server = _serve(lambda: live["obs"], port=serve_port,
+                        health=health)
+        echo(f"  live obs endpoint: http://127.0.0.1:{server.port}"
+             "/metrics | /vars | /healthz (per running cell)")
+    try:
+        return _run_config_cells(cfg, out_dir, echo, collect_metrics,
+                                 obs_dir, make_obs, live, rows, cell_idx,
+                                 rtt_floor)
+    finally:
+        if server is not None:
+            server.close()
+
+
+def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
+                      make_obs, live, rows, cell_idx,
+                      rtt_floor) -> List[dict]:
     for window_spec in (cfg.window_configurations or ["Tumbling(1000)"]):
         for engine in cfg.configurations:
             for agg_name in cfg.agg_functions:
                 t0 = time.perf_counter()
                 try:
                     res = run_cell(cfg, window_spec, agg_name, engine,
-                                   collect_metrics=collect_metrics)
+                                   collect_metrics=collect_metrics,
+                                   make_obs=make_obs)
                 except Exception as e:        # one bad cell must not void
                     rows.append({              # the already-computed ones
                         "name": cfg.name, "windows": window_spec,
@@ -799,6 +851,10 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
                     echo(f"  {window_spec:28s} {engine:10s} {agg_name:8s} "
                          f"ERROR {type(e).__name__}: {e}")
                     continue
+                finally:
+                    # 503 between cells: a finished cell's frozen registry
+                    # must not masquerade as the live pipeline
+                    live["obs"] = None
                 cell = dict(res.to_dict(), engine=engine,
                             cell_wall_s=round(time.perf_counter() - t0, 2))
                 cell["rtt_floor_ms"] = rtt_floor
@@ -879,6 +935,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="override every config's EngineConfig."
                          "overflow_policy (scotty_tpu.resilience); "
                          "'fail' is the benchmarked default")
+    ap.add_argument("--serve-port", default=None, type=int, metavar="PORT",
+                    help="serve a live /metrics | /vars | /healthz "
+                         "endpoint for the currently-running cell "
+                         "(0 = ephemeral port, printed at startup); "
+                         "ignored with --no-obs")
+    ap.add_argument("--flight-capacity", default=None, type=int,
+                    metavar="N",
+                    help="attach an N-slot flight recorder "
+                         "(scotty_tpu.obs.FlightRecorder) to every "
+                         "cell's Observability; ring-wraparound drops "
+                         "surface as the gated flight_dropped_events "
+                         "counter")
+    ap.add_argument("--health-lag-ms", default=None, type=float,
+                    metavar="MS",
+                    help="arm the /healthz watermark-lag check "
+                         "(scotty_tpu.obs.HealthPolicy): verdicts flip "
+                         "unhealthy while watermark_lag_ms exceeds MS")
     args = ap.parse_args(argv)
 
     paths = args.configs
@@ -903,7 +976,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 os.close(fd)
                 shutil.copyfile(src, baseline_snap)
         run_config(cfg, out_dir=args.out_dir,
-                   collect_metrics=not args.no_obs, obs_dir=args.obs_dir)
+                   collect_metrics=not args.no_obs, obs_dir=args.obs_dir,
+                   serve_port=args.serve_port,
+                   flight_capacity=args.flight_capacity,
+                   health_lag_ms=args.health_lag_ms)
         if args.gate:
             if baseline_snap is None:
                 _stdout(f"  gate: no baseline for {cfg.name} — skipped "

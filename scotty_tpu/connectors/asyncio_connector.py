@@ -26,22 +26,41 @@ async def run_keyed_async(
         operator: KeyedScottyWindowOperator,
         emit: Callable[[Tuple], Optional[Awaitable]],
         obs=None,
+        serve_port: Optional[int] = None,
+        health=None,
 ) -> None:
     """Consume (key, value, ts) from an async iterator; call ``emit`` for
     every (key, AggregateWindow) result. ``emit`` may be sync or async.
     ``obs`` defaults to the operator's attached Observability (metrics are
-    then recorded by the operator itself — no double counting)."""
+    then recorded by the operator itself — no double counting).
+
+    ``serve_port`` (opt-in, ISSUE 4) serves ``/metrics``·``/vars``·
+    ``/healthz`` over the effective Observability for the duration of the
+    loop; ``0`` binds an ephemeral port, read back from
+    ``operator.obs_server.port`` while running. ``health`` is the
+    :class:`scotty_tpu.obs.HealthPolicy` behind ``/healthz``
+    (``HealthPolicy(max_watermark_lag_ms=...)`` arms the lag check)."""
     own_obs = obs if obs is not None and obs is not operator.obs else None
-    async for key, value, ts in source:
-        items = operator.process_element(key, value, int(ts))
-        if own_obs is not None:
-            own_obs.counter(_obs.INGEST_TUPLES).inc()
-            if items:
-                own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
-        for item in items:
-            r = emit(item)
-            if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
-                await r
+    eff_obs = obs if obs is not None else operator.obs
+    server = None
+    if serve_port is not None and eff_obs is not None:
+        server = eff_obs.serve(port=serve_port, health=health)
+        operator.obs_server = server
+    try:
+        async for key, value, ts in source:
+            items = operator.process_element(key, value, int(ts))
+            if own_obs is not None:
+                own_obs.counter(_obs.INGEST_TUPLES).inc()
+                if items:
+                    own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
+            for item in items:
+                r = emit(item)
+                if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
+                    await r
+    finally:
+        if server is not None:
+            server.close()
+            operator.obs_server = None
 
 
 async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None,
@@ -76,6 +95,8 @@ async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None,
                     stalls += 1
                     if obs is not None:
                         obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
+                        obs.flight_event("stall", "queue_source",
+                                         stalls * stall_timeout_s)
                     if on_stall is not None:
                         on_stall(stalls * stall_timeout_s)
                     if max_stalls is not None and stalls >= max_stalls:

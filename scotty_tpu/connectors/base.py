@@ -121,6 +121,9 @@ class KeyedScottyWindowOperator:
         self.n_key_shards = n_key_shards
         self.engine_config = engine_config
         self.obs = obs                      # scotty_tpu.obs.Observability
+        #: the live ObsServer while a run loop serves this operator
+        #: (asyncio run_keyed_async(..., serve_port=...)); None otherwise
+        self.obs_server = None
         self._host_ops: Dict[Hashable, Any] = {}
         self._key_lanes: Dict[Hashable, int] = {}
         self._lane_keys: List[Hashable] = []
@@ -255,6 +258,7 @@ class KeyedScottyWindowOperator:
                         out.append((key, w))
         if self.obs is not None:
             self.obs.counter(_obs.WATERMARKS).inc()
+            self.obs.flight_event("watermark", "watermark", float(wm))
             if out:
                 self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
         return out
@@ -326,6 +330,7 @@ class GlobalScottyWindowOperator:
                if w.has_value()]
         if self.obs is not None:
             self.obs.counter(_obs.WATERMARKS).inc()
+            self.obs.flight_event("watermark", "watermark", float(wm))
             if out:
                 self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
         return out

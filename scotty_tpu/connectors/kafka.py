@@ -58,13 +58,17 @@ class KafkaScottyWindowOperator:
             # a caller-supplied operator still gets the requested telemetry
             self.operator.obs = obs
         self.deserialize = deserialize
+        #: the live ObsServer while run(serve_port=...) is looping
+        self.obs_server = None
 
     def run(self, consumer: Iterable, on_result: Callable[[Tuple], None],
             max_records: Optional[int] = None,
             dead_letter: Optional[Callable] = None,
             poison_limit: Optional[int] = None,
             stall_timeout_s: Optional[float] = None,
-            clock=None) -> int:
+            clock=None,
+            serve_port: Optional[int] = None,
+            health=None) -> int:
         """``consumer``: any iterable of Kafka-like records (KafkaConsumer
         instances are iterables of ConsumerRecord). Returns records
         consumed (poison records count — they were consumed, then
@@ -73,6 +77,14 @@ class KafkaScottyWindowOperator:
         A record whose ``deserialize`` raises is handled per the module
         docstring instead of killing the loop; ``stall_timeout_s`` flags
         no-progress gaps on the (injectable) ``clock``.
+
+        ``serve_port`` (opt-in, ISSUE 4; needs an attached Observability)
+        serves ``/metrics``·``/vars``·``/healthz`` for the duration of
+        the loop — ``0`` binds an ephemeral port, read back from
+        ``self.obs_server.port`` while running. ``health`` is the
+        :class:`scotty_tpu.obs.HealthPolicy` behind ``/healthz`` (pass
+        ``HealthPolicy(max_watermark_lag_ms=...)`` to arm the
+        watermark-lag check; the default only watches stalls/overflows).
         """
         from ..resilience.connectors import PoisonHandler, watchdog_source
 
@@ -81,18 +93,28 @@ class KafkaScottyWindowOperator:
         if stall_timeout_s is not None:
             consumer = watchdog_source(consumer, stall_timeout_s,
                                        clock=clock, obs=self.operator.obs)
+        self.obs_server = None
+        if serve_port is not None and self.operator.obs is not None:
+            self.obs_server = self.operator.obs.serve(port=serve_port,
+                                                      health=health)
         n = 0
-        for record in consumer:
-            n += 1
-            try:
-                key, value, ts = self.deserialize(record)
-            except Exception as e:       # noqa: BLE001 — poison boundary
-                poison.handle(record, e)
-            else:
-                for item in self.operator.process_element(key, value, ts):
-                    on_result(item)
-            if max_records is not None and n >= max_records:
-                break
+        try:
+            for record in consumer:
+                n += 1
+                try:
+                    key, value, ts = self.deserialize(record)
+                except Exception as e:   # noqa: BLE001 — poison boundary
+                    poison.handle(record, e)
+                else:
+                    for item in self.operator.process_element(key, value,
+                                                              ts):
+                        on_result(item)
+                if max_records is not None and n >= max_records:
+                    break
+        finally:
+            if self.obs_server is not None:
+                self.obs_server.close()
+                self.obs_server = None
         return n
 
 

@@ -524,15 +524,18 @@ class CountStreamPipeline(FusedPipelineDriver):
         import jax
 
         if bool(jax.device_get(self.state.overflow)):
-            if self.obs is not None:
-                self.obs.counter(_obs.OVERFLOWS).inc()
-            raise RuntimeError(
+            e = RuntimeError(
                 "count row-window underrun: a trigger reached below the "
                 "retained per-ms rows — widen the retention model "
                 "(windows larger than expected?). Overflow policies do "
                 "not apply here: the ring is sized by the window spec, "
                 "not by load, so shedding/growing cannot repair a "
                 "mis-sized retention model")
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
+                self.obs.record_failure(e, kind="overflow",
+                                        config=self.config)
+            raise e
 
     # -- test/replay face --------------------------------------------------
     def materialize_interval(self, i: int):

@@ -1149,6 +1149,8 @@ class TpuWindowOperator(WindowOperator):
             if n_drop:
                 if self.obs is not None:
                     self.obs.counter(_obs.RESILIENCE_SHED_TUPLES).inc(n_drop)
+                    self.obs.flight_event("shed", _obs.RESILIENCE_SHED_TUPLES,
+                                          n_drop)
                 if self._dm_active:
                     self._dm_host_add(_dev.DEVICE_DROPPED_TUPLES, n_drop)
                 if self.shed_callback is not None:
@@ -1200,6 +1202,8 @@ class TpuWindowOperator(WindowOperator):
         self._pol_refresh()
         if self.obs is not None:
             self.obs.counter(_obs.RESILIENCE_GROW_EVENTS).inc()
+            self.obs.flight_event("grow", "capacity",
+                                  float(self.config.capacity))
 
     def _flush(self) -> None:
         while self._n_pending > 0:
@@ -1379,6 +1383,7 @@ class TpuWindowOperator(WindowOperator):
         obs.histogram(_obs.WATERMARK_DISPATCH_MS).observe(
             (time.perf_counter() - t0) * 1e3)
         obs.counter(_obs.WATERMARKS).inc()
+        obs.flight_event("watermark", "watermark", float(watermark_ts))
         if self._host_met is not None:
             # floored at 0: a drain watermark deliberately runs past the
             # stream end, and a last-value gauge stuck negative would make
@@ -1581,18 +1586,21 @@ class TpuWindowOperator(WindowOperator):
 
     def _raise_if_overflow(self, ovf) -> None:
         if bool(ovf):
-            if self.obs is not None:
-                self.obs.counter(_obs.OVERFLOWS).inc()
             note = "" if self.config.overflow_policy == "fail" else (
                 f" (overflow_policy={self.config.overflow_policy!r} could "
                 "not prevent it — the raised device flag means writes were "
                 "already clamped, which is unrecoverable under any policy)")
-            raise RuntimeError(
+            e = RuntimeError(
                 "slice/session buffer overflow: raise EngineConfig.capacity "
                 "(slice rows, session rows) / annex_capacity (late annex & "
                 "session orphan buffer) / batch sizing, advance watermarks "
                 "more often, or set EngineConfig.overflow_policy to "
                 "'shed'/'grow' (scotty_tpu.resilience)" + note)
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
+                self.obs.record_failure(e, kind="overflow",
+                                        config=self.config)
+            raise e
 
     def check_overflow(self) -> None:
         """One deliberate sync validating the run (async users call this
@@ -1623,6 +1631,9 @@ class TpuWindowOperator(WindowOperator):
 
             self._dm_folded = _dev.fold_into(
                 self.obs.registry, self.device_metrics(), self._dm_folded)
+            # and sample the flight ring (zero additional device syncs —
+            # the watermark advance itself was recorded at dispatch)
+            self.obs.flight_sample()
 
     def _fetch_sessions(self, outs):
         """Fetch per-session-window sweep outputs; emission follows window
@@ -1643,15 +1654,18 @@ class TpuWindowOperator(WindowOperator):
                 # the second overflow raise path (ISSUE 3 satellite):
                 # counted like the buffer-overflow path so dashboards and
                 # the obs diff gate see it, with an actionable hint
-                if self.obs is not None:
-                    self.obs.counter(_obs.OVERFLOWS).inc()
-                raise RuntimeError(
+                e = RuntimeError(
                     f"{m} sessions completed in one watermark exceeds the "
                     f"emission buffer ({self._emit_cap}); raise "
                     "EngineConfig.min_trigger_pad, advance watermarks more "
                     "often (fewer sessions complete per sweep), or run "
                     "under a scotty_tpu.resilience.Supervisor to restart "
                     "from the last checkpoint")
+                if self.obs is not None:
+                    self.obs.counter(_obs.OVERFLOWS).inc()
+                    self.obs.record_failure(e, kind="overflow",
+                                            config=self.config)
+                raise e
             ws_parts.append(ws_h[:m])
             we_parts.append(we_h[:m])
             cnt_parts.append(cnt_h[:m])

@@ -396,6 +396,11 @@ class FusedPipelineDriver:
             if obs is not None:
                 self._dm_folded = _dev.fold_into(obs.registry, snap,
                                                  self._dm_folded)
+        if obs is not None:
+            # flight-recorder sample rides the SAME drain (no extra device
+            # sync): the watermark this pipeline has advanced to plus the
+            # registry deltas since the last drain land in the ring
+            obs.flight_sync(watermark=self._interval * self.wm_period_ms)
         return v
 
     def enforce_overflow_policy(self, factory=None, obs=None):
@@ -608,10 +613,13 @@ class StreamPipeline(FusedPipelineDriver):
         import jax
 
         if bool(jax.device_get(self.state.overflow)):
+            e = RuntimeError("slice buffer overflow: raise capacity or "
+                             "advance watermarks more often")
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
-            raise RuntimeError("slice buffer overflow: raise capacity or "
-                               "advance watermarks more often")
+                self.obs.record_failure(e, kind="overflow",
+                                        config=self.config)
+            raise e
 
     def materialize_interval(self, i: int):
         """Regenerate interval i's tuple stream on host (testing), in
@@ -1366,10 +1374,13 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         import jax
 
         if bool(jax.device_get(self.state.overflow)):
+            e = RuntimeError("slice buffer overflow: raise capacity or "
+                             "gc more often")
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
-            raise RuntimeError("slice buffer overflow: raise capacity or "
-                               "gc more often")
+                self.obs.record_failure(e, kind="overflow",
+                                        config=self.config)
+            raise e
 
     def materialize_interval_late(self, i: int):
         """Regenerate interval i's LATE tuple stream on host (testing):

@@ -537,14 +537,17 @@ class SessionStreamPipeline(FusedPipelineDriver):
         if self.has_grid:
             flags.append(self.state.overflow)
         if any(bool(v) for v in jax.device_get(flags)):
-            if self.obs is not None:
-                self.obs.counter(_obs.OVERFLOWS).inc()
-            raise RuntimeError(
+            e = RuntimeError(
                 "slice/session buffer overflow: raise capacity. (GROW's "
                 "occupancy trigger watches the slice anchor only, so "
                 "session-row pressure on this pipeline cannot be "
                 "prevented by overflow_policy='grow'; a raised flag is "
                 "unrecoverable under any policy)")
+            if self.obs is not None:
+                self.obs.counter(_obs.OVERFLOWS).inc()
+                self.obs.record_failure(e, kind="overflow",
+                                        config=self.config)
+            raise e
 
     def materialize_interval(self, i: int):
         """Regenerate interval i's tuple stream on host (testing): returns

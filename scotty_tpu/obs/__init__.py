@@ -14,6 +14,12 @@ package replaces that split with a first-class subsystem:
   span dumps (:mod:`.exporters`).
 * ``python -m scotty_tpu.obs report <file>`` — summarize any export
   (:mod:`.report`).
+* the operational layer (ISSUE 4): an always-on :class:`.flight.
+  FlightRecorder` ring of recent engine events sampled at the existing
+  drain points, atomic crash bundles + ``python -m scotty_tpu.obs
+  postmortem`` triage (:mod:`.flight`, :mod:`.postmortem`), and a live
+  ``/metrics``·``/vars``·``/healthz`` endpoint
+  (``Observability.serve()``, :mod:`.server`).
 
 Host-side hooks record at batch/interval boundaries; the engine itself
 never prints (tier-1 enforces it). What happens INSIDE a fused interval is
@@ -61,10 +67,23 @@ connectors; spans ``resilience_checkpoint`` / ``resilience_restore`` /
 ``resilience_poison_records``   counter: records routed to dead-letter
 ``resilience_stall_events``     counter: no-progress watchdog detections
 ==============================  ==============================================
+
+Operations contract (ISSUE 4 — the flight recorder / live endpoint
+layer; :mod:`.flight`, :mod:`.server`, :mod:`.postmortem`):
+
+==========================  ==================================================
+``flight_dropped_events``   counter: flight-ring events lost to wraparound
+                            (folded at every drain-point sample — never
+                            silent; gated by the default ``obs diff``)
+``health_checks``           counter: ``/healthz`` verdicts computed
+``health_unhealthy``        counter: verdicts that came back unhealthy
+                            (gated by the default ``obs diff``)
+==========================  ==================================================
 """
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 from ..utils.metrics import MetricsRegistry
@@ -80,6 +99,8 @@ from .device import (
     init_device_metrics,
 )
 from .exporters import JsonlExporter, prometheus_text, write_chrome_trace
+from .flight import FLIGHT_DROPPED_EVENTS, FlightRecorder, write_postmortem
+from .server import HEALTH_CHECKS, HEALTH_UNHEALTHY, HealthPolicy
 from .spans import Span, SpanRecorder
 
 # stable metric names (the contract above)
@@ -114,6 +135,38 @@ RESILIENCE_RESTORE_SPAN = "resilience_restore"
 RESILIENCE_BACKOFF_SPAN = "resilience_backoff"
 RESILIENCE_GROW_SPAN = "resilience_grow"
 
+#: Prometheus HELP text for the contract metrics (``/metrics`` serves it;
+#: :func:`.exporters.prometheus_text` escapes it per the exposition format)
+METRIC_HELP = {
+    INGEST_TUPLES: "tuples accepted (operator or connector boundary)",
+    INGEST_BATCH_SIZE: "tuples per host batch",
+    LATE_TUPLES: "tuples arriving below the stream's max event time",
+    DROPPED_TUPLES: "tuples older than watermark - allowed lateness",
+    WATERMARKS: "watermark advances",
+    WATERMARK_LAG_MS: "max event time seen - watermark ts (floored at 0)",
+    WATERMARK_DISPATCH_MS: "host wall time of one watermark dispatch",
+    INTERVAL_STEP_MS: "host wall time of one fused interval step",
+    SYNC_MS: "host wall time of a pipeline drain/sync",
+    SLICE_OCCUPANCY: "live slices / capacity (recorded at sync points)",
+    SLICE_HEADROOM: "capacity - live slices",
+    QUEUE_DEPTH: "asyncio source queue depth",
+    WINDOWS_EMITTED: "non-empty windows delivered",
+    OVERFLOWS: "buffer-overflow events detected",
+    SILENT_INTERVALS: "session-pipeline intervals with no tuples",
+    EMIT_LATENCY_MS: "sampled dispatch->results-on-host time",
+    RESILIENCE_SHED_TUPLES: "tuples dropped by the SHED overflow policy",
+    RESILIENCE_GROW_EVENTS: "GROW capacity doublings",
+    RESILIENCE_CHECKPOINTS: "automatic supervisor checkpoints",
+    RESILIENCE_RESTARTS: "supervisor restarts after a failure",
+    RESILIENCE_SOURCE_RETRIES: "retrying-source reconnect attempts",
+    RESILIENCE_POISON_RECORDS: "records routed to dead-letter",
+    RESILIENCE_STALL_EVENTS: "no-progress watchdog detections",
+    FLIGHT_DROPPED_EVENTS:
+        "flight-recorder ring events lost to wraparound",
+    HEALTH_CHECKS: "/healthz verdicts computed",
+    HEALTH_UNHEALTHY: "/healthz verdicts that came back unhealthy",
+}
+
 
 class Observability:
     """One registry + span recorder, shared by every layer of a run.
@@ -121,17 +174,42 @@ class Observability:
     ``annotate=True`` additionally opens a ``jax.profiler.TraceAnnotation``
     per span, so the same phase names appear inside captured device traces
     (:func:`scotty_tpu.utils.profiling.trace`).
+
+    ``flight`` attaches a :class:`.flight.FlightRecorder`: spans then
+    also land open/close events in the ring, registry activity is sampled
+    into it at the drain points (:meth:`flight_sample` — zero extra
+    device syncs), and fatal paths flight-record before raising.
+    ``postmortem_dir`` arms :meth:`record_failure` to dump an atomic
+    crash bundle (``postmortem-<n>.json``) on those paths.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  spans: Optional[SpanRecorder] = None,
-                 annotate: bool = False):
+                 annotate: bool = False,
+                 flight: Optional[FlightRecorder] = None,
+                 postmortem_dir: Optional[str] = None):
         self.registry = registry or MetricsRegistry()
         self.spans = spans or SpanRecorder(annotate=annotate)
+        self.flight = flight
+        self.postmortem_dir = postmortem_dir
+        self._flight_prev: dict = {}
 
     # -- recording --------------------------------------------------------
     def span(self, name: str):
-        return self.spans.span(name)
+        if self.flight is None:
+            return self.spans.span(name)
+        return self._flight_span(name)
+
+    @contextlib.contextmanager
+    def _flight_span(self, name: str):
+        from . import flight as _flight
+
+        self.flight.record(_flight.SPAN_OPEN, name)
+        try:
+            with self.spans.span(name):
+                yield
+        finally:
+            self.flight.record(_flight.SPAN_CLOSE, name)
 
     def counter(self, name: str):
         return self.registry.counter(name)
@@ -141,6 +219,96 @@ class Observability:
 
     def histogram(self, name: str):
         return self.registry.histogram(name)
+
+    # -- flight recorder (ISSUE 4) ----------------------------------------
+    def flight_event(self, kind: str, name: str, value: float = 0.0
+                     ) -> None:
+        """Record one flight event (no-op without an attached recorder) —
+        the single call every wiring site uses, so a bare ``Observability``
+        stays exactly as cheap as before."""
+        if self.flight is not None:
+            self.flight.record(kind, name, value)
+
+    def flight_sample(self) -> None:
+        """Sample registry activity into the flight ring: one ``counter``
+        event per counter that moved since the last sample (value =
+        delta) and one ``gauge`` event per gauge that changed. Called at
+        the existing sync()/drain points only — the ring sees engine
+        state exactly where a device round trip already happens, adding
+        zero syncs. Also folds the ring's wraparound drop count into the
+        registry (``flight_dropped_events``) so it is never silent."""
+        fl = self.flight
+        if fl is None:
+            return
+        from . import flight as _flight
+
+        with self.registry._lock:
+            counters = {n: c.value
+                        for n, c in self.registry.counters.items()}
+            gauges = {n: g.value for n, g in self.registry.gauges.items()}
+        prev = self._flight_prev
+        for n, v in counters.items():
+            if n == FLIGHT_DROPPED_EVENTS:
+                continue               # the fold below, not a feedback loop
+            last = prev.get(n, 0.0)
+            if v != last:
+                fl.record(_flight.COUNTER, n, v - last)
+                prev[n] = v
+        for n, v in gauges.items():
+            key = "gauge:" + n
+            if prev.get(key) != v:
+                fl.record(_flight.GAUGE, n, v)
+                prev[key] = v
+        dropped = fl.dropped
+        last_d = prev.get("flight:dropped", 0)
+        if dropped > last_d:
+            self.registry.counter(FLIGHT_DROPPED_EVENTS).inc(
+                dropped - last_d)
+            prev["flight:dropped"] = dropped
+
+    def flight_sync(self, watermark: Optional[float] = None) -> None:
+        """The drain-point hook the engine calls from ``sync()`` /
+        ``check_overflow()``: records the watermark advance (when known)
+        and samples the registry. No-op without a recorder."""
+        if self.flight is None:
+            return
+        from . import flight as _flight
+
+        if watermark is not None:
+            self.flight.record(_flight.WATERMARK, "watermark",
+                               float(watermark))
+        self.flight_sample()
+
+    def record_failure(self, exc: BaseException, kind: str = "overflow",
+                       config=None, checkpoint: Optional[str] = None):
+        """Flight-record a fatal event and, when ``postmortem_dir`` is
+        set, dump an atomic postmortem bundle. Returns the bundle path
+        (or None). NEVER raises — this runs on crash paths where a
+        secondary failure would mask the real one."""
+        try:
+            if self.flight is not None:
+                self.flight.record(kind, type(exc).__name__)
+                self.flight_sample()
+            if self.postmortem_dir:
+                from .flight import write_postmortem as _write
+
+                return _write(self.postmortem_dir, exception=exc,
+                              obs=self, config=config,
+                              checkpoint=checkpoint)
+        except Exception:       # noqa: BLE001 — crash-path side channel
+            pass
+        return None
+
+    # -- live endpoint ----------------------------------------------------
+    def serve(self, port: int = 0, host: str = "127.0.0.1",
+              health: Optional[HealthPolicy] = None):
+        """Start the daemon-thread HTTP endpoint (``/metrics``, ``/vars``,
+        ``/healthz`` — :mod:`.server`) over this Observability; returns
+        the :class:`.server.ObsServer` (read ``.port`` back, ``close()``
+        when done)."""
+        from .server import serve as _serve
+
+        return _serve(self, port=port, host=host, health=health)
 
     # -- export -----------------------------------------------------------
     def snapshot(self) -> dict:
@@ -160,12 +328,16 @@ class Observability:
         self.spans.dump_chrome_trace(path)
 
     def prometheus(self, prefix: str = "scotty_") -> str:
-        return prometheus_text(self.registry, prefix=prefix)
+        return prometheus_text(self.registry, prefix=prefix,
+                               help_texts=METRIC_HELP)
 
 
 __all__ = [
     "Observability", "MetricsRegistry", "SpanRecorder", "Span",
     "JsonlExporter", "prometheus_text", "write_chrome_trace",
+    "FlightRecorder", "write_postmortem", "HealthPolicy",
+    "FLIGHT_DROPPED_EVENTS", "HEALTH_CHECKS", "HEALTH_UNHEALTHY",
+    "METRIC_HELP",
     "DeviceMetrics", "init_device_metrics",
     "DEVICE_INGEST_TUPLES", "DEVICE_LATE_TUPLES", "DEVICE_DROPPED_TUPLES",
     "DEVICE_TRIGGERS_FIRED", "DEVICE_WINDOWS_NONEMPTY",

@@ -1,4 +1,4 @@
-"""CLI entry: ``python -m scotty_tpu.obs report <file>``."""
+"""CLI entry: ``python -m scotty_tpu.obs {report,diff,postmortem} ...``."""
 
 import sys
 

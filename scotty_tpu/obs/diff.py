@@ -65,6 +65,12 @@ DEFAULT_THRESHOLDS = {
         "resilience_poison_records": {"direction": "lower", "default": 0},
         "resilience_source_retries": {"direction": "lower", "default": 0},
         "resilience_stall_events": {"direction": "lower", "default": 0},
+        # operations contract (ISSUE 4): flight-ring wraparound drops and
+        # unhealthy /healthz verdicts appearing between two exports gate —
+        # a run that silently lost its own black-box tail, or that an
+        # operator endpoint flagged, must not pass as clean.
+        "flight_dropped_events": {"direction": "lower", "default": 0},
+        "health_unhealthy": {"direction": "lower", "default": 0},
     },
     "require_cells": True,
 }

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import re
-import time
 from typing import IO, Optional, Union
 
 from ..utils.metrics import MetricsRegistry
@@ -38,10 +37,12 @@ class JsonlExporter:
               t: Optional[float] = None) -> dict:
         """Write one row; accepts a registry (snapshotted here) or a
         pre-built snapshot dict. Returns the row written."""
+        from ..resilience.clock import wall_time
+
         snap = (registry_or_snapshot.snapshot()
                 if isinstance(registry_or_snapshot, MetricsRegistry)
                 else dict(registry_or_snapshot))
-        row = {"t": time.time() if t is None else t}
+        row = {"t": wall_time() if t is None else t}
         if label is not None:
             row["label"] = label
         row.update(snap)
@@ -64,35 +65,82 @@ _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 def _prom_name(name: str, prefix: str) -> str:
-    return prefix + _PROM_BAD.sub("_", name)
+    name = _PROM_BAD.sub("_", name)
+    if name and name[0].isdigit():           # exposition: no leading digit
+        name = "_" + name
+    return prefix + name
 
 
-def prometheus_text(registry: MetricsRegistry,
-                    prefix: str = "scotty_") -> str:
+def escape_help(s: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    line feed only."""
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(s: str) -> str:
+    """Label-value escaping: backslash, double quote, line feed."""
+    return (str(s).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prometheus_text(registry: MetricsRegistry, prefix: str = "scotty_",
+                    help_texts: Optional[dict] = None) -> str:
     """Prometheus text exposition (version 0.0.4) snapshot of a registry:
     counters as ``counter``, gauges as ``gauge``, histograms as ``summary``
     with p50/p99 quantile samples plus ``_sum``/``_count``. Suitable for a
-    textfile-collector drop or a scrape handler body."""
-    lines = []
+    textfile-collector drop or a scrape handler body.
+
+    Hardened (ISSUE 4 satellite): ``# HELP``/``# TYPE`` lines are emitted
+    exactly once per (sanitized) metric family, and two raw names
+    collapsing to one family after sanitization expose only the FIRST —
+    duplicate unlabeled samples for one series are an invalid exposition
+    a scraper rejects WHOLESALE, so the later metric is dropped with an
+    explicit comment (never silently), as is a same-family TYPE
+    conflict. HELP text (``help_texts`` maps raw metric name →
+    description) and label values are escaped per the format; a summary
+    with zero observations exposes ``NaN`` quantiles (the Prometheus
+    convention) with honest ``_sum``/``_count``; an empty registry is
+    the empty exposition (``""``)."""
+    lines: list = []
+    families: dict = {}          # sanitized family name -> declared type
+
+    def _open_family(n: str, raw: str, ftype: str) -> bool:
+        declared = families.get(n)
+        if declared is None:
+            if help_texts and raw in help_texts:
+                lines.append(f"# HELP {n} {escape_help(help_texts[raw])}")
+            lines.append(f"# TYPE {n} {ftype}")
+            families[n] = ftype
+            return True
+        # one sample per series: a second raw name on an already-open
+        # family would duplicate it (or conflict on type) — drop loudly
+        lines.append(f"# scotty_tpu: dropped metric {raw!r} — family "
+                     f"{n} already exposed as {declared}")
+        return False
+
     with registry._lock:
         counters = dict(registry.counters)
         gauges = dict(registry.gauges)
         histograms = dict(registry.histograms)
     for name, c in counters.items():
         n = _prom_name(name, prefix)
-        lines.append(f"# TYPE {n} counter")
-        lines.append(f"{n} {c.value}")
+        if _open_family(n, name, "counter"):
+            lines.append(f"{n} {c.value}")
     for name, g in gauges.items():
         n = _prom_name(name, prefix)
-        lines.append(f"# TYPE {n} gauge")
-        lines.append(f"{n} {g.value}")
+        if _open_family(n, name, "gauge"):
+            lines.append(f"{n} {g.value}")
     for name, h in histograms.items():
         n = _prom_name(name, prefix)
-        lines.append(f"# TYPE {n} summary")
-        lines.append(f'{n}{{quantile="0.5"}} {h.percentile(50)}')
-        lines.append(f'{n}{{quantile="0.99"}} {h.percentile(99)}')
+        if not _open_family(n, name, "summary"):
+            continue
+        for q, label in ((50, "0.5"), (99, "0.99")):
+            v = h.percentile(q) if h.count else float("nan")
+            lines.append(f'{n}{{quantile="{label}"}} {v}')
         lines.append(f"{n}_sum {h.sum}")
         lines.append(f"{n}_count {h.count}")
+    if not lines:
+        return ""
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,278 @@
+"""Flight recorder + crash postmortem bundles (ISSUE 4 tentpole).
+
+The interesting failures in sustained window aggregation happen hours
+into a run — and until now a crash left no record of what the engine was
+doing in the seconds before it died. Following the always-on,
+low-overhead lineage of Dapper (Sigelman et al., 2010):
+
+* :class:`FlightRecorder` — a fixed-capacity ring buffer of recent
+  engine events (span open/close, counter deltas, watermark advances,
+  overflow/shed/grow decisions, checkpoint commits, source offsets).
+  The ring is PREALLOCATED: recording assigns into fixed slots (two
+  preallocated object lists for the interned kind/name strings, two
+  numpy float64 arrays for value/timestamp) — no list growth, dict
+  insertion, or tuple boxing on the hot path. Events are
+  sequence-numbered, so interleavings reconstruct exactly even after
+  wraparound, and timestamped via the injectable
+  :class:`~scotty_tpu.resilience.clock.Clock` (chaos tests pass a
+  ``ManualClock``). Registry activity is SAMPLED into the ring at the
+  existing sync()/drain points (``Observability.flight_sample``) — the
+  recorder adds zero device syncs.
+* :func:`write_postmortem` — an atomic crash bundle (flight snapshot +
+  registry snapshot + span summary + engine config + last checkpoint
+  pointer + exception), committed with the same ``os.replace``
+  discipline as the PR 3 checkpoints: a torn write can never produce a
+  half-readable bundle. ``python -m scotty_tpu.obs postmortem <bundle>``
+  (:mod:`.postmortem`) reconstructs the merged timeline and classifies
+  the probable cause.
+
+Wraparound is never silent: the ring's drop count folds into the
+registry as ``flight_dropped_events`` at every sample, and the default
+``obs diff`` thresholds gate it.
+
+Event-kind vocabulary (plain interned strings; recorders pass these,
+:mod:`.postmortem` matches on them):
+
+==============  ============================================================
+``span_open``   a host phase opened (name = span name)
+``span_close``  the phase closed
+``counter``     registry counter delta since the last sample (value = delta)
+``gauge``       registry gauge changed (value = new value)
+``watermark``   a watermark advanced (value = watermark event-time ms)
+``overflow``    a fatal buffer-overflow raise (name = exception type)
+``shed``        SHED admission control dropped tuples (value = count)
+``grow``        GROW doubled capacity (value = new capacity)
+``checkpoint``  a supervisor checkpoint committed (value = interval/offset)
+``restore``     a restart restored from a checkpoint
+``restart``     a supervised restart attempt (name = failure type)
+``gave_up``     the supervisor exhausted its restart budget
+``offset``      a source offset milestone (value = offset)
+``retry``       a retrying source restarted (value = resume offset)
+``stall``       a no-progress watchdog fired (value = gap seconds)
+``poison``      a record was dead-lettered (value = poison count so far)
+``health``      a /healthz probe computed an unhealthy verdict
+``mark``        free-form user annotation
+==============  ============================================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ..resilience.clock import Clock, SystemClock, wall_time
+
+#: schema tags — bump when the layout changes incompatibly; readers accept
+#: any ``<prefix>/<n>`` they know how to parse
+FLIGHT_SCHEMA = "scotty_tpu.flight/1"
+BUNDLE_SCHEMA = "scotty_tpu.postmortem/1"
+
+#: registry counter: ring-buffer wraparound drops (gated by ``obs diff``)
+FLIGHT_DROPPED_EVENTS = "flight_dropped_events"
+
+# the event-kind vocabulary (see module docstring)
+SPAN_OPEN = "span_open"
+SPAN_CLOSE = "span_close"
+COUNTER = "counter"
+GAUGE = "gauge"
+WATERMARK = "watermark"
+OVERFLOW = "overflow"
+SHED = "shed"
+GROW = "grow"
+CHECKPOINT = "checkpoint"
+RESTORE = "restore"
+RESTART = "restart"
+GAVE_UP = "gave_up"
+OFFSET = "offset"
+RETRY = "retry"
+STALL = "stall"
+POISON = "poison"
+HEALTH = "health"
+MARK = "mark"
+
+
+class FlightRecorder:
+    """Always-on bounded ring of recent engine events (module docstring).
+
+    ``capacity`` slots are preallocated at construction; ``record`` is a
+    slot assignment under the lock — O(1), allocation-free. ``dropped``
+    counts events overwritten by wraparound (``next_seq - capacity``,
+    floored at 0); the oldest retained event's sequence number is exactly
+    ``dropped``, so a reconstructed timeline states precisely what it is
+    missing.
+    """
+
+    def __init__(self, capacity: int = 1024,
+                 clock: Optional[Clock] = None):
+        if capacity < 1:
+            raise ValueError(f"FlightRecorder capacity must be >= 1, "
+                             f"got {capacity}")
+        self.capacity = int(capacity)
+        self.clock = clock or SystemClock()
+        self._lock = threading.Lock()
+        # preallocated ring slots (no per-event allocation: kind/name are
+        # references to the caller's interned strings, value/t land in
+        # fixed numpy storage)
+        self._kind: list = [None] * self.capacity
+        self._name: list = [None] * self.capacity
+        self._value = np.zeros(self.capacity, np.float64)
+        self._t = np.zeros(self.capacity, np.float64)
+        self._seq = 0
+
+    # -- recording (the hot path) -----------------------------------------
+    def record(self, kind: str, name: str, value: float = 0.0) -> None:
+        t = self.clock.now()
+        with self._lock:
+            i = self._seq % self.capacity
+            self._kind[i] = kind
+            self._name[i] = name
+            self._value[i] = value
+            self._t[i] = t
+            self._seq += 1
+
+    # -- inspection --------------------------------------------------------
+    @property
+    def next_seq(self) -> int:
+        return self._seq
+
+    @property
+    def dropped(self) -> int:
+        """Events lost to wraparound (the oldest retained seq)."""
+        return max(0, self._seq - self.capacity)
+
+    def events(self) -> List[dict]:
+        """Retained events oldest→newest, each
+        ``{seq, t, kind, name, value}`` — ``seq`` is the global sequence
+        number (gapless within the retained window), ``t`` the recording
+        clock's seconds."""
+        with self._lock:
+            seq = self._seq
+            kinds = list(self._kind)
+            names = list(self._name)
+            values = self._value.copy()
+            ts = self._t.copy()
+        first = max(0, seq - self.capacity)
+        out = []
+        for s in range(first, seq):
+            i = s % self.capacity
+            out.append({"seq": s, "t": float(ts[i]), "kind": kinds[i],
+                        "name": names[i], "value": float(values[i])})
+        return out
+
+    def snapshot(self) -> dict:
+        """The versioned export embedded in postmortem bundles."""
+        return {"schema": FLIGHT_SCHEMA, "capacity": self.capacity,
+                "next_seq": self.next_seq, "dropped": self.dropped,
+                "events": self.events()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._seq = 0
+            for i in range(self.capacity):
+                self._kind[i] = None
+                self._name[i] = None
+            self._value[:] = 0.0
+            self._t[:] = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Postmortem bundles
+# ---------------------------------------------------------------------------
+
+
+def _exception_record(exc: Optional[BaseException]) -> Optional[dict]:
+    if exc is None:
+        return None
+    rec = {"type": type(exc).__name__, "message": str(exc)}
+    cause = exc.__cause__ or exc.__context__
+    if cause is not None:
+        rec["cause_type"] = type(cause).__name__
+        rec["cause_message"] = str(cause)
+    return rec
+
+
+def _next_bundle_path(dir_path: str) -> str:
+    n = 0
+    while True:
+        path = os.path.join(dir_path, f"postmortem-{n}.json")
+        if not os.path.exists(path):
+            return path
+        n += 1
+
+
+def write_postmortem(dir_path: str, *, exception: Optional[BaseException]
+                     = None, obs=None, flight: Optional[FlightRecorder]
+                     = None, config=None, checkpoint: Optional[str] = None,
+                     label: Optional[str] = None,
+                     extra: Optional[dict] = None) -> str:
+    """Dump one atomic postmortem bundle into ``dir_path`` (created if
+    missing) and return its path.
+
+    The bundle is a single versioned JSON document: the flight-recorder
+    snapshot (``flight`` or ``obs.flight``), the registry snapshot and
+    span summary from ``obs``, the engine config (a dataclass is
+    serialized via ``asdict``), the last-checkpoint pointer, and the
+    exception being post-mortemed. Commit discipline matches the PR 3
+    checkpoints: the document is written to a sibling temp file, fsynced,
+    then ``os.replace``d into place — a crash mid-write leaves no
+    half-readable bundle behind. Bundles are numbered ``postmortem-<n>``
+    in creation order and never overwritten.
+    """
+    import dataclasses
+
+    if flight is None and obs is not None:
+        flight = getattr(obs, "flight", None)
+    if config is not None and dataclasses.is_dataclass(config):
+        config = dataclasses.asdict(config)
+    bundle = {
+        "schema": BUNDLE_SCHEMA,
+        "created_t": wall_time(),
+        "label": label,
+        "exception": _exception_record(exception),
+        "flight": flight.snapshot() if flight is not None else None,
+        "registry": obs.snapshot() if obs is not None else None,
+        "spans": obs.spans.summary() if obs is not None else None,
+        "config": config,
+        "checkpoint": checkpoint,
+        "extra": extra,
+    }
+    os.makedirs(dir_path, exist_ok=True)
+    path = _next_bundle_path(dir_path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(bundle, f, indent=1, default=float)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)                    # the atomic commit point
+    return path
+
+
+def read_postmortem(path: str) -> dict:
+    """Load + schema-check one bundle."""
+    with open(path) as f:
+        bundle = json.load(f)
+    schema = bundle.get("schema", "")
+    if not str(schema).startswith("scotty_tpu.postmortem/"):
+        raise ValueError(
+            f"{path}: not a postmortem bundle (schema={schema!r}; "
+            "expected scotty_tpu.postmortem/<n>)")
+    return bundle
+
+
+def list_postmortems(dir_path: str) -> List[str]:
+    """Bundle paths in ``dir_path``, oldest (lowest index) first."""
+    if not os.path.isdir(dir_path):
+        return []
+    found = []
+    for name in os.listdir(dir_path):
+        if name.startswith("postmortem-") and name.endswith(".json"):
+            try:
+                idx = int(name[len("postmortem-"):-len(".json")])
+            except ValueError:
+                continue
+            found.append((idx, os.path.join(dir_path, name)))
+    return [p for _, p in sorted(found)]

@@ -1,0 +1,236 @@
+"""``python -m scotty_tpu.obs postmortem <bundle>`` — crash triage.
+
+Reads an atomic postmortem bundle (:func:`scotty_tpu.obs.flight.
+write_postmortem`), reconstructs the merged flight-recorder timeline
+(sequence-numbered, so interleavings are exact even after ring
+wraparound), reports the operational trajectory — last watermark,
+slice-occupancy trend, drop and restart history — and classifies the
+probable cause:
+
+==================  ========================================================
+``overflow``        a slice/annex/session buffer overflow raise
+``stalled_source``  the stream went quiet (watchdog events / SourceStalled)
+``poison_record``   dead-letter volume crossed the poison limit
+``crash_loop``      the supervisor exhausted its restart budget
+``crash``           an exception matching no specific signature
+``none``            the bundle records no failure (a manual snapshot)
+==================  ========================================================
+
+Exit status: nonzero iff the bundle records a failure — a postmortem of
+a crash is itself a red CI signal, while a manually-written snapshot
+bundle reads clean.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from . import flight as _flight
+
+
+# ---------------------------------------------------------------------------
+# analysis
+# ---------------------------------------------------------------------------
+
+
+def _events(bundle: dict) -> List[dict]:
+    fl = bundle.get("flight") or {}
+    return list(fl.get("events") or [])
+
+
+def _counter(bundle: dict, name: str) -> float:
+    reg = bundle.get("registry") or {}
+    v = reg.get(name, 0.0)
+    return float(v) if isinstance(v, (int, float)) else 0.0
+
+
+def classify(bundle: dict) -> Tuple[str, List[str]]:
+    """(cause, evidence). Signature checks run most-specific-first: the
+    exception type, then its recorded cause, then message text, then the
+    counter/flight evidence — so a ``SupervisorGaveUp`` wrapping an
+    overflow still reads ``crash_loop`` (the loop is the operational
+    problem; the evidence lines name the underlying failure)."""
+    exc = bundle.get("exception")
+    events = _events(bundle)
+    evidence = []
+    for name, label in ((_flight.OVERFLOW, "overflow events"),
+                        (_flight.STALL, "stall events"),
+                        (_flight.POISON, "poison events"),
+                        (_flight.RESTART, "restart attempts"),
+                        (_flight.SHED, "shed decisions"),
+                        (_flight.GROW, "grow decisions")):
+        n = sum(1 for e in events if e.get("kind") == name)
+        if n:
+            evidence.append(f"{n} {label} in the flight window")
+    for name in ("overflows", "resilience_stall_events",
+                 "resilience_poison_records", "resilience_restarts",
+                 "resilience_shed_tuples"):
+        v = _counter(bundle, name)
+        if v:
+            evidence.append(f"{name}={v:g}")
+    if exc is None:
+        return "none", evidence
+    text = " ".join(str(exc.get(k, "")) for k in
+                    ("type", "message", "cause_type",
+                     "cause_message")).lower()
+    if "supervisorgaveup" in text or "gave up after" in text:
+        cause = "crash_loop"
+    elif "overflow" in text or any(e.get("kind") == _flight.OVERFLOW
+                                   for e in events):
+        cause = "overflow"
+    elif ("stall" in text
+          or _counter(bundle, "resilience_stall_events") > 0):
+        cause = "stalled_source"
+    elif ("poison" in text
+          or _counter(bundle, "resilience_poison_records") > 0):
+        cause = "poison_record"
+    else:
+        cause = "crash"
+    return cause, evidence
+
+
+def _occupancy_trend(events: List[dict]) -> Optional[dict]:
+    samples = [e["value"] for e in events
+               if e.get("kind") == _flight.GAUGE
+               and e.get("name") == "slice_occupancy"]
+    if not samples:
+        return None
+    half = max(1, len(samples) // 2)
+    head = sum(samples[:half]) / half
+    tail = sum(samples[-half:]) / half
+    if tail > head + 0.05:
+        trend = "rising"
+    elif tail < head - 0.05:
+        trend = "falling"
+    else:
+        trend = "flat"
+    return {"trend": trend, "first": samples[0], "last": samples[-1],
+            "peak": max(samples), "samples": len(samples)}
+
+
+def analyze(bundle: dict) -> dict:
+    """The structured triage report (what ``--json`` prints)."""
+    events = _events(bundle)
+    cause, evidence = classify(bundle)
+    watermarks = [e["value"] for e in events
+                  if e.get("kind") == _flight.WATERMARK]
+    restarts = [e for e in events if e.get("kind") in
+                (_flight.RESTART, _flight.GAVE_UP)]
+    checkpoints = [e for e in events
+                   if e.get("kind") == _flight.CHECKPOINT]
+    drops = {
+        "shed_tuples": _counter(bundle, "resilience_shed_tuples"),
+        "dropped_tuples": _counter(bundle, "dropped_tuples")
+        + _counter(bundle, "device_dropped_tuples"),
+        "poison_records": _counter(bundle, "resilience_poison_records"),
+    }
+    fl = bundle.get("flight") or {}
+    return {
+        "cause": cause,
+        "evidence": evidence,
+        "exception": bundle.get("exception"),
+        "label": bundle.get("label"),
+        "checkpoint": bundle.get("checkpoint"),
+        "last_watermark_ms": watermarks[-1] if watermarks else None,
+        "occupancy": _occupancy_trend(events),
+        "restart_history": [
+            {"seq": e["seq"], "t": e["t"], "kind": e["kind"],
+             "failure": e.get("name"), "attempt": e.get("value")}
+            for e in restarts],
+        "checkpoint_history": [
+            {"seq": e["seq"], "t": e["t"], "position": e.get("value")}
+            for e in checkpoints],
+        "drops": drops,
+        "flight_events": len(events),
+        "flight_dropped": int(fl.get("dropped", 0) or 0),
+        "failed": bundle.get("exception") is not None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def render_timeline(bundle: dict) -> str:
+    """The merged event timeline, oldest first (``--timeline``)."""
+    events = _events(bundle)
+    fl = bundle.get("flight") or {}
+    lines = []
+    dropped = int(fl.get("dropped", 0) or 0)
+    if dropped:
+        lines.append(f"  ... {dropped} earlier event(s) lost to ring "
+                     f"wraparound (capacity {fl.get('capacity')}) ...")
+    for e in events:
+        lines.append(f"  #{e['seq']:<6d} t={e['t']:>12.6f}  "
+                     f"{e['kind']:<12s} {str(e['name']):<28s} "
+                     f"{e['value']:g}")
+    if not events:
+        lines.append("  (no flight events in bundle)")
+    return "\n".join(lines)
+
+
+def render(path: str, bundle: dict, show_timeline: bool = False) -> str:
+    a = analyze(bundle)
+    lines = [f"{path} [postmortem]"]
+    exc = a["exception"]
+    if exc:
+        lines.append(f"  exception: {exc.get('type')}: "
+                     f"{exc.get('message')}")
+        if exc.get("cause_type"):
+            lines.append(f"    caused by: {exc['cause_type']}: "
+                         f"{exc.get('cause_message')}")
+    else:
+        lines.append("  exception: none (snapshot bundle)")
+    lines.append(f"  probable cause: {a['cause']}")
+    for ev in a["evidence"]:
+        lines.append(f"    evidence: {ev}")
+    if a["last_watermark_ms"] is not None:
+        lines.append(f"  last watermark: {a['last_watermark_ms']:g} ms")
+    occ = a["occupancy"]
+    if occ:
+        lines.append(
+            f"  slice occupancy: {occ['trend']} "
+            f"({occ['first']:.3f} -> {occ['last']:.3f}, "
+            f"peak {occ['peak']:.3f}, {occ['samples']} samples)")
+    if any(a["drops"].values()):
+        lines.append("  drops: " + ", ".join(
+            f"{k}={v:g}" for k, v in a["drops"].items() if v))
+    if a["restart_history"]:
+        lines.append(f"  restarts: {len(a['restart_history'])}")
+        for r in a["restart_history"]:
+            lines.append(f"    #{r['seq']} t={r['t']:.3f} {r['kind']} "
+                         f"({r['failure']}, attempt {r['attempt']:g})")
+    if a["checkpoint_history"]:
+        last = a["checkpoint_history"][-1]
+        lines.append(
+            f"  checkpoints: {len(a['checkpoint_history'])} "
+            f"(last committed at position {last['position']:g})")
+    if a["checkpoint"]:
+        lines.append(f"  restart from: {a['checkpoint']}")
+    lines.append(f"  flight window: {a['flight_events']} events, "
+                 f"{a['flight_dropped']} dropped to wraparound")
+    if show_timeline:
+        lines.append("  timeline:")
+        lines.append(render_timeline(bundle))
+    return "\n".join(lines)
+
+
+def postmortem_main(bundle_path: str, as_json: bool = False,
+                    show_timeline: bool = False, echo=None) -> int:
+    """CLI entry: 0 = clean snapshot bundle, 1 = the bundle records a
+    failure (the classification is in the output either way)."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    bundle = _flight.read_postmortem(bundle_path)
+    a = analyze(bundle)
+    if as_json:
+        if show_timeline:
+            a["timeline"] = _events(bundle)
+        echo(json.dumps(a, indent=1, default=float))
+    else:
+        echo(render(bundle_path, bundle, show_timeline=show_timeline))
+    return 1 if a["failed"] else 0

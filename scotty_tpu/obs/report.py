@@ -38,13 +38,28 @@ def summarize_rows(rows: List[dict]) -> dict:
 
 
 def summarize_jsonl(path: str) -> dict:
+    """Summarize a JSONL time series, degrading gracefully on the exact
+    file a postmortem reads: a run that crashed mid-write leaves a
+    truncated (or garbage) final line, which is COUNTED and skipped
+    (``skipped_lines``) instead of raising away the rows that did land
+    (ISSUE 4 satellite)."""
     rows = []
-    with open(path) as f:
+    skipped = 0
+    with open(path, errors="replace") as f:
         for line in f:
             line = line.strip()
-            if line:
-                rows.append(json.loads(line))
-    return {"kind": "jsonl", "rows": len(rows),
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+            else:                      # a bare scalar/list is not a snapshot
+                skipped += 1
+    return {"kind": "jsonl", "rows": len(rows), "skipped_lines": skipped,
             "metrics": summarize_rows(rows)}
 
 
@@ -79,22 +94,29 @@ def summarize_bench_results(cells: List[dict]) -> dict:
 
 
 def summarize(path: str) -> dict:
-    """Sniff + summarize one exported metrics file (see module doc)."""
-    with open(path) as f:
+    """Sniff + summarize one exported metrics file (see module doc).
+    Truncated exports (a crashed run's half-written JSON) degrade to the
+    line-tolerant JSONL path instead of raising."""
+    with open(path, errors="replace") as f:
         head = f.read(1)
         f.seek(0)
         if head == "[":
-            return summarize_bench_results(json.load(f))
+            try:
+                return summarize_bench_results(json.load(f))
+            except json.JSONDecodeError:
+                # a torn result_*.json: salvage any parseable lines
+                return summarize_jsonl(path)
         if head == "{":
             try:
                 obj = json.load(f)
             except json.JSONDecodeError:
-                # multiple lines of objects: a JSONL time series
+                # multiple lines of objects (a JSONL time series) or a
+                # truncated single object — the tolerant path covers both
                 return summarize_jsonl(path)
             if "traceEvents" in obj:
                 return summarize_trace(obj)
             # a single snapshot object: treat as a one-row series
-            return {"kind": "snapshot", "rows": 1,
+            return {"kind": "snapshot", "rows": 1, "skipped_lines": 0,
                     "metrics": summarize_rows([obj])}
     return summarize_jsonl(path)
 
@@ -113,6 +135,9 @@ def render(path: str, as_json: bool = False) -> str:
     lines = [f"{path} [{summary['kind']}]"]
     if summary["kind"] in ("jsonl", "snapshot"):
         lines.append(f"  rows: {summary['rows']}")
+        if summary.get("skipped_lines"):
+            lines.append(f"  skipped: {summary['skipped_lines']} "
+                         "truncated/corrupt line(s) — crashed-run tail?")
         lines.append(f"  {'metric':32s} {'n':>6s} {'last':>14s} "
                      f"{'mean':>14s} {'min':>14s} {'max':>14s}")
         for name, st in summary["metrics"].items():
@@ -178,6 +203,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "default gates the headline bench fields")
     dp.add_argument("--json", action="store_true",
                     help="machine-readable finding list")
+    pp = sub.add_parser(
+        "postmortem", help="triage a crash bundle: merged flight "
+                           "timeline, watermark/occupancy/restart "
+                           "history, probable-cause classification; "
+                           "exits nonzero when the bundle records a "
+                           "failure")
+    pp.add_argument("bundle", help="path to a postmortem-<n>.json bundle")
+    pp.add_argument("--json", action="store_true",
+                    help="machine-readable analysis instead of the report")
+    pp.add_argument("--timeline", action="store_true",
+                    help="include the full event-by-event timeline")
     args = ap.parse_args(argv)
     if args.cmd == "report":
         print(render(args.file, as_json=args.json))
@@ -187,4 +223,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return diff_main(args.baseline, args.candidate, args.thresholds,
                          as_json=args.json)
+    if args.cmd == "postmortem":
+        from .postmortem import postmortem_main
+
+        return postmortem_main(args.bundle, as_json=args.json,
+                               show_timeline=args.timeline)
     return 2                                            # pragma: no cover

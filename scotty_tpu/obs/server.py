@@ -1,0 +1,181 @@
+"""Live metrics/health endpoint: ``Observability.serve(port=0)``.
+
+A stdlib-``http.server`` daemon thread an operator (or a Prometheus
+scraper / k8s probe) can hit while a pipeline runs:
+
+* ``/metrics`` — the registry's Prometheus text exposition
+  (``Observability.prometheus()``).
+* ``/vars`` — the structured JSON export (metrics snapshot + span
+  summary, ``Observability.export()``).
+* ``/healthz`` — a JSON verdict from :class:`HealthPolicy`: HTTP 200
+  when healthy, 503 when not. The verdict is computed from the
+  ``watermark_lag_ms`` gauge (event-time lag behind the stream head),
+  the PR 3 stall-watchdog state (``resilience_stall_events`` advancing
+  between probes) and the ``overflows`` counter.
+
+No third-party dependency, no background polling: every request reads
+the thread-safe registry at answer time, so serving adds zero work to
+the engine's hot path. Opt-in wiring: ``serve_port=`` on the kafka /
+asyncio ``run()`` loops and ``--serve-port`` on the bench runner.
+
+Health probes are themselves telemetry: each verdict counts
+``health_checks`` and, when unhealthy, ``health_unhealthy`` (gated by
+the default ``obs diff`` thresholds) and records a ``health`` flight
+event — a postmortem can show that the endpoint saw it coming.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import flight as _flight
+
+#: registry counters the health endpoint maintains (obs-contract names)
+HEALTH_CHECKS = "health_checks"
+HEALTH_UNHEALTHY = "health_unhealthy"
+
+#: metric names the default verdict reads (the obs contract)
+_WATERMARK_LAG_MS = "watermark_lag_ms"
+_STALL_EVENTS = "resilience_stall_events"
+_OVERFLOWS = "overflows"
+
+
+class HealthPolicy:
+    """Computes the ``/healthz`` verdict from registry state.
+
+    ``max_watermark_lag_ms`` — unhealthy while the ``watermark_lag_ms``
+    gauge exceeds it (None disables the check). ``stall_unhealthy`` —
+    unhealthy when ``resilience_stall_events`` advanced since the
+    previous probe (the PR 3 watchdogs count them; a probe after a quiet
+    interval recovers). ``overflow_unhealthy`` — unhealthy once any
+    ``overflows`` were counted (a raised overflow flag is terminal, so
+    this check never recovers).
+
+    ``verdict`` is also callable without a server (tests drive it
+    directly) and is safe under concurrent probes (one policy-level lock
+    orders the stall-delta reads).
+    """
+
+    def __init__(self, max_watermark_lag_ms: Optional[float] = None,
+                 stall_unhealthy: bool = True,
+                 overflow_unhealthy: bool = True):
+        self.max_watermark_lag_ms = max_watermark_lag_ms
+        self.stall_unhealthy = stall_unhealthy
+        self.overflow_unhealthy = overflow_unhealthy
+        self._lock = threading.Lock()
+        self._last_stalls = 0.0
+
+    def verdict(self, obs) -> dict:
+        reg = obs.registry
+        with reg._lock:
+            lag = (reg.gauges[_WATERMARK_LAG_MS].value
+                   if _WATERMARK_LAG_MS in reg.gauges else None)
+            stalls = (reg.counters[_STALL_EVENTS].value
+                      if _STALL_EVENTS in reg.counters else 0.0)
+            overflows = (reg.counters[_OVERFLOWS].value
+                         if _OVERFLOWS in reg.counters else 0.0)
+        checks = {}
+        healthy = True
+        if self.max_watermark_lag_ms is not None:
+            ok = lag is None or lag <= self.max_watermark_lag_ms
+            checks["watermark_lag"] = {
+                "ok": ok, "lag_ms": lag,
+                "max_lag_ms": self.max_watermark_lag_ms}
+            healthy = healthy and ok
+        if self.stall_unhealthy:
+            with self._lock:
+                new = stalls - self._last_stalls
+                self._last_stalls = stalls
+            ok = new <= 0
+            checks["stall_watchdog"] = {
+                "ok": ok, "stall_events": stalls,
+                "new_since_last_probe": new}
+            healthy = healthy and ok
+        if self.overflow_unhealthy:
+            ok = overflows == 0
+            checks["overflow"] = {"ok": ok, "overflows": overflows}
+            healthy = healthy and ok
+        obs.counter(HEALTH_CHECKS).inc()
+        if not healthy:
+            obs.counter(HEALTH_UNHEALTHY).inc()
+            obs.flight_event(_flight.HEALTH, "unhealthy")
+        return {"healthy": healthy, "checks": checks}
+
+
+class ObsServer:
+    """The daemon-thread HTTP server :func:`serve` returns. ``port`` is
+    the bound port (useful with ``port=0``); ``close()`` shuts the
+    listener down and joins the thread. Context-manager friendly."""
+
+    def __init__(self, obs, host: str = "127.0.0.1", port: int = 0,
+                 health: Optional[HealthPolicy] = None):
+        self.obs = obs                 # an Observability OR a () -> obs
+        self.health = health or HealthPolicy()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):      # silent by contract
+                pass
+
+            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                o = outer.obs() if callable(outer.obs) else outer.obs
+                path = self.path.split("?", 1)[0]
+                if o is None:
+                    self._reply(503, "text/plain",
+                                b"no active observability\n")
+                    return
+                if path == "/metrics":
+                    self._reply(200, "text/plain; version=0.0.4",
+                                o.prometheus().encode())
+                elif path == "/vars":
+                    self._reply(200, "application/json",
+                                json.dumps(o.export(),
+                                           default=float).encode())
+                elif path == "/healthz":
+                    v = outer.health.verdict(o)
+                    self._reply(200 if v["healthy"] else 503,
+                                "application/json",
+                                json.dumps(v, default=float).encode())
+                else:
+                    self._reply(404, "text/plain",
+                                b"unknown path (serving /metrics, /vars, "
+                                b"/healthz)\n")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"scotty-obs-server:{self.port}")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "ObsServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve(obs, port: int = 0, host: str = "127.0.0.1",
+          health: Optional[HealthPolicy] = None) -> ObsServer:
+    """Start the endpoint for ``obs`` (an ``Observability`` or a zero-arg
+    provider returning the currently-live one — the bench runner swaps
+    per-cell registries under one server). ``port=0`` binds an ephemeral
+    port; read it back from ``server.port``."""
+    return ObsServer(obs, host=host, port=port, health=health)

@@ -14,6 +14,15 @@ from __future__ import annotations
 import time
 
 
+def wall_time() -> float:
+    """Unix wall seconds — the single sanctioned wall-clock read for
+    export timestamps (JSONL rows, postmortem bundle headers). The obs
+    tier-1 lint forbids bare ``time.time()``/``time.monotonic()`` inside
+    ``scotty_tpu/obs/`` (mirroring the no-bare-sleep rule), so anything
+    there that needs a wall timestamp routes through here."""
+    return time.time()
+
+
 class Clock:
     """Monotonic now() + sleep() pair. Implementations must keep
     ``now()`` consistent with ``sleep()`` (after ``sleep(d)``, ``now()``

@@ -69,6 +69,7 @@ def retrying_source(make_source: Callable[[int], Iterator],
             failures += 1
             if obs is not None:
                 obs.counter(_obs.RESILIENCE_SOURCE_RETRIES).inc()
+                obs.flight_event("retry", type(e).__name__, offset)
             if failures > max_retries:
                 raise SourceExhaustedRetries(
                     f"source failed {failures} consecutive times at "
@@ -95,6 +96,7 @@ class PoisonHandler:
         self.count += 1
         if self.obs is not None:
             self.obs.counter(_obs.RESILIENCE_POISON_RECORDS).inc()
+            self.obs.flight_event("poison", type(exc).__name__, self.count)
         if self.dead_letter is not None:
             self.dead_letter(record, exc)
         if self.limit is not None and self.count > self.limit:
@@ -133,6 +135,7 @@ def watchdog_source(source, stall_timeout_s: float,
         if gap > stall_timeout_s:
             if obs is not None:
                 obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
+                obs.flight_event("stall", "watchdog_source", gap)
             if on_stall is not None:
                 on_stall(gap)
         yield item

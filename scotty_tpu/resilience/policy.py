@@ -172,6 +172,7 @@ def grow_pipeline(pipeline, factory, obs=None):
             grown.obs = pipeline.obs
     if obs is not None:
         obs.counter(_obs.RESILIENCE_GROW_EVENTS).inc()
+        obs.flight_event("grow", "capacity", float(new_config.capacity))
     return grown
 
 

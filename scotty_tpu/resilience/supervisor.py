@@ -83,6 +83,29 @@ class Supervisor:
 
         return contextlib.nullcontext()
 
+    def _flight(self, kind: str, name: str, value: float = 0.0) -> None:
+        if self.obs is not None:
+            self.obs.flight_event(kind, name, value)
+
+    def _postmortem(self, exc: BaseException) -> None:
+        """Dump an atomic crash bundle NEXT TO the checkpoints (ISSUE 4):
+        every restart attempt and the final give-up leave a
+        ``postmortem-<n>.json`` carrying the flight window, registry
+        snapshot, the checkpointed config and the LATEST pointer — what
+        ``python -m scotty_tpu.obs postmortem`` triages. Never raises:
+        a bundle-write failure must not mask the supervised one."""
+        try:
+            from ..obs.flight import write_postmortem
+
+            ckpt = self._current_ckpt()
+            write_postmortem(
+                self.dir, exception=exc, obs=self.obs,
+                config=self._load_config_sidecar(ckpt), checkpoint=ckpt,
+                extra={"restarts": self.restarts,
+                       "total_restarts": self.total_restarts,
+                       "max_restarts": self.max_restarts})
+        except Exception:       # noqa: BLE001 — crash-path side channel
+            pass
 
     def _backoff(self, exc: BaseException) -> None:
         # `restarts` counts CONSECUTIVE failed recoveries: a successful
@@ -93,10 +116,16 @@ class Supervisor:
         self.restarts += 1
         self.total_restarts += 1
         self._count(_obs.RESILIENCE_RESTARTS)
+        self._flight("restart", type(exc).__name__, self.restarts)
+        self._postmortem(exc)
         if self.restarts > self.max_restarts:
-            raise SupervisorGaveUp(
+            gave = SupervisorGaveUp(
                 f"gave up after {self.max_restarts} restarts "
-                f"(last failure: {exc})") from exc
+                f"(last failure: {exc})")
+            gave.__cause__ = exc
+            self._flight("gave_up", type(exc).__name__, self.restarts)
+            self._postmortem(gave)
+            raise gave
         delay = backoff_delay(self.restarts, self.backoff_base_s,
                               self.backoff_max_s, self.jitter, self._rng)
         with self._span(_obs.RESILIENCE_BACKOFF_SPAN):
@@ -196,6 +225,7 @@ class Supervisor:
                             self._save_config_sidecar(d, p.config)
                             self._commit_ckpt(d)
                         self._count(_obs.RESILIENCE_CHECKPOINTS)
+                        self._flight("checkpoint", "interval", i)
                         self.restarts = 0          # progress made
                 return [results[k] for k in range(n_intervals)]
             except Exception as e:            # noqa: BLE001 — supervised edge
@@ -212,6 +242,7 @@ class Supervisor:
         if ckpt is not None:
             with self._span(_obs.RESILIENCE_RESTORE_SPAN):
                 restore_pipeline(p, ckpt)
+            self._flight("restore", os.path.basename(ckpt))
         return p
 
     # -- operator + source mode --------------------------------------------
@@ -267,6 +298,7 @@ class Supervisor:
                                 json.dump({"offset": idx}, f)
                             self._commit_ckpt(d)
                         self._count(_obs.RESILIENCE_CHECKPOINTS)
+                        self._flight("checkpoint", "offset", idx)
                         offset = idx
                         self.restarts = 0          # progress made
                 return [results[k] for k in sorted(results)]
@@ -285,6 +317,8 @@ class Supervisor:
                 restore_engine_operator(op, ckpt)
             with open(os.path.join(ckpt, "offset.json")) as f:
                 offset = int(json.load(f)["offset"])
+            self._flight("restore", os.path.basename(ckpt), offset)
+            self._flight("offset", "resume", offset)
         if self.obs is not None and op.obs is None:
             op.set_observability(self.obs)
         return op, offset

@@ -1,0 +1,316 @@
+"""Flight recorder + crash postmortems + health verdicts (ISSUE 4).
+
+Chaos-driven coverage: under ``CrashInjector`` and ``StallingSource`` on
+a ``ManualClock``, postmortem bundles are produced atomically, the
+reconstructed timeline matches the oracle event order exactly, and the
+``/healthz`` verdict flips unhealthy at the configured watermark-lag
+threshold / on fresh stall-watchdog events.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.operator import TpuWindowOperator
+from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+from scotty_tpu.obs import (
+    FLIGHT_DROPPED_EVENTS,
+    FlightRecorder,
+    HealthPolicy,
+    Observability,
+    write_postmortem,
+)
+from scotty_tpu.obs.flight import list_postmortems, read_postmortem
+from scotty_tpu.obs.postmortem import analyze, postmortem_main
+from scotty_tpu.obs.report import main as obs_main
+from scotty_tpu.resilience import (
+    ChaosError,
+    CrashInjector,
+    ManualClock,
+    StallingSource,
+    Supervisor,
+    SupervisorGaveUp,
+    burst,
+    watchdog_source,
+)
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=1 << 12, batch_size=256, annex_capacity=256,
+                   min_trigger_pad=32)
+
+
+# ---------------------------------------------------------------------------
+# the ring itself
+# ---------------------------------------------------------------------------
+
+
+def test_ring_wraparound_keeps_newest_and_counts_dropped():
+    clock = ManualClock()
+    fl = FlightRecorder(capacity=8, clock=clock)
+    for i in range(20):
+        clock.advance(1.0)
+        fl.record("mark", "m", i)
+    ev = fl.events()
+    assert len(ev) == 8
+    assert [e["seq"] for e in ev] == list(range(12, 20))   # newest window
+    assert [e["value"] for e in ev] == list(range(12, 20))
+    assert ev[0]["t"] == 13.0                  # ManualClock drove the stamps
+    assert fl.dropped == 12
+    snap = fl.snapshot()
+    assert snap["schema"].startswith("scotty_tpu.flight/")
+    assert snap["dropped"] == 12 and snap["next_seq"] == 20
+
+
+def test_observability_span_and_sample_feed_the_ring():
+    obs = Observability(flight=FlightRecorder(capacity=64,
+                                              clock=ManualClock()))
+    with obs.span("drain"):
+        obs.counter("ingest_tuples").inc(100)
+    obs.gauge("slice_occupancy").set(0.25)
+    obs.flight_sync(watermark=500)
+    kinds = [(e["kind"], e["name"]) for e in obs.flight.events()]
+    assert ("span_open", "drain") in kinds
+    assert ("span_close", "drain") in kinds
+    assert ("watermark", "watermark") in kinds
+    assert ("counter", "ingest_tuples") in kinds
+    assert ("gauge", "slice_occupancy") in kinds
+    # spans still land in the SpanRecorder too
+    assert obs.spans.summary()["drain"]["count"] == 1
+    # delta semantics: a second unchanged sample records nothing new
+    n = len(obs.flight.events())
+    obs.flight_sample()
+    assert len(obs.flight.events()) == n
+    obs.counter("ingest_tuples").inc(7)
+    obs.flight_sample()
+    last = obs.flight.events()[-1]
+    assert (last["kind"], last["value"]) == ("counter", 7.0)
+
+
+def test_wraparound_drops_fold_into_registry_exactly_once():
+    obs = Observability(flight=FlightRecorder(capacity=4,
+                                              clock=ManualClock()))
+    for i in range(10):
+        obs.flight.record("mark", "m", i)
+    obs.flight_sample()
+    first = obs.snapshot()[FLIGHT_DROPPED_EVENTS]
+    assert first >= 6                     # 10 recorded into 4 slots
+    obs.flight_sample()                   # no new drops -> no re-fold
+    assert obs.snapshot()[FLIGHT_DROPPED_EVENTS] == first
+
+
+# ---------------------------------------------------------------------------
+# bundles
+# ---------------------------------------------------------------------------
+
+
+def test_postmortem_bundle_atomic_roundtrip(tmp_path):
+    obs = Observability(flight=FlightRecorder(capacity=16,
+                                              clock=ManualClock()))
+    obs.counter("ingest_tuples").inc(5)
+    obs.flight_sample()
+    d = str(tmp_path / "pm")
+    p0 = write_postmortem(d, exception=RuntimeError("boom"), obs=obs,
+                          config=CFG, checkpoint="ckpt-4", label="unit")
+    p1 = write_postmortem(d, obs=obs)          # clean snapshot bundle
+    assert os.path.basename(p0) == "postmortem-0.json"
+    assert os.path.basename(p1) == "postmortem-1.json"
+    # atomic commit: no temp residue next to the bundles
+    assert not [f for f in os.listdir(d) if ".tmp." in f]
+    assert list_postmortems(d) == [p0, p1]
+    b = read_postmortem(p0)
+    assert b["exception"]["type"] == "RuntimeError"
+    assert b["config"]["capacity"] == CFG.capacity
+    assert b["checkpoint"] == "ckpt-4"
+    assert b["flight"]["events"]
+    assert b["registry"]["ingest_tuples"] == 5
+    # a clean snapshot bundle reads as no-failure; the CLI exits 0 on it
+    assert analyze(read_postmortem(p1))["cause"] == "none"
+    assert postmortem_main(p1, echo=lambda s: None) == 0
+    with pytest.raises(ValueError, match="not a postmortem bundle"):
+        bad = tmp_path / "x.json"
+        bad.write_text("{}")
+        read_postmortem(str(bad))
+
+
+def pipeline_factory(config=None):
+    return AlignedStreamPipeline(
+        [TumblingWindow(Time, 50)], [SumAggregation()],
+        config=config or CFG, throughput=20_000, wm_period_ms=100,
+        max_lateness=100, seed=5, gc_every=10 ** 9, value_scale=1024.0)
+
+
+def test_supervised_crash_bundle_timeline_matches_oracle_order(tmp_path):
+    """A CrashInjector run yields a postmortem bundle whose reconstructed
+    resilience timeline bit-matches the injected event sequence
+    (checkpoints at 2 and 4, the crash at 5, nothing else), and the
+    recovery completes with the post-restart events in oracle order."""
+    obs = Observability(flight=FlightRecorder(capacity=256,
+                                              clock=ManualClock()))
+    sup = Supervisor(str(tmp_path / "ckpt"), clock=ManualClock(), obs=obs,
+                     checkpoint_every=2, max_restarts=2, seed=9)
+    crash = CrashInjector(at=5)
+    rows = sup.run_pipeline(pipeline_factory, 8, fault=crash)
+    assert crash.fired == 5
+
+    bundles = list_postmortems(str(tmp_path / "ckpt"))
+    assert len(bundles) == 1              # exactly one restart attempt
+    b = read_postmortem(bundles[0])
+    resil = [(e["kind"], e["name"], e["value"])
+             for e in b["flight"]["events"]
+             if e["kind"] in ("checkpoint", "restart", "restore",
+                              "gave_up")]
+    # the oracle event order of the injected chaos, bit-for-bit
+    assert resil == [("checkpoint", "interval", 2.0),
+                     ("checkpoint", "interval", 4.0),
+                     ("restart", "ChaosError", 1.0)]
+    assert b["exception"]["type"] == "ChaosError"
+    assert b["checkpoint"] and b["checkpoint"].endswith("ckpt-4")
+    assert b["config"]["capacity"] == CFG.capacity
+    a = analyze(b)
+    assert a["failed"] and a["cause"] == "crash"
+    assert a["last_watermark_ms"] == 400.0     # last drained sync: ckpt-4
+    assert a["checkpoint_history"][-1]["position"] == 4.0
+
+    # the full post-recovery timeline continues in oracle order
+    full = [(e["kind"], e["value"]) for e in obs.flight.events()
+            if e["kind"] in ("checkpoint", "restart", "restore")]
+    assert full == [("checkpoint", 2.0), ("checkpoint", 4.0),
+                    ("restart", 1.0), ("restore", 0.0),
+                    ("checkpoint", 6.0), ("checkpoint", 8.0)]
+    # and recovery stayed bit-identical to an uninterrupted run
+    ref = pipeline_factory()
+    assert rows == [ref.lowered_results(o) for o in ref.run(8)]
+
+
+def test_crash_loop_bundle_classifies_and_cli_exits_nonzero(tmp_path,
+                                                            capsys):
+    obs = Observability(flight=FlightRecorder(capacity=128,
+                                              clock=ManualClock()))
+    sup = Supervisor(str(tmp_path / "ckpt"), clock=ManualClock(), obs=obs,
+                     checkpoint_every=2, max_restarts=1, seed=1)
+
+    def always_crash(pos):
+        raise ChaosError("permanent failure")
+
+    with pytest.raises(SupervisorGaveUp):
+        sup.run_pipeline(pipeline_factory, 8, fault=always_crash)
+
+    bundles = list_postmortems(str(tmp_path / "ckpt"))
+    assert bundles                        # every attempt + the give-up
+    last = read_postmortem(bundles[-1])
+    assert last["exception"]["type"] == "SupervisorGaveUp"
+    assert last["exception"]["cause_type"] == "ChaosError"
+    a = analyze(last)
+    assert a["cause"] == "crash_loop"
+    assert len(a["restart_history"]) >= 2       # restarts + gave_up events
+
+    # the CLI: nonzero exit, cause named in the human report AND --json
+    assert obs_main(["postmortem", bundles[-1]]) == 1
+    out = capsys.readouterr().out
+    assert "probable cause: crash_loop" in out
+    assert obs_main(["postmortem", bundles[-1], "--json",
+                     "--timeline"]) == 1
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["cause"] == "crash_loop"
+    assert parsed["timeline"]
+
+
+def test_overflow_fail_path_dumps_bundle(tmp_path):
+    """The overflow FAIL path dumps a bundle (obs.postmortem_dir armed)
+    that classifies as ``overflow``."""
+    vals, ts = burst(seed=0, n=512, t0=0, t1=5000)
+    obs = Observability(flight=FlightRecorder(capacity=64,
+                                              clock=ManualClock()),
+                        postmortem_dir=str(tmp_path / "pm"))
+    op = TpuWindowOperator(
+        config=EngineConfig(capacity=32, batch_size=64, annex_capacity=8,
+                            min_trigger_pad=32), obs=obs)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(10_000)
+    op.process_elements(vals, ts)
+    with pytest.raises(RuntimeError, match="overflow"):
+        op.process_watermark_arrays(5000)
+    bundles = list_postmortems(str(tmp_path / "pm"))
+    assert len(bundles) == 1
+    b = read_postmortem(bundles[0])
+    assert analyze(b)["cause"] == "overflow"
+    assert b["config"]["capacity"] == 32
+    assert any(e["kind"] == "overflow" for e in b["flight"]["events"])
+    assert postmortem_main(bundles[0], echo=lambda s: None) == 1
+
+
+# ---------------------------------------------------------------------------
+# health verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_flips_unhealthy_at_watermark_lag_threshold():
+    obs = Observability()
+    policy = HealthPolicy(max_watermark_lag_ms=100)
+    obs.gauge("watermark_lag_ms").set(60.0)
+    v = policy.verdict(obs)
+    assert v["healthy"] and v["checks"]["watermark_lag"]["ok"]
+    obs.gauge("watermark_lag_ms").set(101.0)     # crosses the threshold
+    v = policy.verdict(obs)
+    assert not v["healthy"]
+    assert not v["checks"]["watermark_lag"]["ok"]
+    obs.gauge("watermark_lag_ms").set(0.0)       # caught up again
+    assert policy.verdict(obs)["healthy"]
+    snap = obs.snapshot()
+    assert snap["health_checks"] == 3
+    assert snap["health_unhealthy"] == 1
+
+
+def test_stall_watchdog_flips_health_under_manual_clock():
+    """StallingSource + watchdog_source on a ManualClock: the stall is
+    flagged deterministically, lands in the flight ring, and the NEXT
+    health probe is unhealthy (recovering on the one after)."""
+    mc = ManualClock()
+    obs = Observability(flight=FlightRecorder(capacity=32, clock=mc))
+    policy = HealthPolicy()
+    assert policy.verdict(obs)["healthy"]        # baseline probe
+
+    src = StallingSource(list(range(8)), stall_at=[3], stall_s=5.0,
+                         clock=mc)
+    got = list(watchdog_source(src, stall_timeout_s=1.0, clock=mc,
+                               obs=obs))
+    assert got == list(range(8))                 # stream survived the stall
+    snap = obs.snapshot()
+    assert snap["resilience_stall_events"] == 1
+    stalls = [e for e in obs.flight.events() if e["kind"] == "stall"]
+    assert len(stalls) == 1 and stalls[0]["value"] == 5.0
+
+    v = policy.verdict(obs)
+    assert not v["healthy"]
+    assert not v["checks"]["stall_watchdog"]["ok"]
+    assert policy.verdict(obs)["healthy"]        # no NEW stalls since
+    assert obs.snapshot()["health_unhealthy"] == 1
+    # the unhealthy verdict itself is flight-recorded
+    assert any(e["kind"] == "health" for e in obs.flight.events())
+
+
+def test_pipeline_sync_samples_flight_with_zero_extra_syncs():
+    """The drain-point contract: running a fused pipeline with a flight
+    recorder attached lands watermark + counter/gauge samples in the
+    ring via the EXISTING sync, and the postmortem occupancy trend is
+    reconstructible from the gauge samples."""
+    obs = Observability(flight=FlightRecorder(capacity=256,
+                                              clock=ManualClock()))
+    p = pipeline_factory()
+    p.reset()
+    p.set_observability(obs)
+    for _ in range(3):
+        p.run(2)
+        p.sync()
+    ev = obs.flight.events()
+    wms = [e["value"] for e in ev if e["kind"] == "watermark"]
+    assert wms == [200.0, 400.0, 600.0]
+    assert any(e["kind"] == "gauge" and e["name"] == "slice_occupancy"
+               for e in ev)
+    assert any(e["kind"] == "counter" and e["name"] == "ingest_tuples"
+               for e in ev)

@@ -1,6 +1,7 @@
 """Tier-1 lint: the engine core stays silent (ISSUE 1 satellite; extended
-to connectors/ and bench/ in ISSUE 2), and nothing sleeps on the wall
-clock outside the injectable-clock module (ISSUE 3 satellite).
+to connectors/ and bench/ in ISSUE 2), nothing sleeps on the wall
+clock outside the injectable-clock module (ISSUE 3 satellite), and the
+obs layer never reads the wall clock directly (ISSUE 4 satellite).
 
 The reference's engine never logs — its only output was the benchmark-side
 throughput logger (SURVEY.md §5). The port preserves that discipline: all
@@ -80,3 +81,38 @@ def test_no_bare_time_sleep():
         "bare time.sleep in scotty_tpu — route waits through "
         "scotty_tpu.resilience.clock (injectable Clock): "
         + ", ".join(offenders))
+
+
+def _walltime_calls(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # time.time(...) / time.monotonic(...)
+        if (isinstance(f, ast.Attribute)
+                and f.attr in ("time", "monotonic")
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "time"):
+            yield f"{path}:{node.lineno}"
+        # from time import time/monotonic; time(...) / monotonic(...)
+        elif isinstance(f, ast.Name) and f.id in ("time", "monotonic"):
+            yield f"{path}:{node.lineno}"
+
+
+def test_no_bare_walltime_in_obs():
+    """ISSUE 4 satellite, mirroring the no-bare-sleep rule: flight
+    recorder / postmortem / export timestamps in ``scotty_tpu/obs/`` must
+    come from the injectable clock (``resilience.clock.Clock`` for
+    monotonic event time, ``resilience.clock.wall_time`` for export
+    rows) — never a bare ``time.time()``/``time.monotonic()`` — so chaos
+    tests can drive the whole operational layer on a ManualClock and
+    bundle timelines stay deterministic. ``time.perf_counter`` (relative
+    span durations) stays allowed."""
+    offenders = []
+    for path in sorted((PKG_ROOT / "obs").rglob("*.py")):
+        offenders.extend(_walltime_calls(path))
+    assert not offenders, (
+        "bare time.time()/time.monotonic() in scotty_tpu/obs/ — route "
+        "timestamps through scotty_tpu.resilience.clock (injectable "
+        "Clock / wall_time): " + ", ".join(offenders))

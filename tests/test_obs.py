@@ -86,6 +86,110 @@ def test_prometheus_exposition_format():
             assert name and float(value) is not None
 
 
+def test_prometheus_empty_registry_is_empty_exposition():
+    assert prometheus_text(Observability().registry) == ""
+
+
+def test_prometheus_zero_observation_histogram():
+    """A summary with no observations must not fabricate 0-valued
+    quantiles: NaN quantiles (the Prometheus convention), honest
+    ``_sum``/``_count``."""
+    obs = Observability()
+    obs.histogram("emit_latency_ms")
+    text = obs.prometheus()
+    assert "# TYPE scotty_emit_latency_ms summary" in text
+    assert 'scotty_emit_latency_ms{quantile="0.5"} nan' in text
+    assert "scotty_emit_latency_ms_sum 0.0" in text
+    assert "scotty_emit_latency_ms_count 0" in text
+
+
+def test_prometheus_type_lines_once_per_family():
+    """Two raw names sanitizing to one family: ONE ``# TYPE`` line, ONE
+    sample — a duplicate unlabeled sample for a series is an invalid
+    exposition a scraper rejects wholesale, so later same-family metrics
+    (same type OR conflicting type) are dropped with an explicit comment,
+    never silently."""
+    obs = Observability()
+    obs.counter("late.tuples").inc(1)          # both sanitize to
+    obs.counter("late_tuples").inc(2)          # scotty_late_tuples
+    obs.gauge("late tuples").set(9.0)          # same family, other type
+    text = obs.prometheus()
+    assert text.count("# TYPE scotty_late_tuples ") == 1
+    assert "# TYPE scotty_late_tuples counter" in text
+    samples = [ln for ln in text.splitlines()
+               if ln.startswith("scotty_late_tuples ")]
+    assert samples == ["scotty_late_tuples 1.0"]   # first wins, no dupes
+    assert text.count("dropped metric") == 2       # both drops announced
+
+
+def test_prometheus_help_and_name_sanitization():
+    from scotty_tpu.obs.exporters import escape_help, escape_label_value
+
+    obs = Observability()
+    obs.counter("1weird metric-name").inc(3)
+    text = prometheus_text(
+        obs.registry,
+        help_texts={"1weird metric-name": "line1\nline2 \\ done"})
+    # sanitized family: leading digit guarded, bad chars underscored
+    assert "scotty__1weird_metric_name 3.0" in text
+    assert "# HELP scotty__1weird_metric_name line1\\nline2 \\\\ done" \
+        in text
+    assert escape_help("a\nb\\c") == "a\\nb\\\\c"
+    assert escape_label_value('say "hi"\n') == 'say \\"hi\\"\\n'
+
+
+def test_report_degrades_gracefully_on_truncated_jsonl(tmp_path, capsys):
+    """The crashed-run export (ISSUE 4 satellite): a half-written final
+    line is counted and skipped, never raised."""
+    path = tmp_path / "crashed.jsonl"
+    path.write_text(
+        json.dumps({"t": 1.0, "ingest_tuples": 5.0}) + "\n"
+        + json.dumps({"t": 2.0, "ingest_tuples": 9.0}) + "\n"
+        + '{"t": 3.0, "ingest_tup')          # torn mid-write
+    summary = summarize(str(path))
+    assert summary["kind"] == "jsonl"
+    assert summary["rows"] == 2
+    assert summary["skipped_lines"] == 1
+    assert summary["metrics"]["ingest_tuples"]["last"] == 9.0
+    out = render(str(path))
+    assert "skipped: 1 truncated/corrupt line(s)" in out
+    assert report_main(["report", str(path)]) == 0
+    assert "skipped" in capsys.readouterr().out
+
+    # a torn single-object export and a torn bench list degrade too
+    (tmp_path / "torn.json").write_text('{"ingest_tuples": 5')
+    assert summarize(str(tmp_path / "torn.json"))["rows"] == 0
+    (tmp_path / "torn_list.json").write_text('[{"name": "x"}')
+    assert summarize(str(tmp_path / "torn_list.json"))["kind"] == "jsonl"
+    # binary garbage: skipped, not a UnicodeDecodeError
+    (tmp_path / "bin.jsonl").write_bytes(b"\xff\xfe{not json}\n")
+    assert summarize(str(tmp_path / "bin.jsonl"))["skipped_lines"] == 1
+
+
+def test_diff_gates_flight_and_health_counters(tmp_path):
+    """ISSUE 4 satellite: the default thresholds gate the operational
+    counters — wraparound drops or unhealthy verdicts APPEARING in a
+    candidate regress even though a clean baseline never exported the
+    keys."""
+    from scotty_tpu.obs.diff import DEFAULT_THRESHOLDS, diff_exports
+
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    base.write_text(json.dumps({"tuples_per_sec": 100.0}))
+    cand.write_text(json.dumps({"tuples_per_sec": 100.0,
+                                "flight_dropped_events": 5.0,
+                                "health_unhealthy": 2.0}))
+    findings = diff_exports(str(base), str(cand), DEFAULT_THRESHOLDS)
+    regressed = {f["metric"] for f in findings
+                 if f["status"] == "regressed"}
+    assert "flight_dropped_events" in regressed
+    assert "health_unhealthy" in regressed
+    # clean both ways stays clean
+    cand.write_text(json.dumps({"tuples_per_sec": 100.0}))
+    findings = diff_exports(str(base), str(cand), DEFAULT_THRESHOLDS)
+    assert not [f for f in findings if f["status"] == "regressed"]
+
+
 def test_jsonl_exporter_and_report(tmp_path):
     path = tmp_path / "metrics.jsonl"
     obs = Observability()

@@ -1,0 +1,179 @@
+"""Live /metrics · /vars · /healthz endpoint (ISSUE 4): serving a real
+Observability, answering correctly on a LIVE connector pipeline, and the
+opt-in ``serve_port`` wiring on the kafka/asyncio run loops."""
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+from scotty_tpu.connectors.base import (
+    KeyedScottyWindowOperator,
+    PeriodicWatermarks,
+)
+from scotty_tpu.connectors.kafka import KafkaScottyWindowOperator
+from scotty_tpu.obs import HealthPolicy, Observability
+from scotty_tpu.obs.server import serve
+from scotty_tpu.resilience import make_records
+
+
+def _get(port, path):
+    try:
+        r = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                   timeout=5)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_serve_metrics_vars_healthz_and_404():
+    obs = Observability()
+    obs.counter("ingest_tuples").inc(42)
+    obs.gauge("watermark_lag_ms").set(10.0)
+    obs.histogram("emit_latency_ms").observe(3.0)
+    with obs.serve(port=0) as srv:
+        code, text = _get(srv.port, "/metrics")
+        assert code == 200
+        assert "# TYPE scotty_ingest_tuples counter" in text
+        assert "scotty_ingest_tuples 42.0" in text
+
+        code, text = _get(srv.port, "/vars")
+        assert code == 200
+        body = json.loads(text)
+        assert body["metrics"]["ingest_tuples"] == 42.0
+
+        code, text = _get(srv.port, "/healthz")
+        assert code == 200
+        assert json.loads(text)["healthy"] is True
+
+        code, _ = _get(srv.port, "/nope")
+        assert code == 404
+    # every probe was itself counted (the health_* contract)
+    assert obs.snapshot()["health_checks"] == 1
+
+
+def test_healthz_http_codes_follow_the_lag_verdict():
+    obs = Observability()
+    obs.gauge("watermark_lag_ms").set(500.0)
+    with obs.serve(port=0,
+                   health=HealthPolicy(max_watermark_lag_ms=100)) as srv:
+        code, text = _get(srv.port, "/healthz")
+        assert code == 503
+        v = json.loads(text)
+        assert not v["healthy"]
+        assert not v["checks"]["watermark_lag"]["ok"]
+        obs.gauge("watermark_lag_ms").set(5.0)
+        code, _ = _get(srv.port, "/healthz")
+        assert code == 200
+    assert obs.snapshot()["health_unhealthy"] == 1
+
+
+def test_provider_server_answers_503_between_cells():
+    """The bench runner serves ONE endpoint across cells via a provider;
+    with no live cell it answers 503 instead of crashing."""
+    live = {"obs": None}
+    with serve(lambda: live["obs"], port=0) as srv:
+        code, _ = _get(srv.port, "/metrics")
+        assert code == 503
+        live["obs"] = Observability()
+        live["obs"].counter("ingest_tuples").inc(1)
+        code, text = _get(srv.port, "/metrics")
+        assert code == 200 and "scotty_ingest_tuples 1.0" in text
+
+
+def test_kafka_run_serves_live_pipeline(tmp_path):
+    """serve_port on the kafka run() loop: the endpoint answers while the
+    connector pipeline is LIVE — a mid-stream record probes /metrics and
+    /healthz from inside the consumer iterable — and the server is gone
+    after run() returns."""
+    obs = Observability()
+    kop = KafkaScottyWindowOperator(
+        operator=KeyedScottyWindowOperator(
+            watermark_policy=PeriodicWatermarks(100), obs=obs))
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+
+    kop.operator.add_window(TumblingWindow(WindowMeasure.Time, 200))
+    kop.operator.add_aggregation(SumAggregation())
+    records = make_records(seed=7, n=60, keys=2, period_ms=10)
+    probes = []
+
+    def consumer():
+        for r in records[:40]:
+            yield r
+        # mid-stream: the loop is live, the server is up
+        port = kop.obs_server.port
+        probes.append(_get(port, "/metrics"))
+        probes.append(_get(port, "/healthz"))
+        for r in records[40:]:
+            yield r
+
+    out = []
+    n = kop.run(consumer(), on_result=out.append, serve_port=0)
+    assert n == len(records) and out
+    assert kop.obs_server is None               # closed after the loop
+    (m_code, m_text), (h_code, h_text) = probes
+    assert m_code == 200
+    assert "scotty_ingest_tuples 40.0" in m_text
+    assert "scotty_watermarks" in m_text
+    assert h_code == 200 and json.loads(h_text)["healthy"]
+
+
+def test_run_loop_forwards_health_policy():
+    """The run-loop wirings forward ``health=`` to serve(), so the
+    watermark-lag check is configurable on a served connector loop —
+    and the operator declares ``obs_server`` (None) even before any
+    served run."""
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+
+    obs = Observability()
+    kop = KafkaScottyWindowOperator(
+        operator=KeyedScottyWindowOperator(
+            watermark_policy=PeriodicWatermarks(100), obs=obs))
+    assert kop.operator.obs_server is None      # declared, not ad hoc
+    kop.operator.add_window(TumblingWindow(WindowMeasure.Time, 200))
+    kop.operator.add_aggregation(SumAggregation())
+    obs.gauge("watermark_lag_ms").set(900.0)    # a badly lagging stream
+    records = make_records(seed=3, n=20, keys=2, period_ms=10)
+    probes = []
+
+    def consumer():
+        for r in records[:10]:
+            yield r
+        probes.append(_get(kop.obs_server.port, "/healthz"))
+        for r in records[10:]:
+            yield r
+
+    kop.run(consumer(), on_result=lambda *_: None, serve_port=0,
+            health=HealthPolicy(max_watermark_lag_ms=100))
+    code, text = probes[0]
+    assert code == 503
+    assert not json.loads(text)["checks"]["watermark_lag"]["ok"]
+
+
+def test_asyncio_run_serves_live_pipeline():
+    """serve_port on run_keyed_async: probed mid-stream via the source
+    (run_in_executor keeps the event loop honest), closed afterwards."""
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.connectors.asyncio_connector import run_keyed_async
+
+    obs = Observability()
+    op = KeyedScottyWindowOperator(
+        windows=[TumblingWindow(WindowMeasure.Time, 100)],
+        aggregations=[SumAggregation()], obs=obs)
+    probes = []
+
+    async def source():
+        loop = asyncio.get_running_loop()
+        for t in range(0, 400, 10):
+            if t == 200:
+                port = op.obs_server.port
+                probes.append(await loop.run_in_executor(
+                    None, _get, port, "/healthz"))
+            yield ("k", 1.0, t)
+
+    out = []
+    asyncio.run(run_keyed_async(source(), op, emit=out.append,
+                                serve_port=0))
+    assert out
+    assert op.obs_server is None
+    assert probes and probes[0][0] == 200
