@@ -75,6 +75,14 @@ def __getattr__(name):
         from .parallel import GlobalTpuWindowOperator
 
         return GlobalTpuWindowOperator
+    if name == "StreamShaper":
+        from .shaper import StreamShaper
+
+        return StreamShaper
+    if name == "ShaperConfig":
+        from .shaper import ShaperConfig
+
+        return ShaperConfig
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -87,4 +95,5 @@ __all__ = [
     "SlicingWindowOperator", "MemoryStateFactory", "StateFactory",
     "HybridWindowOperator", "TpuWindowOperator", "EngineConfig",
     "KeyedTpuWindowOperator", "GlobalTpuWindowOperator",
+    "StreamShaper", "ShaperConfig",
 ]

@@ -157,6 +157,12 @@ class BenchmarkConfig:
     #: "fail" (the benchmarked default — BASELINE.md numbers are FAIL),
     #: "shed" or "grow" (scotty_tpu.resilience) for degraded-mode A/Bs
     overflow_policy: str = "fail"
+    #: ShaperConfig.late_capacity for the ShapedOOO cell (ISSUE 5);
+    #: 0 = the shaper default, max(64, batch_size // 8)
+    shaper_late_capacity: int = 0
+    #: inter-batch disorder back-reach (event-ms) of the ShapedOOO cell's
+    #: adversarial stream; 0 = min(max_lateness, batch span / 8)
+    shaper_back_ms: int = 0
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -180,6 +186,8 @@ class BenchmarkConfig:
             session_config=raw.get("sessionConfig"),
             legacy_generator=raw.get("legacyGenerator", False),
             overflow_policy=raw.get("overflowPolicy", "fail"),
+            shaper_late_capacity=raw.get("shaperLateCapacity", 0),
+            shaper_back_ms=raw.get("shaperBackMs", 0),
         )
 
 

@@ -14,6 +14,12 @@ stops being blind (VERDICT r1 item 9):
 * ``gc``                — slice-buffer roll
 * ``host_pack``         — keyed host packing (lexsort + [K, B] scatter),
   no device work
+* ``shape_sort_split``  — the shaper's jitted sort-and-split alone
+  (scotty_tpu.shaper.device, ISSUE 5)
+* ``ingest_shaped_ooo`` — a DISORDERED device-resident stream through
+  the shaper end-to-end (sort-split + dense in-order ingest + late
+  residue) — the number to hold against ``ingest_scatter``, which is
+  what the same stream costs unshaped
 
 Run: ``python -m scotty_tpu.bench.micro [--out bench_results/micro.json]``.
 Each phase reports mean/min ms per dispatch and derived tuples/s where
@@ -31,16 +37,27 @@ import numpy as np
 
 
 def _time_phase(fn: Callable[[], None], sync: Callable[[], None],
-                iters: int, warmup: int = 2) -> dict:
+                iters: int, warmup: int = 2,
+                drain: Optional[Callable[[], None]] = None) -> dict:
     """Amortized per-dispatch timing: ``iters`` back-to-back dispatches,
     ONE true sync (``sync`` must be a ``jax.device_get`` of a value the
     work produced — ``block_until_ready`` is not a reliable barrier on
     tunneled devices, docs/DESIGN.md). The final sync's round trip is
     measured on an idle queue and subtracted; the per-dispatch mean
-    still includes per-dispatch overhead."""
+    still includes per-dispatch overhead.
+
+    ``drain`` retires the WHOLE async dispatch queue (block_until_ready
+    over every live device value of the run) before the timed sections.
+    ``sync`` alone only waits for this phase's own output — work queued
+    by a PREVIOUS section can still be in flight behind it, and that
+    work then lands inside this phase's "idle-queue" sync measurement
+    (micro.json showed query.sync_ms 124.8 ms > its own mean_ms 70.7 ms
+    — queued prior work misattributed to a later section's sync)."""
     for _ in range(warmup):
         fn()
     sync()
+    if drain is not None:
+        drain()                         # the queue is now REALLY idle
     t0 = time.perf_counter()
     sync()                              # idle-queue sync = pure round trip
     sync_ms = (time.perf_counter() - t0) * 1e3
@@ -91,6 +108,18 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     results: dict = {"shapes": {"capacity": C, "annex": A, "batch": B,
                                 "triggers": Tq, "small": small}}
 
+    # every live device value of the run, as thunks: the inter-section
+    # dispatch-queue drain blocks on ALL of them, so no section's timing
+    # inherits queued work from a previous section (see _time_phase)
+    live_thunks: list = []
+
+    def drain():
+        vals = [t() for t in live_thunks]
+        for leaf in jax.tree_util.tree_leaves(
+                [v for v in vals if v is not None]):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()
+
     # ---- ingest (general scatter path) -----------------------------------
     ingest = jax.jit(ec.build_ingest(spec, C, A), donate_argnums=0)
     grid = spec.periods[0]
@@ -108,7 +137,8 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     def sync():
         jax.device_get(holder["st"].n_slices)
 
-    r = _time_phase(do_ingest, sync, iters)
+    live_thunks.append(lambda: holder["st"])
+    r = _time_phase(do_ingest, sync, iters, drain=drain)
     r["tuples_per_s"] = _rate(B, r["mean_ms"])
     results["ingest_scatter"] = r
 
@@ -118,7 +148,7 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     def do_gc():
         holder["st"] = gc(holder["st"], np.int64(holder["i"] * 2 * B))
 
-    results["gc"] = _time_phase(do_gc, sync, iters)
+    results["gc"] = _time_phase(do_gc, sync, iters, drain=drain)
 
     # ---- query ------------------------------------------------------------
     query = jax.jit(ec.build_query(spec, C, A))
@@ -137,7 +167,8 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     def sync_q():
         jax.device_get(out_holder["out"][0][0])
 
-    r = _time_phase(do_query, sync_q, iters)
+    live_thunks.append(lambda: out_holder.get("out"))
+    r = _time_phase(do_query, sync_q, iters, drain=drain)
     r["windows_per_s"] = _rate(Tq, r["mean_ms"])
     results["query"] = r
 
@@ -147,7 +178,7 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     def do_merge():
         holder["st"] = merge(holder["st"])
 
-    results["annex_merge"] = _time_phase(do_merge, sync, iters)
+    results["annex_merge"] = _time_phase(do_merge, sync, iters, drain=drain)
 
     # ---- aligned fused interval ------------------------------------------
     p = AlignedStreamPipeline(
@@ -161,7 +192,12 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     def do_aligned():
         p.run(1, collect=False)
 
-    r = _time_phase(do_aligned, lambda: p.sync(), iters)
+    def _pipeline_drain():
+        p.sync()
+        return None
+
+    live_thunks.append(_pipeline_drain)
+    r = _time_phase(do_aligned, lambda: p.sync(), iters, drain=drain)
     r["tuples_per_s"] = _rate(p.tuples_per_interval, r["mean_ms"])
     results["ingest_aligned"] = r
     p.check_overflow()
@@ -187,7 +223,7 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
         ts_b[k2[m], lane[m]] = t2[m]
         return ts_b
 
-    r = _time_phase(do_pack, lambda: None, iters)
+    r = _time_phase(do_pack, lambda: None, iters, drain=drain)
     r["tuples_per_s"] = _rate(Np, r["mean_ms"])
     results["host_pack"] = r
 
@@ -207,8 +243,9 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     def do_sf():
         sc_holder["f32"] = scatter_f32(sc_holder["f32"])
 
+    live_thunks.append(lambda: (sc_holder["f32"], sc_holder["i64"]))
     r = _time_phase(do_sf, lambda: jax.device_get(sc_holder["f32"][0]),
-                    iters)
+                    iters, drain=drain)
     r["lanes"] = Bs
     results["scatter_f32_add"] = r
 
@@ -216,9 +253,81 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
         sc_holder["i64"] = scatter_i64(sc_holder["i64"])
 
     r = _time_phase(do_si, lambda: jax.device_get(sc_holder["i64"][0]),
-                    iters)
+                    iters, drain=drain)
     r["lanes"] = Bs
     results["scatter_i64_min"] = r
+
+    # ---- shaper sort-and-split kernel alone (ISSUE 5) --------------------
+    from ..shaper.device import I64_MIN, init_shaper_stats, \
+        sort_split_kernel
+
+    late_cap = max(64, B // 8)
+    ss_kern = sort_split_kernel(B, late_cap)
+    ts_ooo = rng.integers(0, B * 2, size=B).astype(np.int64)  # UNSORTED
+    ss_holder = {"stats": init_shaper_stats()}
+    cut0 = np.int64(I64_MIN)
+
+    def do_ss():
+        out = ss_kern(ss_holder["stats"], ts_ooo, vals, valid, cut0, cut0)
+        ss_holder["stats"] = out[0]
+        ss_holder["out"] = out[1:]
+
+    def sync_ss():
+        jax.device_get(ss_holder["out"][0][0])
+
+    live_thunks.append(lambda: (ss_holder["stats"],
+                                ss_holder.get("out")))
+    r = _time_phase(do_ss, sync_ss, iters, drain=drain)
+    r["tuples_per_s"] = _rate(B, r["mean_ms"])
+    results["shape_sort_split"] = r
+
+    # ---- shaped OOO ingest end-to-end (ISSUE 5) --------------------------
+    # the SAME disordered device-resident stream class ingest_scatter
+    # pays the general kernel for: per-batch uniform draws (unsorted
+    # arrival order) with a bounded back-reach into the previous batch's
+    # range, taken through StreamShaper.shape_device_batch — sort-split
+    # + dense/in-order ingest + the small late-residue dispatch
+    from ..engine import TpuWindowOperator
+    from ..shaper import ShaperConfig, StreamShaper
+
+    from ..core.windows import TumblingWindow
+
+    span = 2 * B                    # event-ms per batch (ingest_scatter's)
+    back = max(1, span // 32)       # bounded inter-batch disorder reach
+    op_sh = TpuWindowOperator(config=EngineConfig(
+        capacity=C, annex_capacity=A, batch_size=B, min_trigger_pad=32))
+    # a window whose grid keeps ~iters un-GC'd batches inside `capacity`
+    # (the timed loop never watermarks; the grid-1 sliding spec of the
+    # scatter cell would blow the slice buffer at full shapes)
+    w_grid = max(1000, span // 8)
+    op_sh.add_window_assigner(TumblingWindow(WindowMeasure.Time, w_grid))
+    op_sh.add_aggregation(SumAggregation())
+    op_sh.set_max_lateness(span + back)
+    shaper = StreamShaper(op_sh, ShaperConfig(late_capacity=late_cap))
+    ts_sh = rng.integers(0, span + back, size=B).astype(np.int64)
+    sh2 = {"i": 1}                  # start a span in so ts never go < 0
+
+    def do_shaped():
+        off = sh2["i"] * span
+        sh2["i"] += 1
+        # batch i covers [i*span - back, i*span + span): the `back` head
+        # reaches into batch i-1's range — the actually-late fraction
+        shaper.shape_device_batch(vals, ts_sh + (off - back),
+                                  off - back, off + span)
+
+    def sync_sh():
+        jax.device_get(op_sh._state.n_slices)
+
+    live_thunks.append(lambda: op_sh._state)
+    r = _time_phase(do_shaped, sync_sh, iters, drain=drain)
+    r["tuples_per_s"] = _rate(B, r["mean_ms"])
+    r["late_capacity"] = late_cap
+    if results["ingest_scatter"]["mean_ms"] > 0:
+        r["speedup_vs_scatter"] = (results["ingest_scatter"]["mean_ms"]
+                                   / r["mean_ms"])
+    results["ingest_shaped_ooo"] = r
+    shaper.check()
+    op_sh.check_overflow()
 
     results["platform"] = jax.devices()[0].platform
     return results

@@ -387,7 +387,158 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if engine == "KeyedHostFed":
         return run_keyed_host_fed_cell(cfg, window_spec, agg_name, obs=obs)
 
+    if engine == "ShapedOOO":
+        return run_shaped_ooo_cell(cfg, window_spec, agg_name, obs=obs)
+
     raise ValueError(f"unknown engine {engine!r}")
+
+
+def run_shaped_ooo_cell(cfg: BenchmarkConfig, window_spec: str,
+                        agg_name: str,
+                        obs: Optional[_obs.Observability] = None
+                        ) -> BenchResult:
+    """Shaped out-of-order cell (ISSUE 5): an ADVERSARIALLY DISORDERED
+    device-resident stream — every batch fully shuffled, with a bounded
+    back-reach into the previous batch's event range — taken through
+    ``StreamShaper.shape_device_batch`` end to end: jitted sort-and-split,
+    the in-order majority through the scatter-free dense/in-order ingest,
+    the late residue through the small ``ingest_device_late`` dispatch,
+    plus the normal watermark cadence. This is the general-traffic
+    counterpart of the shaped ``TpuEngine`` cells: the stream is NOT
+    pipeline-generated, NOT sorted, and NOT aligned — the number to hold
+    against ``micro.json: ingest_scatter`` (the same stream unshaped)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import EngineConfig, TpuWindowOperator
+    from ..shaper import ShaperConfig, StreamShaper
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    B = cfg.batch_size
+    n_batches = int(max(4, cfg.throughput * cfg.runtime_s // B))
+    span = max(1.0, cfg.runtime_s * 1000 / n_batches)
+    back = cfg.shaper_back_ms or max(1, min(cfg.max_lateness,
+                                            int(span) // 8))
+
+    # pregenerate a cycled pool of shuffled base batches ON DEVICE (the
+    # stream's origin is device memory — generation cost is the load
+    # generator's, excluded like every other cell); per-batch offsets are
+    # added lazily on device, which is part of the source's cost model
+    rng = np.random.default_rng(cfg.seed)
+    P = min(n_batches, 16)
+    pool = []
+    for _ in range(P):
+        ts = rng.integers(0, int(span) + back, size=B).astype(np.int64)
+        vals = (rng.random(B) * 10_000).astype(np.float32)
+        pool.append((jax.device_put(vals), jax.device_put(ts)))
+
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=cfg.capacity, batch_size=B,
+        overflow_policy=cfg.overflow_policy))
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(make_aggregation(agg_name))
+    op.set_max_lateness(max(cfg.max_lateness, back + int(span)))
+    # default residue lanes at B/4: the adversarial stream's expected
+    # late fraction is back/(span+back) ≈ 11%, so the static late block
+    # runs near half-full — exercised every batch, never overflowing
+    late_cap = cfg.shaper_late_capacity or max(64, B // 4)
+    # refuse mis-sized geometries UP FRONT: at tiny spans (high
+    # throughput / small batches) the integer span collapses and the
+    # late fraction back/(int(span)+back) can exceed the residue lanes —
+    # the run would only die in ShaperOverflow at the final drain
+    exp_late = B * back / (int(span) + back)
+    if exp_late * 1.5 > late_cap:
+        raise ValueError(
+            f"ShapedOOO geometry: expected late fraction "
+            f"{back}/({int(span)}+{back}) of batch_size {B} ≈ "
+            f"{exp_late:.0f} tuples ≥ late_capacity {late_cap} — lower "
+            "throughput (longer span per batch), shrink shaperBackMs, or "
+            "raise shaperLateCapacity")
+    shaper = StreamShaper(op, ShaperConfig(late_capacity=late_cap))
+
+    def feed(i: int) -> int:
+        # batch i covers [i*span - back, i*span + span): shuffled within,
+        # reaching `back` ms into batch i-1's range
+        off = int((i + 1) * span)
+        v_dev, t_dev = pool[i % P]
+        lo = off - back
+        shaper.shape_device_batch(v_dev, t_dev + jnp.int64(lo), lo,
+                                  off + int(span))
+        return off + int(span)
+
+    # warmup: compiles sort-split + ingest + watermark kernels
+    hi = feed(0)
+    hi = feed(1)
+    warm_wm = hi + 1
+    op.process_watermark_async(warm_wm)
+    jax.device_get(op._state.n_slices)
+    if obs is not None:
+        op.set_observability(obs)
+        obs.registry.reset_clock()
+
+    next_wm = (warm_wm // cfg.watermark_period_ms + 1) \
+        * cfg.watermark_period_ms
+    pending = []
+    t0 = time.perf_counter()
+    for i in range(2, n_batches):
+        hi = feed(i)
+        while hi - back - int(span) >= next_wm:
+            # watermark only once the back-reach can no longer repair it
+            out = op.process_watermark_async(next_wm)
+            if out[3] is not None:
+                pending.append((out[0].shape[0], out[3]))
+            next_wm += cfg.watermark_period_ms
+    out = op.process_watermark_async(next_wm)
+    if out[3] is not None:
+        pending.append((out[0].shape[0], out[3]))
+    emitted = 0
+    fetched = jax.device_get([c for _, c in pending])
+    for (T, _), cnt in zip(pending, fetched):
+        emitted += int((cnt[:T] > 0).sum())
+    op.check_overflow()                 # includes shaper.check()
+    wall = time.perf_counter() - t0
+    n_tuples = (n_batches - 2) * B
+    if obs is not None:
+        obs.registry.stop_clock()
+        op.set_observability(None)
+
+    # drained emit-latency samples: one shaped batch + watermark each,
+    # time-shifted past the stream end (the shaped delivery path)
+    lats = []
+    cursor = int(next_wm + 2 * (int(span) + back))
+    v0, t0_dev = pool[0]
+    t_lat = time.perf_counter()
+    for _ in range(LATENCY_SAMPLES_MAX):
+        jax.device_get(op._state.n_slices)
+        t1 = time.perf_counter()
+        shaper.shape_device_batch(v0, t0_dev + jnp.int64(cursor), cursor,
+                                  cursor + int(span) + back)
+        out = op.process_watermark_async(cursor + int(span) + back + 1)
+        if out[3] is not None:
+            jax.device_get((out[3], out[4]))
+        else:
+            jax.device_get(op._state.n_slices)
+        lats.append((time.perf_counter() - t1) * 1e3)
+        cursor += 2 * (int(span) + back) + cfg.watermark_period_ms
+        if (len(lats) >= LATENCY_SAMPLES_MIN
+                and time.perf_counter() - t_lat > LATENCY_BUDGET_S):
+            break
+    op.check_overflow()
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=float(np.percentile(lats, 99)) if lats else 0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    res.n_lat_samples = len(lats)
+    res.p50_emit_ms = float(np.percentile(lats, 50)) if lats else 0.0
+    res.shaper_back_ms = back
+    stats = shaper.device_stats()
+    res.shaper_late_routed = stats.get("late_routed", 0)
+    res.shaper_reordered = stats.get("reordered", 0)
+    finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
+    return res
 
 
 def run_host_fed_cell(cfg: BenchmarkConfig, window_spec: str,
@@ -863,7 +1014,8 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                               "p50_emit_ms", "emit_ms_device",
                               "p99_emit_ms_trimmed", "n_stall_samples",
                               "n_trimmed_samples", "stall_flagged",
-                              "tail_unattributed"):
+                              "tail_unattributed", "shaper_back_ms",
+                              "shaper_late_routed", "shaper_reordered"):
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)

@@ -28,6 +28,7 @@ async def run_keyed_async(
         obs=None,
         serve_port: Optional[int] = None,
         health=None,
+        shaper=None,
 ) -> None:
     """Consume (key, value, ts) from an async iterator; call ``emit`` for
     every (key, AggregateWindow) result. ``emit`` may be sync or async.
@@ -39,7 +40,13 @@ async def run_keyed_async(
     loop; ``0`` binds an ephemeral port, read back from
     ``operator.obs_server.port`` while running. ``health`` is the
     :class:`scotty_tpu.obs.HealthPolicy` behind ``/healthz``
-    (``HealthPolicy(max_watermark_lag_ms=...)`` arms the lag check)."""
+    (``HealthPolicy(max_watermark_lag_ms=...)`` arms the lag check).
+
+    ``shaper`` (a :class:`scotty_tpu.shaper.ShaperConfig`, ISSUE 5)
+    attaches the coalescing/sorting front-end to the operator for this
+    run; held records drain through ``emit`` when the source ends."""
+    if shaper is not None:
+        operator.attach_shaper(shaper)
     own_obs = obs if obs is not None and obs is not operator.obs else None
     eff_obs = obs if obs is not None else operator.obs
     server = None
@@ -57,6 +64,10 @@ async def run_keyed_async(
                 r = emit(item)
                 if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
                     await r
+        for item in operator.drain_shaper():
+            r = emit(item)
+            if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
+                await r
     finally:
         if server is not None:
             server.close()

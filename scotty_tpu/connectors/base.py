@@ -110,7 +110,9 @@ class KeyedScottyWindowOperator:
                  backend: str = "host",
                  n_key_shards: int = 64,
                  engine_config=None,
-                 obs=None):
+                 obs=None,
+                 shaper=None,
+                 shaper_clock=None):
         self.windows: List[Window] = list(windows or [])
         self.aggregations: List[AggregateFunction] = list(aggregations or [])
         # reference default allowedLateness = 1 ms
@@ -128,6 +130,64 @@ class KeyedScottyWindowOperator:
         self._key_lanes: Dict[Hashable, int] = {}
         self._lane_keys: List[Hashable] = []
         self._device_op = None
+        # stream shaper (ISSUE 5): coalesce + reorder-slack-sort records
+        # before the per-key operators see them, replacing the raw
+        # per-record trickle for out-of-order host streams
+        self._shaper = None
+        self._shaper_results: List[Tuple[Hashable, AggregateWindow]] = []
+        self._in_replay = False
+        if shaper is not None:
+            self.attach_shaper(shaper, clock=shaper_clock)
+
+    def attach_shaper(self, config, clock=None) -> None:
+        """Attach a :class:`scotty_tpu.shaper.ShaperConfig`-driven
+        front-end: ``process_element`` then buffers records through the
+        coalescing/sorting accumulator and replays flushed blocks in
+        sorted order (watermark policy observes during replay, so the
+        per-key operators see a shaped stream). ``process_watermark``
+        and the run loops drain held records first."""
+        from ..shaper import ShaperConfig, StreamShaper
+
+        if not isinstance(config, ShaperConfig):
+            raise TypeError("attach_shaper expects a ShaperConfig, got "
+                            f"{type(config).__name__}")
+        B = config.batch_size or getattr(self.engine_config, "batch_size",
+                                         None) or 1024
+        import dataclasses
+
+        self._shaper = StreamShaper(
+            config=dataclasses.replace(config, batch_size=B),
+            sink=self._replay_block, keyed=True, clock=clock,
+            obs=self.obs, value_dtype=None)
+
+    def _replay_block(self, keys, vals, tss) -> None:
+        # replay must NOT re-enter drain_shaper: a policy-fired watermark
+        # mid-replay would force-flush the reorder-slack band, undoing
+        # the shaping (and re-emitting already-fired windows as late
+        # updates the unshaped sorted run never produces)
+        self._in_replay = True
+        try:
+            for k, v, t in zip(keys, vals, tss.tolist()):
+                # compute BEFORE looking up the list: a fired watermark
+                # pops and REBINDS _shaper_results mid-call, and
+                # extending the pre-pop binding would strand results on
+                # an orphaned list
+                r = self._process_element_now(k, v, int(t))
+                self._shaper_results.extend(r)
+        finally:
+            self._in_replay = False
+
+    def drain_shaper(self) -> List[Tuple[Hashable, AggregateWindow]]:
+        """Flush everything the shaper holds (stream end / external
+        watermark); returns results emitted during the replay — plus any
+        undelivered results a restore() brought back. No-op while a
+        replay is already in flight."""
+        if self._in_replay:
+            return []
+        if self._shaper is not None:
+            self._shaper.flush()
+        out, self._shaper_results = self._shaper_results, []
+        return out
 
     # -- builder API (README.md:31-42 chaining) ----------------------------
     def add_window(self, window: Window) -> "KeyedScottyWindowOperator":
@@ -190,7 +250,17 @@ class KeyedScottyWindowOperator:
     def process_element(self, key: Hashable, value: Any, ts: int
                         ) -> List[Tuple[Hashable, AggregateWindow]]:
         """Feed one tuple; returns window results if this tuple's ts advanced
-        the watermark (the connector emit path)."""
+        the watermark (the connector emit path). With an attached shaper
+        the record buffers first and results surface when a block
+        flushes (sorted replay)."""
+        if self._shaper is not None:
+            self._shaper.offer(value, int(ts), key=key)
+            out, self._shaper_results = self._shaper_results, []
+            return out
+        return self._process_element_now(key, value, ts)
+
+    def _process_element_now(self, key: Hashable, value: Any, ts: int
+                             ) -> List[Tuple[Hashable, AggregateWindow]]:
         if self.obs is not None:
             self.obs.counter(_obs.INGEST_TUPLES).inc()
             wm_cur = self.policy.current_watermark()
@@ -223,10 +293,18 @@ class KeyedScottyWindowOperator:
             raise NotImplementedError(
                 "device-backend connectors checkpoint through "
                 "utils.checkpoint.save_keyed_operator")
+        # records held in an attached shaper count as consumed by the
+        # supervisor's source offset: replay them into the per-key
+        # operators first, and persist any results that replay emitted
+        # so a restore can still deliver them
+        if self._shaper is not None:
+            drained = self.drain_shaper()   # pops + REBINDS the list
+            self._shaper_results.extend(drained)
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "keyed_connector.pkl"), "wb") as f:
             pickle.dump({"host_ops": self._host_ops, "policy": self.policy,
-                         "allowed_lateness": self.allowed_lateness}, f)
+                         "allowed_lateness": self.allowed_lateness,
+                         "shaper_results": list(self._shaper_results)}, f)
 
     def restore(self, path: str) -> None:
         """Restore a :meth:`save` snapshot into a freshly-configured
@@ -243,8 +321,17 @@ class KeyedScottyWindowOperator:
                 f"{self.allowed_lateness} — configure them identically")
         self._host_ops = snap["host_ops"]
         self.policy = snap["policy"]
+        # results the checkpoint's shaper drain emitted but the run loop
+        # never collected — surfaced by the next process_element /
+        # process_watermark so a restored run still delivers them
+        self._shaper_results = list(snap.get("shaper_results", []))
 
     def process_watermark(self, wm: int) -> List[Tuple[Hashable, AggregateWindow]]:
+        # held shaper records are about to fall behind this watermark:
+        # drain them first (their replay may itself fire policy
+        # watermarks — those results lead this one's and were already
+        # counted by their own firings)
+        pre: List[Tuple[Hashable, AggregateWindow]] = self.drain_shaper()
         out: List[Tuple[Hashable, AggregateWindow]] = []
         if self.backend == "device":
             if self._device_op is not None:
@@ -261,7 +348,7 @@ class KeyedScottyWindowOperator:
             self.obs.flight_event("watermark", "watermark", float(wm))
             if out:
                 self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
-        return out
+        return pre + out
 
 
 class GlobalScottyWindowOperator:
@@ -275,7 +362,9 @@ class GlobalScottyWindowOperator:
                  backend: str = "host",
                  n_shards: int = 8,
                  engine_config=None,
-                 obs=None):
+                 obs=None,
+                 shaper=None,
+                 shaper_clock=None):
         self.windows = list(windows or [])
         self.aggregations = list(aggregations or [])
         self.allowed_lateness = allowed_lateness
@@ -285,6 +374,47 @@ class GlobalScottyWindowOperator:
         self.engine_config = engine_config
         self.obs = obs
         self._op = None
+        self._shaper = None
+        self._shaper_results: List[AggregateWindow] = []
+        self._in_replay = False
+        if shaper is not None:
+            self.attach_shaper(shaper, clock=shaper_clock)
+
+    def attach_shaper(self, config, clock=None) -> None:
+        """Global-stream analogue of
+        :meth:`KeyedScottyWindowOperator.attach_shaper`."""
+        from ..shaper import ShaperConfig, StreamShaper
+
+        if not isinstance(config, ShaperConfig):
+            raise TypeError("attach_shaper expects a ShaperConfig, got "
+                            f"{type(config).__name__}")
+        B = config.batch_size or getattr(self.engine_config, "batch_size",
+                                         None) or 1024
+        import dataclasses
+
+        self._shaper = StreamShaper(
+            config=dataclasses.replace(config, batch_size=B),
+            sink=self._replay_block, keyed=False, clock=clock,
+            obs=self.obs, value_dtype=None)
+
+    def _replay_block(self, vals, tss) -> None:
+        # no drain re-entry, compute-then-extend — see the keyed
+        # operator's _replay_block for both invariants
+        self._in_replay = True
+        try:
+            for v, t in zip(vals, tss.tolist()):
+                r = self._process_element_now(v, int(t))
+                self._shaper_results.extend(r)
+        finally:
+            self._in_replay = False
+
+    def drain_shaper(self) -> List[AggregateWindow]:
+        if self._in_replay:
+            return []
+        if self._shaper is not None:
+            self._shaper.flush()
+        out, self._shaper_results = self._shaper_results, []
+        return out
 
     def add_window(self, window: Window) -> "GlobalScottyWindowOperator":
         self.windows.append(window)
@@ -313,6 +443,14 @@ class GlobalScottyWindowOperator:
         return self._op
 
     def process_element(self, value: Any, ts: int) -> List[AggregateWindow]:
+        if self._shaper is not None:
+            self._shaper.offer(value, int(ts))
+            out, self._shaper_results = self._shaper_results, []
+            return out
+        return self._process_element_now(value, ts)
+
+    def _process_element_now(self, value: Any, ts: int
+                             ) -> List[AggregateWindow]:
         if self.obs is not None:
             self.obs.counter(_obs.INGEST_TUPLES).inc()
             wm_cur = self.policy.current_watermark()
@@ -326,6 +464,9 @@ class GlobalScottyWindowOperator:
         return []
 
     def process_watermark(self, wm: int) -> List[AggregateWindow]:
+        # drained-replay results were already counted by their own nested
+        # watermark firings — only this watermark's emissions count here
+        pre = self.drain_shaper()
         out = [w for w in self._operator().process_watermark(wm)
                if w.has_value()]
         if self.obs is not None:
@@ -333,4 +474,4 @@ class GlobalScottyWindowOperator:
             self.obs.flight_event("watermark", "watermark", float(wm))
             if out:
                 self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
-        return out
+        return pre + out

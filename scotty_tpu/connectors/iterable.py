@@ -22,16 +22,24 @@ from .base import GlobalScottyWindowOperator, KeyedScottyWindowOperator
 
 def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
               obs=None, dead_letter=None,
-              poison_limit: int | None = None) -> Iterator[Tuple]:
+              poison_limit: int | None = None,
+              shaper=None) -> Iterator[Tuple]:
     """Drive a keyed operator from an iterable of (key, value, ts); yields
     (key, AggregateWindow) results as watermarks fire.
 
     Records that fail to destructure or whose ts is not integral are
     POISON (ISSUE 3): counted, handed to ``dead_letter(record, exc)`` and
     skipped instead of killing the loop — engine errors still propagate.
+
+    ``shaper`` (a :class:`scotty_tpu.shaper.ShaperConfig`, ISSUE 5)
+    attaches the coalescing/sorting front-end to the operator for this
+    run: records buffer into sorted blocks instead of trickling one at a
+    time, and anything still held drains when the source ends.
     """
     from ..resilience.connectors import PoisonHandler
 
+    if shaper is not None:
+        operator.attach_shaper(shaper)
     own_obs = obs if obs is not None and obs is not operator.obs else None
     poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                            obs=obs if obs is not None else operator.obs)
@@ -49,15 +57,22 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
                 own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
         for item in items:
             yield item
+    for item in operator.drain_shaper() if hasattr(operator, "drain_shaper") \
+            else ():
+        yield item
 
 
 def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
                obs=None, dead_letter=None,
-               poison_limit: int | None = None) -> Iterator:
+               poison_limit: int | None = None,
+               shaper=None) -> Iterator:
     """Drive a global operator from an iterable of (value, ts) — same
-    poison-record contract as :func:`run_keyed`."""
+    poison-record contract as :func:`run_keyed`, same optional
+    ``shaper`` front-end."""
     from ..resilience.connectors import PoisonHandler
 
+    if shaper is not None:
+        operator.attach_shaper(shaper)
     own_obs = obs if obs is not None and obs is not operator.obs else None
     poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                            obs=obs if obs is not None else operator.obs)
@@ -75,6 +90,9 @@ def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
                 own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
         for item in items:
             yield item
+    for item in operator.drain_shaper() if hasattr(operator, "drain_shaper") \
+            else ():
+        yield item
 
 
 def collect_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,

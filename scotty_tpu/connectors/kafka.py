@@ -68,7 +68,8 @@ class KafkaScottyWindowOperator:
             stall_timeout_s: Optional[float] = None,
             clock=None,
             serve_port: Optional[int] = None,
-            health=None) -> int:
+            health=None,
+            shaper=None) -> int:
         """``consumer``: any iterable of Kafka-like records (KafkaConsumer
         instances are iterables of ConsumerRecord). Returns records
         consumed (poison records count — they were consumed, then
@@ -85,9 +86,20 @@ class KafkaScottyWindowOperator:
         :class:`scotty_tpu.obs.HealthPolicy` behind ``/healthz`` (pass
         ``HealthPolicy(max_watermark_lag_ms=...)`` to arm the
         watermark-lag check; the default only watches stalls/overflows).
+
+        ``shaper`` (a :class:`scotty_tpu.shaper.ShaperConfig`, ISSUE 5)
+        attaches the coalescing/sorting front-end for the duration of
+        the loop: records buffer into sorted blocks, the config's
+        ``max_delay_ms`` deadline (on the injectable ``clock``) is
+        evaluated as each record arrives — while the consumer iterator
+        blocks on a silent topic there is no execution to evaluate it
+        on — and anything still held drains through ``on_result`` at
+        loop end.
         """
         from ..resilience.connectors import PoisonHandler, watchdog_source
 
+        if shaper is not None:
+            self.operator.attach_shaper(shaper, clock=clock)
         poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                                obs=self.operator.obs)
         if stall_timeout_s is not None:
@@ -111,6 +123,8 @@ class KafkaScottyWindowOperator:
                         on_result(item)
                 if max_records is not None and n >= max_records:
                     break
+            for item in self.operator.drain_shaper():
+                on_result(item)
         finally:
             if self.obs_server is not None:
                 self.obs_server.close()

@@ -1,6 +1,15 @@
 """Host→device ingest pipeline (SURVEY.md §7 stage 7): double-buffered
 transfers of packed tuple batches overlapping the previous batch's ingest.
 
+**This is the PRE-SHAPED fast path**: both feeds hard-error on unsorted
+input (``pack`` raises on any descending timestamp) because they exist to
+saturate the link with zero per-tuple host work. A stream that is not
+already sorted-and-batched belongs to the general entry point,
+:class:`scotty_tpu.shaper.StreamShaper` (ISSUE 5) — its accumulator
+coalesces and sorts irregular host records into exactly the blocks these
+feeds want, and its device sort-and-split shapes device-resident batches
+without a host round trip.
+
 The reference's LoadGeneratorSource emits tuples in-process
 (benchmark/.../LoadGeneratorSource.java:10-87) — there IS no host→device
 boundary in the reference. On TPU the boundary is real, and this module is
@@ -127,9 +136,19 @@ class KeyedHostFeed:
         order = np.argsort(keys, kind="stable")
         k2 = np.asarray(keys, np.int64)[order]
         if k2.size and (k2[-1] >= K or k2[0] < 0):
+            # a round can hold BOTH negative and >= K keys — report every
+            # offending value class plus the out-of-range count, not just
+            # whichever end the old single-value message happened to pick
+            bad = (k2 < 0) | (k2 >= K)
+            offenders = []
+            if k2[0] < 0:
+                offenders.append(int(k2[0]))
+            if k2[-1] >= K:
+                offenders.append(int(k2[-1]))
             raise ValueError(
-                f"KeyedHostFeed.pack: key {int(k2[-1] if k2[-1] >= K else k2[0])} "
-                f"out of range [0, {K})")
+                f"KeyedHostFeed.pack: {int(bad.sum())} tuple(s) with keys "
+                f"out of range [0, {K}); offending value(s): "
+                f"{', '.join(str(o) for o in offenders)}")
         counts = np.bincount(k2, minlength=K)
         if counts.max(initial=0) > Bk:
             raise ValueError(

@@ -283,9 +283,29 @@ class TpuWindowOperator(WindowOperator):
 
     def __init__(self, state_factory: Optional[StateFactory] = None,
                  config: Optional[EngineConfig] = None, obs=None,
-                 collect_device_metrics: Optional[bool] = None):
+                 collect_device_metrics: Optional[bool] = None,
+                 shaper=None):
         self.config = config or EngineConfig()
         self.obs = obs                      # scotty_tpu.obs.Observability
+        #: stream-shaping front-end (scotty_tpu.shaper, ISSUE 5). Pass a
+        #: ShaperConfig (or a prebuilt StreamShaper) to route host-fed
+        #: tuples through the coalescing/sorting accumulator; watermarks
+        #: drain it first and check_overflow folds its telemetry. None
+        #: (default) leaves every pre-shaper path byte-identical.
+        self._shaper = None
+        self._shaper_feeding = False
+        if shaper is not None:
+            from ..shaper import ShaperConfig, StreamShaper
+
+            if isinstance(shaper, ShaperConfig):
+                StreamShaper(self, shaper)      # attaches via __init__
+            elif isinstance(shaper, StreamShaper):
+                shaper.op = self
+                self._shaper = shaper
+            else:
+                raise TypeError(
+                    "shaper= expects a scotty_tpu.shaper.ShaperConfig or "
+                    f"StreamShaper, got {type(shaper).__name__}")
         #: device_* telemetry mode. None (default) = AUTO: collect only
         #: while an Observability is attached, so a bare operator stays
         #: zero-overhead (no dm_ingest kernel dispatch per device batch,
@@ -669,9 +689,22 @@ class TpuWindowOperator(WindowOperator):
         self.process_elements(np.asarray([element], dtype=np.float32),
                               np.asarray([ts], dtype=np.int64))
 
+    @property
+    def shaper(self):
+        """The attached :class:`scotty_tpu.shaper.StreamShaper` (None
+        when the operator runs bare)."""
+        return self._shaper
+
     def process_elements(self, elements: Sequence, timestamps: Sequence) -> None:
         if not self._built:
             self._build()
+        if self._shaper is not None and not self._shaper_feeding:
+            # shaped ingest: the accumulator coalesces/sorts and calls
+            # back into this method (reentrancy flag set) per full block
+            self._shaper.offer_many(
+                np.asarray(elements, dtype=np.float32).reshape(-1),
+                np.asarray(timestamps, dtype=np.int64).reshape(-1))
+            return
         vals = np.asarray(elements, dtype=np.float32).reshape(-1)
         tss = np.asarray(timestamps, dtype=np.int64).reshape(-1)
         if vals.shape != tss.shape:
@@ -1210,13 +1243,22 @@ class TpuWindowOperator(WindowOperator):
             self._launch_batch(min(self._n_pending, self.config.batch_size))
 
     def ingest_device_batch(self, vals, ts, ts_min: int, ts_max: int,
-                            n_valid: Optional[int] = None) -> None:
+                            n_valid: Optional[int] = None,
+                            valid=None) -> None:
         """Zero-copy ingest of device-resident arrays (shape [batch_size],
         ts ascending — late tuples allowed as the sorted prefix, within
         ``max_lateness``). ``ts_min``/``ts_max`` are host-known event-time
         bounds of the batch (they keep the host clock mirrors exact without
         a device sync; conservative bounds are fine). This is the path for
-        device-side sources — host→device bandwidth never caps throughput."""
+        device-side sources — host→device bandwidth never caps throughput.
+
+        ``valid`` (optional) is a DEVICE-resident boolean lane mask that
+        overrides the ``n_valid`` prefix mask — the stream shaper's
+        sort-and-split computes its split point on device, so the mask
+        cannot be host-materialized without a sync (scotty_tpu.shaper).
+        Valid lanes must still be a sorted prefix with pad lanes
+        repeating the last valid ts; ``n_valid`` then only feeds the
+        host tuple-count mirrors (a conservative total is fine)."""
         if not self._built:
             self._build()
         if self.config.overflow_policy != "fail":
@@ -1230,15 +1272,17 @@ class TpuWindowOperator(WindowOperator):
         if self._valid_dev is None:
             self._valid_dev = jax.device_put(np.ones((B,), bool))
         n = B if n_valid is None else n_valid
-        if n == B:
-            valid = self._valid_dev
-        else:
-            # partially filled batch: lanes >= n_valid MUST be masked or
-            # their pad values aggregate into real windows (lanes must be a
-            # sorted prefix, pad lanes repeating the last valid ts)
-            m = np.zeros((B,), bool)
-            m[:n] = True
-            valid = jax.device_put(m)
+        if valid is None:
+            if n == B:
+                valid = self._valid_dev
+            else:
+                # partially filled batch: lanes >= n_valid MUST be masked
+                # or their pad values aggregate into real windows (lanes
+                # must be a sorted prefix, pad lanes repeating the last
+                # valid ts)
+                m = np.zeros((B,), bool)
+                m[:n] = True
+                valid = jax.device_put(m)
         if self._session_states:
             raise UnsupportedOnDevice(
                 "device-resident batches with session windows: use "
@@ -1395,6 +1439,11 @@ class TpuWindowOperator(WindowOperator):
     def _process_watermark_dispatch(self, watermark_ts: int):
         if not self._built:
             self._build()
+        if self._shaper is not None:
+            # event time is about to advance past anything still held in
+            # the shaper's accumulator — drain it first (the shaper's
+            # bounded-delay contract also caps how much can be here)
+            self._shaper.flush()
         self._flush()
         if self._pure_session:
             outs = self._sweep_sessions(watermark_ts)
@@ -1605,6 +1654,10 @@ class TpuWindowOperator(WindowOperator):
     def check_overflow(self) -> None:
         """One deliberate sync validating the run (async users call this
         after draining a stream)."""
+        if self._shaper is not None:
+            # shaper drain-point check: raises ShaperOverflow on a lost
+            # late residue and folds the shaper_* telemetry
+            self._shaper.check()
         if not self._built:
             return
         if self._state is not None:

@@ -121,6 +121,15 @@ OVERFLOWS = "overflows"
 SILENT_INTERVALS = "silent_intervals"
 EMIT_LATENCY_MS = "emit_latency_ms"
 
+# shaper contract (ISSUE 5 — scotty_tpu.shaper; counters/gauges folded
+# at the existing drain points, documented in README/docs/API.md)
+SHAPER_REORDERED_TUPLES = "shaper_reordered_tuples"
+SHAPER_FLUSHES = "shaper_flushes"
+SHAPER_HELD_TUPLES = "shaper_held_tuples"
+SHAPER_LATE_ROUTED = "shaper_late_routed"
+SHAPER_SLACK_OVERFLOWS = "shaper_slack_overflows"
+SHAPER_FILL_RATIO = "shaper_fill_ratio"
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -154,6 +163,16 @@ METRIC_HELP = {
     OVERFLOWS: "buffer-overflow events detected",
     SILENT_INTERVALS: "session-pipeline intervals with no tuples",
     EMIT_LATENCY_MS: "sampled dispatch->results-on-host time",
+    SHAPER_REORDERED_TUPLES:
+        "tuples the shaper's sort actually moved (arrived below the "
+        "running max event time)",
+    SHAPER_FLUSHES: "shaper accumulator blocks flushed",
+    SHAPER_HELD_TUPLES: "tuples currently held in the shaper accumulator",
+    SHAPER_LATE_ROUTED:
+        "tuples the device sort-and-split routed to the late residue",
+    SHAPER_SLACK_OVERFLOWS:
+        "shaped batches whose late residue exceeded late_capacity",
+    SHAPER_FILL_RATIO: "flushed shaper block size / batch_size",
     RESILIENCE_SHED_TUPLES: "tuples dropped by the SHED overflow policy",
     RESILIENCE_GROW_EVENTS: "GROW capacity doublings",
     RESILIENCE_CHECKPOINTS: "automatic supervisor checkpoints",
@@ -347,6 +366,8 @@ __all__ = [
     "INTERVAL_STEP_MS", "SYNC_MS", "SLICE_OCCUPANCY", "SLICE_HEADROOM",
     "QUEUE_DEPTH", "WINDOWS_EMITTED", "OVERFLOWS", "SILENT_INTERVALS",
     "EMIT_LATENCY_MS",
+    "SHAPER_REORDERED_TUPLES", "SHAPER_FLUSHES", "SHAPER_HELD_TUPLES",
+    "SHAPER_LATE_ROUTED", "SHAPER_SLACK_OVERFLOWS", "SHAPER_FILL_RATIO",
     "RESILIENCE_SHED_TUPLES", "RESILIENCE_GROW_EVENTS",
     "RESILIENCE_CHECKPOINTS", "RESILIENCE_RESTARTS",
     "RESILIENCE_SOURCE_RETRIES", "RESILIENCE_POISON_RECORDS",

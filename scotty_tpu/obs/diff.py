@@ -65,6 +65,16 @@ DEFAULT_THRESHOLDS = {
         "resilience_poison_records": {"direction": "lower", "default": 0},
         "resilience_source_retries": {"direction": "lower", "default": 0},
         "resilience_stall_events": {"direction": "lower", "default": 0},
+        # shaper contract (ISSUE 5): a candidate whose shaper started
+        # losing late residues (slack overflow) or holding tuples past
+        # the end-of-run drain must not pass as clean; reordered-tuple
+        # growth beyond 10% on the same seeded stream flags a stream-
+        # quality (or shaping) regression. All lazily created, so
+        # "default": 0 covers the appearing case like the resilience set.
+        "shaper_slack_overflows": {"direction": "lower", "default": 0},
+        "shaper_held_tuples": {"direction": "lower", "default": 0},
+        "shaper_reordered_tuples": {"direction": "lower", "default": 0,
+                                    "rel_tol": 0.10},
         # operations contract (ISSUE 4): flight-ring wraparound drops and
         # unhealthy /healthz verdicts appearing between two exports gate —
         # a run that silently lost its own black-box tail, or that an

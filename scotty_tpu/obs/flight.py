@@ -93,6 +93,12 @@ STALL = "stall"
 POISON = "poison"
 HEALTH = "health"
 MARK = "mark"
+# stream-shaper events (ISSUE 5): flush size, held-tuple highwater, and
+# late-residue slack overflow — so a postmortem timeline shows what the
+# shaper was doing at crash time
+SHAPER_FLUSH = "shaper_flush"
+SHAPER_HELD = "shaper_held"
+SHAPER_OVERFLOW = "shaper_overflow"
 
 
 class FlightRecorder:

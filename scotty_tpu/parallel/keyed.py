@@ -336,6 +336,13 @@ class KeyedTpuWindowOperator:
         return ws, we, cnt_np, lowered
 
     def check_overflow(self) -> None:
+        shaper = getattr(self, "_attached_shaper", None)
+        if shaper is not None:
+            # a StreamShaper feeding shape_device_round registers here:
+            # its sticky row-overflow flag (a key exceeded the round
+            # size — tuples were dropped by the scatter) must surface at
+            # this drain point, never silently (scotty_tpu.shaper)
+            shaper.check()
         if self._state is not None and bool(
                 np.any(np.asarray(self._state.overflow))):
             raise RuntimeError("slice buffer overflow on some key shard")

@@ -1,0 +1,29 @@
+"""Stream-shaping ingest subsystem (ISSUE 5).
+
+A sort-and-split front-end between sources and the engine: unshaped
+out-of-order streams in, fused-kernel-rate ingest out. See
+:mod:`.shaper` (the :class:`StreamShaper` facade + :class:`ShaperConfig`),
+:mod:`.device` (jitted sort-and-split / keyed round kernels) and
+:mod:`.host` (numpy mirrors + the :class:`.host.BatchAccumulator`
+coalescing ring).
+"""
+
+from .device import (
+    ShaperStats,
+    build_keyed_round,
+    build_sort_split,
+    init_shaper_stats,
+    keyed_round_kernel,
+    sort_split_kernel,
+)
+from .host import BatchAccumulator, count_reordered, keyed_round_host, \
+    sort_split_host
+from .shaper import ShaperConfig, ShaperOverflow, StreamShaper
+
+__all__ = [
+    "StreamShaper", "ShaperConfig", "ShaperOverflow",
+    "BatchAccumulator", "sort_split_host", "keyed_round_host",
+    "count_reordered",
+    "ShaperStats", "init_shaper_stats", "build_sort_split",
+    "build_keyed_round", "sort_split_kernel", "keyed_round_kernel",
+]

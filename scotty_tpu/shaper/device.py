@@ -1,0 +1,239 @@
+"""Device shaper kernels: jitted sort-and-split (+ keyed round layout).
+
+The engine's fast ingest paths have contracts a real-world stream does not
+meet: ascending timestamps (the scatter-free dense kernel,
+``engine/core.py::build_ingest_dense``) or at worst a sorted late prefix.
+An unshaped out-of-order batch therefore falls through to the general
+scatter-combine kernel, whose per-field int64 [B]-lane scatters dominate
+ingest cost (~100 ms per 1M lanes on v5e — ``bench_results/micro.json:
+ingest_scatter``). This module moves the shaping itself onto the device:
+
+* :func:`build_sort_split` — one ``lax.sort`` of the batch by timestamp,
+  then a split against the operator's current max event time (host-known
+  mirror, passed as ``cut``): the in-order majority is compacted to a
+  [B]-lane block fit for the dense/in-order kernels, the late residue is
+  compacted to a small static [late_capacity]-lane block for the general
+  kernel (``TpuWindowOperator.ingest_device_late``), so the expensive
+  full-lane scatter sets are paid only on the actually-late fraction.
+  The split point is unknowable host-side without a sync, so both blocks
+  carry device-resident validity masks and BOTH are always dispatched —
+  the masked kernels fold invalid lanes to their identities, making an
+  empty block a no-op dispatch rather than a host round trip.
+* :func:`build_keyed_round` — the keyed variant: a stable two-key
+  ``lax.sort`` by (key, ts) plus a [K, Bk] scatter produces the padded
+  round layout ``KeyedTpuWindowOperator.ingest_device_round`` consumes,
+  entirely on device (the host mirror is ``KeyedHostFeed.pack``).
+
+Both kernels also maintain a tiny :class:`ShaperStats` pytree (donated,
+zero host syncs): exact out-of-arrival-order counts (the same running-max
+calculus the device telemetry uses), late-routed totals and a sticky
+slack-overflow flag — fetched only at the existing drain points
+(``StreamShaper.check``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from .. import jax_config  # noqa: F401
+
+#: sentinel above any real event time (and any cut) — invalid lanes sort
+#: to the tail and never count as late
+TS_SENTINEL = np.int64(1) << 62
+I64_MIN = np.int64(-(1 << 62))
+
+
+class ShaperStats(NamedTuple):
+    """Device-resident shaper telemetry (int64 scalars + bool flag)."""
+
+    #: tuples seen by the shaper
+    seen: "jnp.ndarray"
+    #: tuples that arrived strictly below the running max event time at
+    #: their arrival position (the tuples the sort actually moved)
+    reordered: "jnp.ndarray"
+    #: tuples routed to the late residue (below the operator's ts_max cut)
+    late_routed: "jnp.ndarray"
+    #: sticky: a batch's late residue exceeded the static late capacity —
+    #: tuples were lost; the run is invalid (raised at the next drain)
+    slack_overflow: "jnp.ndarray"
+
+
+def init_shaper_stats() -> ShaperStats:
+    import jax.numpy as jnp
+
+    # distinct buffers per leaf: the jitted kernels donate the pytree,
+    # and XLA rejects donating one buffer twice
+    return ShaperStats(seen=jnp.int64(0), reordered=jnp.int64(0),
+                       late_routed=jnp.int64(0),
+                       slack_overflow=jnp.asarray(False))
+
+
+def stats_snapshot(stats) -> dict:
+    """Host dict of a fetched (``jax.device_get``) stats pytree."""
+    return {
+        "seen": int(stats.seen),
+        "reordered": int(stats.reordered),
+        "late_routed": int(stats.late_routed),
+        "slack_overflow": bool(stats.slack_overflow),
+    }
+
+
+def build_sort_split(batch_size: int, late_capacity: int):
+    """Sort-and-split kernel for one global (unkeyed) batch.
+
+    ``(stats, ts[B], vals[B], valid[B], cut, seed) -> (stats', io_ts[B],
+    io_vals[B], io_valid[B], late_ts[L], late_vals[L], late_valid[L])``
+
+    * ``cut`` — the operator's current max event time (host mirror);
+      tuples strictly below it are late. Pass ``I64_MIN`` for a stream
+      with no history (nothing is late; the kernel is then a pure sort).
+    * ``seed`` — the running max ARRIVAL-ORDER event time before this
+      batch, for the reordered-tuple count (usually equals ``cut``).
+    * the io block is ts-ascending with invalid lanes padded by the max
+      valid ts (the ``ingest_device_batch`` pad contract); the late block
+      is ts-ascending over ``late_capacity`` static lanes. When the late
+      residue exceeds ``late_capacity`` the residue is truncated and the
+      sticky ``slack_overflow`` flag raises — checked at drain points.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, L = batch_size, late_capacity
+
+    def sort_split(stats: ShaperStats, ts, vals, valid, cut, seed):
+        ts = jnp.asarray(ts)
+        vals = jnp.asarray(vals)
+        valid = jnp.asarray(valid)
+        cut = jnp.int64(cut)
+        key = jnp.where(valid, ts, jnp.int64(TS_SENTINEL))
+        sort_ts, sort_vals = jax.lax.sort((key, vals), num_keys=1,
+                                          is_stable=True)
+        n_valid = jnp.sum(valid.astype(jnp.int32))
+        n_late = jnp.minimum(
+            jnp.searchsorted(sort_ts, cut, side="left").astype(jnp.int32),
+            n_valid)
+
+        lane = jnp.arange(B, dtype=jnp.int32)
+        last = jnp.maximum(n_valid - 1, 0)
+        idx_io = jnp.minimum(lane + n_late, last)
+        io_ts = sort_ts[idx_io]          # pad lanes repeat the max valid ts
+        io_vals = sort_vals[idx_io]
+        io_valid = lane < (n_valid - n_late)
+        # an entirely-invalid/entirely-late batch would otherwise expose
+        # the sort sentinel on every pad lane; clamp to the cut so the
+        # masked kernels see a benign constant
+        io_ts = jnp.where(n_valid > n_late, io_ts, cut)
+
+        lanel = jnp.arange(L, dtype=jnp.int32)
+        idx_l = jnp.minimum(lanel, jnp.maximum(n_late - 1, 0))
+        late_ts = jnp.where(n_late > 0, sort_ts[idx_l], cut)
+        late_vals = sort_vals[idx_l]
+        late_valid = lanel < n_late
+
+        # reordered = arrived strictly below the running max at arrival
+        eff = jnp.where(valid, ts, jnp.int64(I64_MIN))
+        shifted = jnp.concatenate(
+            [jnp.reshape(jnp.int64(seed), (1,)), eff[:-1]])
+        rm = jax.lax.cummax(shifted)
+        n_reord = jnp.sum((valid & (ts < rm)).astype(jnp.int64))
+        stats = stats._replace(
+            seen=stats.seen + n_valid.astype(jnp.int64),
+            reordered=stats.reordered + n_reord,
+            late_routed=stats.late_routed + n_late.astype(jnp.int64),
+            slack_overflow=stats.slack_overflow | (n_late > L))
+        return (stats, io_ts, io_vals, io_valid,
+                late_ts, late_vals, late_valid)
+
+    return sort_split
+
+
+def build_keyed_round(n_keys: int, round_size: int):
+    """Keyed shaping: flat (keys, ts, vals) -> the padded ``[K, Bk]``
+    round layout ``KeyedTpuWindowOperator.ingest_device_round`` consumes.
+
+    ``(stats, keys[N], ts[N], vals[N], valid[N], seed) -> (stats',
+    ts_round[K, Bk], vals_round[K, Bk], mask[K, Bk])``
+
+    One stable two-key ``lax.sort`` by (key, ts) groups each key's tuples
+    into an ascending run; per-key row positions come from a vectorized
+    ``searchsorted`` over the sorted keys (the device analogue of
+    ``KeyedHostFeed.pack``'s cumsum bookkeeping) and one [N]-lane scatter
+    writes the round. A key holding more than ``round_size`` tuples
+    overflows its row: excess lanes are dropped by the scatter and the
+    sticky ``slack_overflow`` flag raises.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    K, Bk = n_keys, round_size
+
+    def to_round(stats: ShaperStats, keys, ts, vals, valid, seed):
+        keys = jnp.asarray(keys)
+        ts = jnp.asarray(ts)
+        vals = jnp.asarray(vals)
+        valid = jnp.asarray(valid)
+        N = ts.shape[0]
+        k_eff = jnp.where(valid, keys.astype(jnp.int32), jnp.int32(K))
+        ts_eff = jnp.where(valid, ts, jnp.int64(TS_SENTINEL))
+        sk, st, sv = jax.lax.sort((k_eff, ts_eff, vals), num_keys=2,
+                                  is_stable=True)
+        first = jnp.searchsorted(sk, sk, side="left").astype(jnp.int32)
+        pos = jnp.arange(N, dtype=jnp.int32) - first
+        counts = jnp.diff(jnp.searchsorted(
+            sk, jnp.arange(K + 1, dtype=jnp.int32)))          # [K]
+        row = jnp.where((sk < K) & (pos < Bk), sk, jnp.int32(K))
+        # pad lanes mirror KeyedHostFeed.pack: un-written slots read the
+        # batch's min event time (pack's zero u32 delta over `base`), so
+        # the masked keyed kernels see the exact same arrays either way
+        base = jnp.min(jnp.where(valid, ts, jnp.int64(TS_SENTINEL)))
+        base = jnp.where(jnp.any(valid), base, jnp.int64(0))
+        ts_round = jnp.full((K, Bk), base, st.dtype).at[row, pos].set(
+            st, mode="drop")
+        vals_round = jnp.zeros((K, Bk), sv.dtype).at[row, pos].set(
+            sv, mode="drop")
+        mask = jnp.arange(Bk, dtype=jnp.int32)[None, :] < counts[:, None]
+
+        eff = jnp.where(valid, ts, jnp.int64(I64_MIN))
+        shifted = jnp.concatenate(
+            [jnp.reshape(jnp.int64(seed), (1,)), eff[:-1]])
+        rm = jax.lax.cummax(shifted)
+        n_reord = jnp.sum((valid & (ts < rm)).astype(jnp.int64))
+        n_valid = jnp.sum(valid.astype(jnp.int64))
+        stats = stats._replace(
+            seen=stats.seen + n_valid,
+            reordered=stats.reordered + n_reord,
+            slack_overflow=stats.slack_overflow | jnp.any(counts > Bk))
+        return stats, ts_round, vals_round, mask
+
+    return to_round
+
+
+_KERNELS: dict = {}
+
+
+def sort_split_kernel(batch_size: int, late_capacity: int):
+    """Jitted, cached :func:`build_sort_split` (stats donated)."""
+    import jax
+
+    key = ("sort_split", batch_size, late_capacity)
+    hit = _KERNELS.get(key)
+    if hit is None:
+        hit = jax.jit(build_sort_split(batch_size, late_capacity),
+                      donate_argnums=0)
+        _KERNELS[key] = hit
+    return hit
+
+
+def keyed_round_kernel(n_keys: int, round_size: int):
+    """Jitted, cached :func:`build_keyed_round` (stats donated)."""
+    import jax
+
+    key = ("keyed_round", n_keys, round_size)
+    hit = _KERNELS.get(key)
+    if hit is None:
+        hit = jax.jit(build_keyed_round(n_keys, round_size),
+                      donate_argnums=0)
+        _KERNELS[key] = hit
+    return hit

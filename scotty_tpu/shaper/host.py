@@ -1,0 +1,265 @@
+"""Host side of the stream shaper: numpy sort-split mirror + the
+:class:`BatchAccumulator` coalescing ring.
+
+The device kernels in :mod:`.device` have exact vectorized-numpy mirrors
+here — the differential suite (tests/test_shaper.py) asserts the device
+sort-and-split output bit-matches :func:`sort_split_host` on chaos
+streams, the same oracle discipline the engine uses everywhere else.
+
+:class:`BatchAccumulator` is the host story for irregular connector
+streams: every reference-derived connector used to trickle records into
+``process_element`` one at a time, and ``HostFeed`` hard-errors on
+unsorted input — so an out-of-order host stream had NO fast path at all.
+The accumulator coalesces records into full ``batch_size`` blocks, sorts
+them (stable, so equal timestamps keep arrival order), holds back a
+configurable reorder-slack band of the newest event time so stragglers
+can still be merged in order, and bounds how long any record waits with
+a ``max_delay_ms`` flush deadline on the injectable resilience
+:class:`~scotty_tpu.resilience.clock.Clock` (tests drive it with
+``ManualClock`` — no wall-clock waits).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience.clock import Clock, SystemClock
+from .device import I64_MIN
+
+
+def sort_split_host(vals: np.ndarray, ts: np.ndarray, cut: int):
+    """Numpy mirror of the device sort-and-split (the differential
+    oracle): stable ts-sort, split strictly below ``cut``. Returns
+    ``(io_vals, io_ts, late_vals, late_ts)`` — unpadded."""
+    ts = np.asarray(ts, np.int64)
+    vals = np.asarray(vals, np.float32)
+    order = np.argsort(ts, kind="stable")
+    st, sv = ts[order], vals[order]
+    n_late = int(np.searchsorted(st, cut, side="left"))
+    return sv[n_late:], st[n_late:], sv[:n_late], st[:n_late]
+
+
+def keyed_round_host(keys: np.ndarray, vals: np.ndarray, ts: np.ndarray,
+                     n_keys: int, round_size: int):
+    """Numpy mirror of the keyed round kernel: stable (key, ts) lexsort
+    into the padded ``[K, Bk]`` layout. Returns ``(ts_round, vals_round,
+    mask, counts)``; raises ValueError when a key overflows its row."""
+    K, Bk = n_keys, round_size
+    keys = np.asarray(keys, np.int64)
+    ts = np.asarray(ts, np.int64)
+    vals = np.asarray(vals, np.float32)
+    order = np.lexsort((np.arange(ts.size), ts, keys))
+    k2, t2, v2 = keys[order], ts[order], vals[order]
+    counts = np.bincount(k2, minlength=K)
+    if counts.max(initial=0) > Bk:
+        raise ValueError(
+            f"keyed_round_host: a key holds {int(counts.max())} tuples > "
+            f"round size {Bk}")
+    starts = np.zeros((K,), np.int64)
+    starts[1:] = np.cumsum(counts)[:-1]
+    pos = np.arange(k2.size, dtype=np.int64) - starts[k2]
+    base = int(ts.min()) if ts.size else 0
+    ts_round = np.full((K, Bk), base, np.int64)
+    vals_round = np.zeros((K, Bk), np.float32)
+    ts_round[k2, pos] = t2
+    vals_round[k2, pos] = v2
+    mask = np.arange(Bk)[None, :] < counts[:, None]
+    return ts_round, vals_round, mask, counts
+
+
+def box_object_array(items) -> np.ndarray:
+    """A 1-D object ndarray holding ``items`` verbatim — ``np.asarray``
+    would flatten tuple/list payloads into extra dimensions, which is
+    exactly wrong for connector records whose values are themselves
+    sequences."""
+    if isinstance(items, np.ndarray) and items.dtype == object \
+            and items.ndim == 1:
+        return items
+    seq = list(items) if not np.isscalar(items) else [items]
+    out = np.empty(len(seq), object)
+    for i, x in enumerate(seq):
+        out[i] = x
+    return out
+
+
+def count_reordered(ts: np.ndarray, seed: Optional[int]) -> int:
+    """Exact arrival-order reorder count: tuples strictly below the
+    running max event time at their arrival (numpy mirror of the device
+    stats calculus; ``seed`` is the running max before this chunk)."""
+    ts = np.asarray(ts, np.int64)
+    if ts.size == 0:
+        return 0
+    s = np.int64(seed) if seed is not None else I64_MIN
+    rm = np.maximum.accumulate(np.concatenate(([s], ts[:-1])))
+    return int((ts < rm).sum())
+
+
+class BatchAccumulator:
+    """Coalesce irregular (val, ts) records into sorted full-size blocks.
+
+    * **Coalescing**: records buffer until ``batch_size`` of them are
+      *emittable*, then flush as one sorted block (repeat while full
+      blocks remain).
+    * **Reorder slack**: with ``slack_ms > 0``, only records at/below
+      ``max_ts_seen - slack_ms`` are emittable on a size-triggered flush
+      — the newest band is held back so late stragglers within the slack
+      still merge in sorted order ahead of it.
+    * **Bounded delay**: with ``max_delay_ms`` set, a record never waits
+      longer than that on the (injectable) clock — the deadline flush
+      drains EVERYTHING held, slack band included, as possibly-partial
+      blocks.
+    * ``drain()`` force-flushes everything (watermarks and stream ends
+      call it: event time is about to advance past the held records).
+
+    Blocks are delivered to ``sink(vals, ts)`` (keyed variant:
+    ``sink(keys, vals, ts)`` with ``keyed=True``; keys ride an object
+    array through the same stable sort). The accumulator never inspects
+    event-time semantics beyond ordering — routing late-vs-in-order is
+    the engine/shaper's job.
+    """
+
+    def __init__(self, batch_size: int, sink: Callable,
+                 slack_ms: int = 0,
+                 max_delay_ms: Optional[float] = None,
+                 clock: Optional[Clock] = None,
+                 keyed: bool = False,
+                 value_dtype=np.float32):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = int(batch_size)
+        self.sink = sink
+        self.slack_ms = int(slack_ms)
+        self.max_delay_ms = max_delay_ms
+        self.clock = clock or SystemClock()
+        self.keyed = keyed
+        #: value payload dtype; ``None`` = opaque Python objects (the
+        #: connector case — values ride an object array untouched)
+        self.value_dtype = value_dtype
+        self._vals: List[np.ndarray] = []
+        self._ts: List[np.ndarray] = []
+        self._keys: List[np.ndarray] = []
+        self._n = 0
+        self._max_ts: Optional[int] = None
+        self._oldest_deadline: Optional[float] = None
+        #: lifetime telemetry (the StreamShaper folds these into obs)
+        self.flushes = 0
+        self.reordered = 0
+        self.held_highwater = 0
+        self.fill_ratios: List[float] = []
+
+    @property
+    def held(self) -> int:
+        """Records currently buffered."""
+        return self._n
+
+    def offer(self, vals, ts, keys=None) -> int:
+        """Buffer a chunk of records (scalars or arrays); flush every
+        full block that became emittable. Returns blocks flushed."""
+        if self.value_dtype is None:
+            v = box_object_array(vals)
+        else:
+            v = np.atleast_1d(np.asarray(vals, self.value_dtype))
+        t = np.atleast_1d(np.asarray(ts, np.int64))
+        if v.shape != t.shape:
+            raise ValueError("vals/ts length mismatch")
+        if self.keyed:
+            if keys is None:
+                raise ValueError("keyed accumulator needs keys")
+            k = box_object_array(keys)
+            if k.shape != t.shape:
+                raise ValueError("keys/ts length mismatch")
+        elif keys is not None:
+            raise ValueError("keys passed to an unkeyed accumulator")
+        if t.size == 0:
+            return self._maybe_deadline_flush()
+        self.reordered += count_reordered(t, self._max_ts)
+        mx = int(t.max())
+        self._max_ts = mx if self._max_ts is None \
+            else max(self._max_ts, mx)
+        if self._oldest_deadline is None and self.max_delay_ms is not None:
+            self._oldest_deadline = (self.clock.now()
+                                     + self.max_delay_ms / 1e3)
+        self._vals.append(v)
+        self._ts.append(t)
+        if self.keyed:
+            self._keys.append(k)
+        self._n += t.size
+        self.held_highwater = max(self.held_highwater, self._n)
+        flushed = 0
+        if self._n >= self.batch_size:
+            flushed += self._flush_full_blocks()
+        flushed += self._maybe_deadline_flush()
+        return flushed
+
+    # -- internals ---------------------------------------------------------
+    def _gather(self):
+        v = self._vals[0] if len(self._vals) == 1 \
+            else np.concatenate(self._vals)
+        t = self._ts[0] if len(self._ts) == 1 else np.concatenate(self._ts)
+        k = None
+        if self.keyed:
+            k = self._keys[0] if len(self._keys) == 1 \
+                else np.concatenate(self._keys)
+        order = np.argsort(t, kind="stable")
+        return (v[order], t[order],
+                k[order] if k is not None else None)
+
+    def _retain(self, v, t, k, lo: int) -> None:
+        self._vals = [v[lo:]] if lo < t.size else []
+        self._ts = [t[lo:]] if lo < t.size else []
+        self._keys = [k[lo:]] if (self.keyed and lo < t.size) else []
+        self._n = t.size - lo if lo < t.size else 0
+        if self._n == 0:
+            self._oldest_deadline = None
+
+    def _emit(self, v, t, k, lo: int, hi: int) -> None:
+        self.flushes += 1
+        self.fill_ratios.append((hi - lo) / self.batch_size)
+        if self.keyed:
+            self.sink(k[lo:hi], v[lo:hi], t[lo:hi])
+        else:
+            self.sink(v[lo:hi], t[lo:hi])
+
+    def _flush_full_blocks(self) -> int:
+        v, t, k = self._gather()
+        emittable = t.size if self.slack_ms <= 0 else int(
+            np.searchsorted(t, self._max_ts - self.slack_ms, side="right"))
+        n_blocks = emittable // self.batch_size
+        # retain BEFORE delivering: a block's replay can re-enter the
+        # accumulator (a fired watermark drains it), and the held state
+        # must already reflect the pop or records would emit twice
+        self._retain(v, t, k, n_blocks * self.batch_size)
+        lo = 0
+        for _ in range(n_blocks):
+            self._emit(v, t, k, lo, lo + self.batch_size)
+            lo += self.batch_size
+        return n_blocks
+
+    def _maybe_deadline_flush(self) -> int:
+        if (self._oldest_deadline is None or self._n == 0
+                or self.clock.now() < self._oldest_deadline):
+            return 0
+        return self.drain()
+
+    def poll(self) -> int:
+        """Deadline check without new records (idle sources call this so
+        a bounded-delay flush fires even when nothing arrives)."""
+        return self._maybe_deadline_flush()
+
+    def drain(self) -> int:
+        """Force-flush everything held (sorted), slack band included."""
+        if self._n == 0:
+            self._oldest_deadline = None
+            return 0
+        v, t, k = self._gather()
+        self._retain(v, t, k, t.size)   # pop first — see _flush_full_blocks
+        flushed = 0
+        lo = 0
+        while lo < t.size:
+            hi = min(lo + self.batch_size, t.size)
+            self._emit(v, t, k, lo, hi)
+            lo = hi
+            flushed += 1
+        return flushed

@@ -99,6 +99,11 @@ def save_engine_operator(op, path: str) -> None:
     (they are code, not data — same contract as the reference's operator
     construction, SlicingWindowOperator.java:30-37)."""
     os.makedirs(path, exist_ok=True)
+    if getattr(op, "_shaper", None) is not None:
+        # records still held in the shaper's accumulator are counted as
+        # consumed by the caller's source offset — flush them into the
+        # engine first or a restore would silently skip them
+        op._shaper.flush()
     op._flush()
     import jax
 
@@ -159,6 +164,8 @@ def save_engine_operator_orbax(op, path: str) -> None:
         import orbax.checkpoint as ocp
     except ImportError:
         return save_engine_operator(op, path)
+    if getattr(op, "_shaper", None) is not None:
+        op._shaper.flush()      # held records count as consumed upstream
     op._flush()
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(os.path.join(os.path.abspath(path), "orbax"),

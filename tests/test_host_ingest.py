@@ -167,3 +167,11 @@ def test_keyed_host_feed_rejects_out_of_range_keys():
         feed.pack(np.array([0, 1, 4]), vals, ts)
     with pytest.raises(ValueError, match="out of range"):
         feed.pack(np.array([-1, 1, 2]), vals, ts)
+    # ISSUE 5 satellite: a round holding BOTH negative and >= K keys must
+    # report both offending value classes plus the out-of-range count —
+    # the old single-value message picked whichever end it checked first
+    with pytest.raises(ValueError) as exc:
+        feed.pack(np.array([-3, 9, 1]), vals, ts)
+    msg = str(exc.value)
+    assert "-3" in msg and "9" in msg
+    assert "2 tuple(s)" in msg

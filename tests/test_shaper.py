@@ -1,0 +1,748 @@
+"""Stream-shaper differential + behavior tests (ISSUE 5).
+
+The oracle discipline of the rest of the suite: the device sort-and-split
+must bit-match the numpy mirror on seeded chaos streams, and end-to-end
+window results through the shaped device path must bit-match the host
+reference-semantics simulator. Chaos values are small integers (exactly
+representable in float32) so every comparison is exact.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+from scotty_tpu.resilience import chaos
+from scotty_tpu.resilience.clock import ManualClock
+from scotty_tpu.shaper import (
+    BatchAccumulator,
+    ShaperConfig,
+    ShaperOverflow,
+    StreamShaper,
+    count_reordered,
+    init_shaper_stats,
+    keyed_round_host,
+    keyed_round_kernel,
+    sort_split_host,
+    sort_split_kernel,
+)
+from scotty_tpu.shaper.device import I64_MIN, stats_snapshot
+
+Time = WindowMeasure.Time
+
+SMALL = EngineConfig(capacity=1 << 12, batch_size=64, annex_capacity=256,
+                     min_trigger_pad=32)
+
+
+# ---------------------------------------------------------------------------
+# device sort-and-split vs numpy oracle
+# ---------------------------------------------------------------------------
+
+
+def _chaos_batch(kind: str, seed: int, n: int):
+    """Seeded chaos batches: (vals, ts, cut) per disorder pattern."""
+    if kind == "burst":
+        vals, ts = chaos.burst(seed, n, 0, 10_000)
+        order = chaos.rng_of(seed + 1).permutation(n)
+        return vals[order], ts[order], 5_000
+    if kind == "late_storm":
+        vals, ts = chaos.late_storm(seed, n, now_ts=8_000,
+                                    max_lateness=6_000)
+        return vals, ts, 8_000            # everything late
+    if kind == "duplicates":
+        rng = chaos.rng_of(seed)
+        ts = rng.integers(0, 8, size=n).astype(np.int64) * 1000
+        vals = rng.integers(0, 256, size=n).astype(np.float32)
+        return vals, ts, 3_500
+    if kind == "none_late":
+        rng = chaos.rng_of(seed)
+        ts = rng.integers(5_000, 9_000, size=n).astype(np.int64)
+        vals = rng.integers(0, 256, size=n).astype(np.float32)
+        return vals, ts, 5_000
+    raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("kind", ["burst", "late_storm", "duplicates",
+                                  "none_late"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sort_split_matches_numpy_oracle(kind, seed):
+    import jax
+
+    B = 128
+    vals, ts, cut = _chaos_batch(kind, seed, B)
+    valid = np.ones(B, bool)
+    kern = sort_split_kernel(B, B)        # residue can never overflow
+    stats, io_ts, io_vals, io_valid, l_ts, l_vals, l_valid = kern(
+        init_shaper_stats(), ts, vals, valid, np.int64(cut),
+        np.int64(I64_MIN))
+    o_iov, o_iot, o_lv, o_lt = sort_split_host(vals, ts, cut)
+    n_io = int(np.asarray(io_valid).sum())
+    n_l = int(np.asarray(l_valid).sum())
+    assert n_io == o_iot.size and n_l == o_lt.size
+    assert (np.asarray(io_ts)[:n_io] == o_iot).all()
+    assert (np.asarray(io_vals)[:n_io] == o_iov).all()
+    assert (np.asarray(l_ts)[:n_l] == o_lt).all()
+    assert (np.asarray(l_vals)[:n_l] == o_lv).all()
+    if n_io:
+        # pad lanes repeat the max valid ts (the device-batch contract)
+        assert (np.asarray(io_ts)[n_io:] == o_iot[-1]).all()
+    snap = stats_snapshot(jax.device_get(stats))
+    assert snap["seen"] == B
+    assert snap["late_routed"] == n_l
+    assert not snap["slack_overflow"]
+    assert snap["reordered"] == count_reordered(ts, None)
+
+
+def test_sort_split_partial_and_single_and_empty():
+    import jax
+
+    B = 32
+    rng = np.random.default_rng(0)
+    ts = rng.integers(0, 1000, size=B).astype(np.int64)
+    vals = rng.integers(0, 64, size=B).astype(np.float32)
+    kern = sort_split_kernel(B, B)
+    for n in (1, 7, 0):
+        valid = np.zeros(B, bool)
+        valid[:n] = True
+        stats, io_ts, io_vals, io_valid, l_ts, l_vals, l_valid = kern(
+            init_shaper_stats(), ts, vals, valid, np.int64(500),
+            np.int64(I64_MIN))
+        o_iov, o_iot, o_lv, o_lt = sort_split_host(vals[:n], ts[:n], 500)
+        n_io = int(np.asarray(io_valid).sum())
+        n_l = int(np.asarray(l_valid).sum())
+        assert n_io == o_iot.size and n_l == o_lt.size
+        assert (np.asarray(io_ts)[:n_io] == o_iot).all()
+        assert (np.asarray(l_ts)[:n_l] == o_lt).all()
+        assert stats_snapshot(jax.device_get(stats))["seen"] == n
+
+
+def test_sort_split_slack_overflow_flag_sticky():
+    import jax
+
+    B, L = 64, 8
+    rng = np.random.default_rng(1)
+    ts = rng.integers(0, 1000, size=B).astype(np.int64)   # ALL below cut
+    vals = np.ones(B, np.float32)
+    valid = np.ones(B, bool)
+    kern = sort_split_kernel(B, L)
+    stats = init_shaper_stats()
+    out = kern(stats, ts, vals, valid, np.int64(5000), np.int64(I64_MIN))
+    assert stats_snapshot(jax.device_get(out[0]))["slack_overflow"]
+    # sticky across a subsequent clean batch
+    clean = np.sort(ts) + 10_000
+    out2 = kern(out[0], clean, vals, valid, np.int64(5000),
+                np.int64(5000))
+    assert stats_snapshot(jax.device_get(out2[0]))["slack_overflow"]
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_keyed_round_matches_numpy_oracle(seed):
+    K, Bk, N = 8, 64, 180
+    rng = chaos.rng_of(seed)
+    keys = rng.integers(0, K, size=N).astype(np.int64)
+    ts = rng.integers(0, 5000, size=N).astype(np.int64)
+    vals = rng.integers(0, 100, size=N).astype(np.float32)
+    kern = keyed_round_kernel(K, Bk)
+    stats, tr, vr, m = kern(init_shaper_stats(), keys, ts, vals,
+                            np.ones(N, bool), np.int64(I64_MIN))
+    o_tr, o_vr, o_m, _ = keyed_round_host(keys, vals, ts, K, Bk)
+    assert (np.asarray(m) == o_m).all()
+    assert (np.asarray(tr) == o_tr).all()
+    assert (np.asarray(vr) == o_vr).all()
+
+
+def test_keyed_round_row_overflow_flags():
+    import jax
+
+    K, Bk, N = 2, 4, 12
+    keys = np.zeros(N, np.int64)          # one key holds all 12 > Bk=4
+    ts = np.arange(N, dtype=np.int64)
+    vals = np.ones(N, np.float32)
+    kern = keyed_round_kernel(K, Bk)
+    stats, _, _, _ = kern(init_shaper_stats(), keys, ts, vals,
+                          np.ones(N, bool), np.int64(I64_MIN))
+    assert stats_snapshot(jax.device_get(stats))["slack_overflow"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: shaped OOO device stream bit-matches the host simulator
+# ---------------------------------------------------------------------------
+
+
+def _mk_engine(shaper_cfg=None, windows=None):
+    op = TpuWindowOperator(config=SMALL)
+    for w in windows or [SlidingWindow(Time, 2000, 500)]:
+        op.add_window_assigner(w)
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(4000)
+    return op, StreamShaper(op, shaper_cfg or ShaperConfig(late_capacity=64))
+
+
+def _mk_sim(windows=None):
+    sim = SlicingWindowOperator()
+    for w in windows or [SlidingWindow(Time, 2000, 500)]:
+        sim.add_window_assigner(w)
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(4000)
+    return sim
+
+
+def _windows_dict(ws, we, cnt, lowered):
+    return {(int(s), int(e)): float(v)
+            for s, e, c, v in zip(ws, we, cnt, lowered[0]) if c > 0}
+
+
+def _sim_dict(results):
+    return {(w.start, w.end): float(w.agg_values[0])
+            for w in results if w.has_value()}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_shaped_device_ooo_bitmatches_simulator(seed):
+    import jax
+
+    B = SMALL.batch_size
+    rng = chaos.rng_of(seed)
+    op, shaper = _mk_engine()
+    sim = _mk_sim()
+    wm = 0
+    for i in range(6):
+        lo = i * 1000
+        ts = rng.integers(max(0, lo - 3000), lo + 1000,
+                          size=B).astype(np.int64)
+        vals = rng.integers(0, 256, size=B).astype(np.float32)
+        shaper.shape_device_batch(jax.device_put(vals),
+                                  jax.device_put(ts),
+                                  int(ts.min()), int(ts.max()))
+        for v, t in zip(vals, ts):
+            sim.process_element(float(v), int(t))
+        if i % 2 == 1:
+            wm = lo + 1000
+            got = _windows_dict(*op.process_watermark_arrays(wm))
+            exp = _sim_dict(sim.process_watermark(wm))
+            assert got == exp
+    got = _windows_dict(*op.process_watermark_arrays(wm + 5000))
+    exp = _sim_dict(sim.process_watermark(wm + 5000))
+    assert got == exp
+    op.check_overflow()
+    stats = shaper.device_stats()
+    assert stats["seen"] == 6 * B
+    assert stats["late_routed"] > 0     # the chaos streams ARE disordered
+
+
+def test_shaped_device_combined_routing_bitmatches():
+    import jax
+
+    seed = 5
+    B = SMALL.batch_size
+    rng = chaos.rng_of(seed)
+    op, shaper = _mk_engine(ShaperConfig(late_routing="combined"))
+    sim = _mk_sim()
+    for i in range(4):
+        lo = i * 1000
+        ts = rng.integers(max(0, lo - 2000), lo + 1000,
+                          size=B).astype(np.int64)
+        vals = rng.integers(0, 256, size=B).astype(np.float32)
+        shaper.shape_device_batch(jax.device_put(vals),
+                                  jax.device_put(ts),
+                                  int(ts.min()), int(ts.max()))
+        for v, t in zip(vals, ts):
+            sim.process_element(float(v), int(t))
+    got = _windows_dict(*op.process_watermark_arrays(9000))
+    exp = _sim_dict(sim.process_watermark(9000))
+    assert got == exp
+    op.check_overflow()
+
+
+def test_shaped_device_slack_overflow_raises_at_drain():
+    import jax
+
+    from scotty_tpu import obs as obs_mod
+
+    B = SMALL.batch_size
+    obs = obs_mod.Observability(flight=obs_mod.FlightRecorder(64))
+    op = TpuWindowOperator(config=SMALL, obs=obs)
+    op.add_window_assigner(TumblingWindow(Time, 1000))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(10_000)
+    shaper = StreamShaper(op, ShaperConfig(late_capacity=8))
+    rng = np.random.default_rng(0)
+    # establish a stream head, then a late storm far beyond 8 lanes
+    ts0 = np.sort(rng.integers(8000, 9000, size=B)).astype(np.int64)
+    vals = np.ones(B, np.float32)
+    shaper.shape_device_batch(jax.device_put(vals), jax.device_put(ts0),
+                              8000, 9000)
+    late = rng.integers(0, 4000, size=B).astype(np.int64)
+    shaper.shape_device_batch(jax.device_put(vals),
+                              jax.device_put(late), 0, 4000)
+    with pytest.raises(ShaperOverflow):
+        op.check_overflow()
+    snap = obs.snapshot()
+    assert snap["shaper_slack_overflows"] >= 1
+    kinds = [e["kind"] for e in obs.flight.events()]
+    assert "shaper_overflow" in kinds
+
+
+# ---------------------------------------------------------------------------
+# host accumulator
+# ---------------------------------------------------------------------------
+
+
+def test_accumulator_coalesces_and_sorts():
+    blocks = []
+    acc = BatchAccumulator(4, lambda v, t: blocks.append((v, t)))
+    rng = np.random.default_rng(0)
+    ts = rng.permutation(12).astype(np.int64)
+    for t in ts:
+        acc.offer(float(t), int(t))
+    assert [b[1].size for b in blocks] == [4, 4, 4]
+    for _, bt in blocks:
+        assert (np.diff(bt) >= 0).all()         # sorted within each block
+    merged = np.concatenate([b[1] for b in blocks])
+    assert sorted(merged.tolist()) == sorted(ts.tolist())   # nothing lost
+    assert acc.held == 0
+    assert acc.flushes == 3
+    assert acc.reordered == count_reordered(ts, None)
+    assert acc.fill_ratios == [1.0, 1.0, 1.0]
+
+
+def test_accumulator_reorder_slack_holds_newest_band():
+    blocks = []
+    acc = BatchAccumulator(2, lambda v, t: blocks.append(t.tolist()),
+                           slack_ms=100)
+    acc.offer([1.0, 1.0, 1.0, 1.0], [10, 20, 500, 510])
+    # emittable horizon = 510 - 100 = 410: only (10, 20) may flush
+    assert blocks == [[10, 20]]
+    assert acc.held == 2
+    # a straggler below the held band still merges in sorted order
+    acc.offer(1.0, 450)
+    acc.drain()
+    assert blocks[1:] == [[450, 500], [510]]
+
+
+def test_accumulator_bounded_delay_flush_on_manual_clock():
+    clock = ManualClock()
+    blocks = []
+    acc = BatchAccumulator(100, lambda v, t: blocks.append(t.tolist()),
+                           max_delay_ms=50, clock=clock)
+    acc.offer(1.0, 5)
+    acc.offer(1.0, 3)
+    assert blocks == []                  # under-full, deadline not reached
+    clock.advance(0.049)
+    assert acc.poll() == 0
+    clock.advance(0.002)                 # past the 50 ms deadline
+    assert acc.poll() == 1
+    assert blocks == [[3, 5]]            # partial block, sorted
+    assert acc.held == 0
+    # the deadline re-arms from the next first record
+    acc.offer(1.0, 9)
+    assert blocks == [[3, 5]]
+    clock.advance(0.051)
+    acc.offer(1.0, 7)                    # offer past deadline also flushes
+    assert blocks == [[3, 5], [7, 9]]
+
+
+def test_accumulator_keyed_object_payloads():
+    blocks = []
+    acc = BatchAccumulator(3, lambda k, v, t: blocks.append((list(k),
+                                                             list(v),
+                                                             t.tolist())),
+                           keyed=True, value_dtype=None)
+    acc.offer([("tup", 1), "plain", ("tup", 2)], [30, 10, 20],
+              keys=["b", "a", "c"])
+    assert blocks == [(["a", "c", "b"],
+                       ["plain", ("tup", 2), ("tup", 1)], [10, 20, 30])]
+
+
+# ---------------------------------------------------------------------------
+# operator + connector wiring
+# ---------------------------------------------------------------------------
+
+
+def test_operator_shaper_trickle_feed_bitmatches_simulator():
+    op = TpuWindowOperator(config=SMALL, shaper=ShaperConfig())
+    op.add_window_assigner(SlidingWindow(Time, 2000, 500))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(4000)
+    assert op.shaper is not None
+    sim = _mk_sim()
+    rng = chaos.rng_of(7)
+    for i in range(3):
+        lo = i * 1000
+        ts = rng.integers(max(0, lo - 2000), lo + 1000,
+                          size=150).astype(np.int64)
+        vals = rng.integers(0, 256, size=150).astype(np.float32)
+        for v, t in zip(vals, ts):       # the per-record trickle
+            op.process_element(float(v), int(t))
+            sim.process_element(float(v), int(t))
+    # the watermark must drain records still held in the accumulator
+    assert op.shaper.held > 0
+    got = _windows_dict(*op.process_watermark_arrays(6000))
+    exp = _sim_dict(sim.process_watermark(6000))
+    assert got == exp
+    assert op.shaper.held == 0
+    op.check_overflow()
+
+
+def test_operator_shaper_rejects_wrong_type():
+    with pytest.raises(TypeError):
+        TpuWindowOperator(config=SMALL, shaper=object())
+
+
+def _bounded_ooo_records(seed, n, step=20, jitter=400):
+    rng = chaos.rng_of(seed)
+    base = np.arange(n) * step
+    ts = np.maximum(base + rng.integers(-jitter, jitter, n), 0)
+    vals = rng.integers(0, 100, n)
+    return vals, ts
+
+
+def test_run_global_shaper_equals_sorted_unshaped():
+    from scotty_tpu.connectors.base import (
+        AscendingWatermarks,
+        GlobalScottyWindowOperator,
+    )
+    from scotty_tpu.connectors.iterable import collect_global
+
+    vals, ts = _bounded_ooo_records(3, 400)
+    recs = [(float(v), int(t)) for v, t in zip(vals, ts)]
+
+    def mk(shaper=None):
+        return GlobalScottyWindowOperator(
+            windows=[TumblingWindow(Time, 1000)],
+            aggregations=[SumAggregation()], allowed_lateness=1000,
+            watermark_policy=AscendingWatermarks(), shaper=shaper)
+
+    out_s = collect_global(iter(recs),
+                           mk(ShaperConfig(batch_size=64, slack_ms=1000)),
+                           final_watermark=20_000)
+    out_r = collect_global(iter(sorted(recs, key=lambda r: r[1])), mk(),
+                           final_watermark=20_000)
+    key = lambda w: (w.start, w.end, tuple(w.agg_values))  # noqa: E731
+    assert sorted(map(key, out_s)) == sorted(map(key, out_r))
+
+
+def test_run_keyed_shaper_equals_sorted_unshaped():
+    from scotty_tpu.connectors.base import (
+        AscendingWatermarks,
+        KeyedScottyWindowOperator,
+    )
+    from scotty_tpu.connectors.iterable import collect_keyed, run_keyed
+
+    vals, ts = _bounded_ooo_records(4, 400)
+    rng = chaos.rng_of(11)
+    keys = rng.integers(0, 3, vals.size)
+    recs = [(f"k{int(k)}", float(v), int(t))
+            for k, v, t in zip(keys, vals, ts)]
+
+    def mk():
+        return KeyedScottyWindowOperator(
+            windows=[TumblingWindow(Time, 1000)],
+            aggregations=[SumAggregation()], allowed_lateness=1000,
+            watermark_policy=AscendingWatermarks())
+
+    # shaper= on the run loop itself (the ISSUE 5 wiring face)
+    op_s = mk()
+    out_s = list(run_keyed(iter(recs), op_s,
+                           shaper=ShaperConfig(batch_size=64,
+                                               slack_ms=1000)))
+    out_s += op_s.process_watermark(20_000)
+    out_r = collect_keyed(iter(sorted(recs, key=lambda r: r[2])), mk(),
+                          final_watermark=20_000)
+    key = lambda kw: (kw[0], kw[1].start, kw[1].end,  # noqa: E731
+                      tuple(kw[1].agg_values))
+    assert sorted(map(key, out_s)) == sorted(map(key, out_r))
+
+
+def test_kafka_run_with_shaper_drains_at_loop_end():
+    from scotty_tpu.connectors.base import (
+        AscendingWatermarks,
+        KeyedScottyWindowOperator,
+    )
+    from scotty_tpu.connectors.kafka import KafkaScottyWindowOperator
+
+    records = chaos.make_records(seed=2, n=120, keys=3, period_ms=50)
+    op = KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 1000)],
+        aggregations=[SumAggregation()], allowed_lateness=1000,
+        watermark_policy=AscendingWatermarks())
+    k = KafkaScottyWindowOperator(operator=op)
+    got = []
+    n = k.run(records, got.append,
+              shaper=ShaperConfig(batch_size=16, slack_ms=200))
+    assert n == 120
+    got += op.process_watermark(100_000)
+
+    op2 = KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 1000)],
+        aggregations=[SumAggregation()], allowed_lateness=1000,
+        watermark_policy=AscendingWatermarks())
+    ref = []
+    KafkaScottyWindowOperator(operator=op2).run(records, ref.append)
+    ref += op2.process_watermark(100_000)
+    key = lambda kw: (kw[0], kw[1].start, kw[1].end,  # noqa: E731
+                      tuple(kw[1].agg_values))
+    assert sorted(map(key, got)) == sorted(map(key, ref))
+
+
+def test_asyncio_run_with_shaper_drains_at_source_end():
+    import asyncio
+
+    from scotty_tpu.connectors.asyncio_connector import run_keyed_async
+    from scotty_tpu.connectors.base import (
+        AscendingWatermarks,
+        KeyedScottyWindowOperator,
+    )
+
+    vals, ts = _bounded_ooo_records(5, 90)
+    recs = [("k", float(v), int(t)) for v, t in zip(vals, ts)]
+
+    async def source():
+        for r in recs:
+            yield r
+
+    op = KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 1000)],
+        aggregations=[SumAggregation()], allowed_lateness=1000,
+        watermark_policy=AscendingWatermarks())
+    got = []
+    asyncio.run(run_keyed_async(
+        source(), op, got.append,
+        shaper=ShaperConfig(batch_size=16, slack_ms=1000)))
+    got += op.process_watermark(20_000)
+    total = sum(w.agg_values[0] for _, w in got)
+    assert total == float(vals.sum())
+
+
+def test_shaper_telemetry_counters_and_flight_events():
+    from scotty_tpu import obs as obs_mod
+
+    obs = obs_mod.Observability(flight=obs_mod.FlightRecorder(256))
+    op = TpuWindowOperator(config=SMALL, obs=obs,
+                           shaper=ShaperConfig(slack_ms=500))
+    op.add_window_assigner(TumblingWindow(Time, 1000))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(4000)
+    vals, ts = _bounded_ooo_records(6, 300)
+    op.process_elements(vals.astype(np.float32), ts)
+    op.process_watermark_arrays(int(ts.max()) + 5000)
+    op.check_overflow()
+    snap = obs.snapshot()
+    assert snap["shaper_flushes"] >= 1
+    assert snap["shaper_reordered_tuples"] == count_reordered(ts, None)
+    assert snap["shaper_held_tuples"] == 0               # drained
+    assert snap["shaper_fill_ratio_count"] >= 1
+    kinds = {e["kind"] for e in obs.flight.events()}
+    assert "shaper_flush" in kinds
+    assert "shaper_held" in kinds
+
+
+# ---------------------------------------------------------------------------
+# CI gates + bench wiring
+# ---------------------------------------------------------------------------
+
+
+def test_obs_diff_gates_shaper_counters(tmp_path):
+    import json
+
+    from scotty_tpu.obs.diff import DEFAULT_THRESHOLDS, diff_exports
+
+    for name in ("shaper_slack_overflows", "shaper_held_tuples",
+                 "shaper_reordered_tuples"):
+        assert name in DEFAULT_THRESHOLDS["metrics"]
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    row = {"name": "cell", "windows": "w", "engine": "e",
+           "aggregation": "sum", "tuples_per_sec": 100.0}
+    base.write_text(json.dumps([row]))
+    cand.write_text(json.dumps([dict(row, shaper_slack_overflows=2)]))
+    findings = diff_exports(str(base), str(cand))
+    bad = [f for f in findings if f["status"] == "regressed"]
+    assert any(f["metric"] == "shaper_slack_overflows" for f in bad)
+
+
+def test_shaped_ooo_runner_cell_smoke():
+    from scotty_tpu.bench.harness import BenchmarkConfig
+    from scotty_tpu.bench.runner import run_shaped_ooo_cell
+
+    cfg = BenchmarkConfig(
+        name="t", throughput=60_000, runtime_s=1,
+        window_configurations=["Tumbling(1000)"],
+        configurations=["ShapedOOO"], agg_functions=["sum"],
+        batch_size=1 << 10, capacity=1 << 13, max_lateness=1000,
+        watermark_period_ms=1000, seed=1)
+    res = run_shaped_ooo_cell(cfg, "Tumbling(1000)", "sum")
+    assert res.tuples_per_sec > 0
+    assert res.shaper_reordered > 0
+
+
+def test_ooo_external_config_parses():
+    import os
+
+    from scotty_tpu.bench.harness import BenchmarkConfig
+
+    path = os.path.join(os.path.dirname(__file__), "..", "scotty_tpu",
+                        "bench", "configurations", "ooo_external.json")
+    cfg = BenchmarkConfig.from_json(path)
+    assert cfg.configurations == ["ShapedOOO"]
+    assert cfg.batch_size > 0
+
+
+def test_micro_time_phase_drains_before_timing():
+    from scotty_tpu.bench.micro import _time_phase
+
+    calls = []
+    r = _time_phase(lambda: calls.append("fn"),
+                    lambda: calls.append("sync"), iters=3,
+                    drain=lambda: calls.append("drain"))
+    # the drain retires the queue BETWEEN warmup-sync and the idle-queue
+    # sync measurement, so queued prior work can't be misattributed
+    i_drain = calls.index("drain")
+    assert calls[i_drain - 1] == "sync"
+    assert calls[i_drain + 1] == "sync"
+    assert r["iters"] == 3
+
+
+# ---------------------------------------------------------------------------
+# review hardening: keyed rounds end-to-end, geometry guard, checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_shape_device_round_end_to_end_matches_host_pack():
+    import jax
+
+    from scotty_tpu.engine.host_ingest import KeyedHostFeed
+    from scotty_tpu.parallel.keyed import KeyedTpuWindowOperator
+
+    K, Bk = 4, 64
+
+    def mk():
+        op = KeyedTpuWindowOperator(K, config=EngineConfig(
+            capacity=1 << 10, batch_size=Bk, min_trigger_pad=32))
+        op.add_window_assigner(TumblingWindow(Time, 1000))
+        op.add_aggregation(SumAggregation())
+        op.set_max_lateness(1000)
+        return op
+
+    rng = chaos.rng_of(2)
+    N = K * Bk // 2
+    keys = rng.integers(0, K, size=N).astype(np.int64)
+    ts_sorted = np.sort(rng.integers(0, 4000, size=N)).astype(np.int64)
+    vals = rng.integers(0, 100, size=N).astype(np.float32)
+    perm = rng.permutation(N)            # the shaped arm gets DISORDER
+
+    op_sh = mk()
+    shaper = StreamShaper(op_sh)
+    shaper.shape_device_round(jax.device_put(keys[perm]),
+                              jax.device_put(vals[perm]),
+                              jax.device_put(ts_sorted[perm]),
+                              int(ts_sorted[0]), int(ts_sorted[-1]))
+    op_ref = mk()
+    KeyedHostFeed(op_ref).feed(keys, vals, ts_sorted)
+
+    ws_a, we_a, cnt_a, low_a = op_sh.process_watermark_arrays(6000)
+    ws_b, we_b, cnt_b, low_b = op_ref.process_watermark_arrays(6000)
+    assert (np.asarray(ws_a) == np.asarray(ws_b)).all()
+    assert (np.asarray(cnt_a) == np.asarray(cnt_b)).all()
+    assert (np.asarray(low_a[0]) == np.asarray(low_b[0])).all()
+    op_sh.check_overflow()
+
+
+def test_shape_device_round_row_overflow_raises_at_keyed_drain():
+    import jax
+
+    from scotty_tpu.parallel.keyed import KeyedTpuWindowOperator
+
+    K, Bk = 2, 8
+    op = KeyedTpuWindowOperator(K, config=EngineConfig(
+        capacity=1 << 8, batch_size=Bk, min_trigger_pad=32))
+    op.add_window_assigner(TumblingWindow(Time, 100))
+    op.add_aggregation(SumAggregation())
+    shaper = StreamShaper(op)
+    N = 3 * Bk                            # key 0 holds 3x the round size
+    keys = np.zeros(N, np.int64)
+    ts = np.arange(N, dtype=np.int64)
+    vals = np.ones(N, np.float32)
+    shaper.shape_device_round(jax.device_put(keys), jax.device_put(vals),
+                              jax.device_put(ts), 0, N - 1)
+    with pytest.raises(ShaperOverflow, match="keyed round"):
+        op.check_overflow()
+
+
+def test_shaped_ooo_cell_rejects_mis_sized_geometry():
+    from scotty_tpu.bench.harness import BenchmarkConfig
+    from scotty_tpu.bench.runner import run_shaped_ooo_cell
+
+    # span collapses to ~1 event-ms per batch -> the late fraction can
+    # never fit the residue lanes; the cell must refuse up front instead
+    # of dying in ShaperOverflow at the final drain
+    cfg = BenchmarkConfig(
+        name="bad", throughput=4_000_000, runtime_s=1,
+        window_configurations=["Tumbling(1000)"],
+        configurations=["ShapedOOO"], agg_functions=["sum"],
+        batch_size=1 << 10, capacity=1 << 13, max_lateness=1000,
+        watermark_period_ms=1000, seed=1)
+    with pytest.raises(ValueError, match="ShapedOOO geometry"):
+        run_shaped_ooo_cell(cfg, "Tumbling(1000)", "sum")
+
+
+def test_checkpoint_flushes_held_shaper_records(tmp_path):
+    from scotty_tpu.utils import checkpoint as ck
+
+    def mk(shaper=None):
+        op = TpuWindowOperator(config=SMALL, shaper=shaper)
+        op.add_window_assigner(TumblingWindow(Time, 1000))
+        op.add_aggregation(SumAggregation())
+        op.set_max_lateness(2000)
+        return op
+
+    vals, ts = _bounded_ooo_records(8, 100)
+    op = mk(ShaperConfig(slack_ms=10_000))    # slack holds EVERYTHING
+    op.process_elements(vals.astype(np.float32), ts)
+    assert op.shaper.held > 0
+    ck.save_engine_operator(op, str(tmp_path / "ck"))
+    assert op.shaper.held == 0                # flushed INTO the snapshot
+
+    restored = mk()
+    ck.restore_engine_operator(restored, str(tmp_path / "ck"))
+    ref = mk()
+    ref.process_elements(np.sort(ts).astype(np.float32) * 0
+                         + vals[np.argsort(ts, kind="stable")]
+                         .astype(np.float32), np.sort(ts))
+    wm = int(ts.max()) + 3000
+    got = _windows_dict(*restored.process_watermark_arrays(wm))
+    exp = _windows_dict(*ref.process_watermark_arrays(wm))
+    assert got == exp                         # nothing skipped
+
+
+def test_keyed_connector_save_persists_shaper_results(tmp_path):
+    from scotty_tpu.connectors.base import (
+        AscendingWatermarks,
+        KeyedScottyWindowOperator,
+    )
+
+    def mk(shaper=None):
+        return KeyedScottyWindowOperator(
+            windows=[TumblingWindow(Time, 1000)],
+            aggregations=[SumAggregation()], allowed_lateness=1000,
+            watermark_policy=AscendingWatermarks(), shaper=shaper)
+
+    op = mk(ShaperConfig(batch_size=512, slack_ms=0))  # holds under 512
+    for i in range(40):
+        op.process_element("k", 1.0, i * 100)
+    assert op._shaper.held == 40
+    op.save(str(tmp_path / "snap"))
+    assert op._shaper.held == 0               # drained into the snapshot
+
+    restored = mk()                           # no shaper attached
+    restored.restore(str(tmp_path / "snap"))
+    out = restored.process_watermark(100_000)
+    # every record (and every window the save-drain emitted) is delivered
+    total = sum(w.agg_values[0] for _, w in out)
+    assert total == 40.0
