@@ -83,6 +83,14 @@ def __getattr__(name):
         from .shaper import ShaperConfig
 
         return ShaperConfig
+    if name == "QueryService":
+        from .serving import QueryService
+
+        return QueryService
+    if name == "QueryAdmission":
+        from .serving import QueryAdmission
+
+        return QueryAdmission
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -96,4 +104,5 @@ __all__ = [
     "HybridWindowOperator", "TpuWindowOperator", "EngineConfig",
     "KeyedTpuWindowOperator", "GlobalTpuWindowOperator",
     "StreamShaper", "ShaperConfig",
+    "QueryService", "QueryAdmission",
 ]

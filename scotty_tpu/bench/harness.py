@@ -163,6 +163,19 @@ class BenchmarkConfig:
     #: inter-batch disorder back-reach (event-ms) of the ShapedOOO cell's
     #: adversarial stream; 0 = min(max_lateness, batch span / 8)
     shaper_back_ms: int = 0
+    #: QueryChurn cell (ISSUE 6): total register+cancel operations the
+    #: seeded churn schedule performs mid-stream (the acceptance floor is
+    #: >= 1000)
+    churn_ops: int = 1024
+    #: peak concurrently-active queries (QueryAdmission.max_queries; the
+    #: slot grid is pre-padded to this, so steady-state churn never
+    #: rebuckets)
+    churn_max_active: int = 256
+    #: tenants the churn schedule round-robins registrations over
+    churn_tenants: int = 4
+    #: replay the same churn schedule through an always-active superset
+    #: oracle and bit-compare per-query emissions (doubles cell wall time)
+    churn_oracle: bool = True
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -188,6 +201,10 @@ class BenchmarkConfig:
             overflow_policy=raw.get("overflowPolicy", "fail"),
             shaper_late_capacity=raw.get("shaperLateCapacity", 0),
             shaper_back_ms=raw.get("shaperBackMs", 0),
+            churn_ops=raw.get("churnOps", 1024),
+            churn_max_active=raw.get("churnMaxActive", 256),
+            churn_tenants=raw.get("churnTenants", 4),
+            churn_oracle=raw.get("churnOracle", True),
         )
 
 

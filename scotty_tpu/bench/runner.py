@@ -390,7 +390,231 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if engine == "ShapedOOO":
         return run_shaped_ooo_cell(cfg, window_spec, agg_name, obs=obs)
 
+    if engine == "QueryChurn":
+        return run_query_churn_cell(cfg, window_spec, agg_name, obs=obs)
+
     raise ValueError(f"unknown engine {engine!r}")
+
+
+def _churn_schedule(cfg: BenchmarkConfig, pool, n_intervals: int,
+                    n_initial: int):
+    """The seeded register/cancel schedule: ``schedule[i]`` is interval
+    i's command list (the :func:`scotty_tpu.serving.replay_schedule`
+    format), deterministically generated from ``cfg.seed`` — the serving
+    run AND the oracle replay both consume THIS structure, so the two
+    runs cannot drift. Registers ramp toward ``churn_max_active`` then
+    alternate with cancels; >= ``cfg.churn_ops`` operations total."""
+    rng = np.random.default_rng(cfg.seed + 0x5e41)
+    ops_per_interval = -(-cfg.churn_ops // n_intervals)
+    schedule = [[] for _ in range(n_intervals)]
+    live: list = []
+    next_id = 0
+    n_ops = 0
+    for i in range(n_intervals):
+        for _ in range(ops_per_interval):
+            headroom = n_initial + len(live) < cfg.churn_max_active
+            if live and (not headroom or rng.random() < 0.45):
+                rid = live.pop(int(rng.integers(len(live))))
+                schedule[i].append(("cancel", rid))
+            else:
+                w = pool[int(rng.integers(len(pool)))]
+                tenant = f"tenant{next_id % max(1, cfg.churn_tenants)}"
+                schedule[i].append(("register", next_id, w, tenant))
+                live.append(next_id)
+                next_id += 1
+            n_ops += 1
+    return schedule, n_ops, next_id
+
+
+def _churn_pool(windows, g: int, P: int, max_size: int):
+    """Churnable window geometries: slides/sizes multiples of the slice
+    grid, slides >= P/8 so the per-slot trigger-lane bucket stays fixed
+    for the whole run (steady-state churn must not rebucket)."""
+    from ..core.windows import SlidingWindow, TumblingWindow, WindowMeasure
+
+    T = WindowMeasure.Time
+    slides = [s for s in (P, P // 2, P // 4, P // 8)
+              if s >= g and s % g == 0] or [max(g, P)]
+    pool = []
+    for sl in slides:
+        for m in (1, 2, 4):
+            if sl * m <= max_size:
+                pool.append(SlidingWindow(T, sl * m, sl))
+        if sl <= max_size:
+            pool.append(TumblingWindow(T, sl))
+    return pool
+
+
+def _churn_rows(by_slot: dict, slot: int):
+    """One slot's emissions as exact-comparable tuples (f32 value bits)."""
+    return [(s, e, c, tuple(np.float32(v).tobytes() for v in vals))
+            for (s, e, c, vals) in by_slot.get(slot, ())]
+
+
+def run_query_churn_cell(cfg: BenchmarkConfig, window_spec: str,
+                         agg_name: str,
+                         obs: Optional[_obs.Observability] = None
+                         ) -> BenchResult:
+    """Query-churn cell (ISSUE 6): a seeded schedule registers/cancels
+    >= ``churnOps`` windows MID-STREAM against a
+    :class:`scotty_tpu.serving.QueryService`, recording the jit-trace
+    count after warmup (the zero-steady-state-retrace acceptance), the
+    throughput delta vs the static-set equivalent pipeline, and — unless
+    ``churnOracle`` is off — a bit-exact comparison of every active
+    query's emissions against an always-active superset oracle replaying
+    the same schedule (per-trigger-row results are independent and the
+    engine state is query-set independent, so equality must be exact)."""
+    import jax
+
+    from ..engine import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+    from ..serving import QueryAdmission, QueryService, replay_schedule
+    from ..serving.cache import pad_pow2
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    P = cfg.watermark_period_ms
+    g = AlignedStreamPipeline.slice_grid(windows, P)
+    tp = _round_throughput(cfg.throughput, g)
+    max_size = max([4 * P] + [int(w.size) for w in windows])
+    pool = _churn_pool(windows, g, P, max_size)
+    lanes = max(P // int(getattr(w, "slide", w.size)) + 2
+                for w in pool + windows)
+    econf = EngineConfig(capacity=cfg.capacity, annex_capacity=8,
+                         min_trigger_pad=32,
+                         overflow_policy=cfg.overflow_policy)
+
+    n_timed = max(4, cfg.runtime_s)
+    schedule, n_ops, n_regs = _churn_schedule(cfg, pool, n_timed,
+                                              len(windows))
+    warmup = max_size // P + 2
+
+    def build_service(max_queries: int, min_slots: int) -> QueryService:
+        return QueryService(
+            [make_aggregation(agg_name)], slice_grid=g,
+            max_window_size=max_size, throughput=tp, wm_period_ms=P,
+            max_lateness=cfg.max_lateness, seed=cfg.seed, config=econf,
+            admission=QueryAdmission(max_queries=max_queries),
+            windows=windows, min_slots=min_slots,
+            min_trigger_lanes=pad_pow2(lanes, 8))
+
+    svc = build_service(cfg.churn_max_active,
+                        pad_pow2(cfg.churn_max_active, 8))
+    svc.run(warmup, collect=False)
+    svc.sync()
+    svc.mark_warm()
+    if obs is not None:
+        svc.set_observability(obs)
+        obs.registry.reset_clock()
+
+    handles: dict = {}
+    slot_maps = []                  # per timed interval: live reg -> slot
+    outs = []
+    t0 = time.perf_counter()
+    for cmds in schedule:
+        replay_schedule(svc, cmds, handles)
+        slot_maps.append({rid: h.slot for rid, h in handles.items()})
+        outs.extend(svc.run(1, collect=True))
+    svc.sync()
+    wall = time.perf_counter() - t0
+    svc.check_overflow()
+    retraces = svc.retraces_since_warm
+    n_tuples = n_timed * svc.pipeline.tuples_per_interval
+    if obs is not None:
+        obs.registry.stop_clock()
+        svc.set_observability(None)
+
+    # drained emit-latency samples on the live churned query set
+    lats = []
+    t_lat = time.perf_counter()
+    for _ in range(LATENCY_SAMPLES_MAX):
+        svc.sync()
+        t1 = time.perf_counter()
+        out = svc.run(1)[0]
+        jax.device_get((out[2], out[3]))
+        lats.append((time.perf_counter() - t1) * 1e3)
+        if (len(lats) >= LATENCY_SAMPLES_MIN
+                and time.perf_counter() - t_lat > LATENCY_BUDGET_S):
+            break
+    svc.check_overflow()
+    emitted = 0
+    by_slot_per_interval = [svc.results_by_slot(o) for o in outs]
+    for bs in by_slot_per_interval:
+        emitted += sum(len(rows) for rows in bs.values())
+
+    # static-set equivalent: the same engine geometry with the seed
+    # window set baked in at build time — the <= 5% penalty comparator
+    ps = AlignedStreamPipeline(
+        windows, [make_aggregation(agg_name)], config=econf, throughput=tp,
+        wm_period_ms=P, max_lateness=cfg.max_lateness, seed=cfg.seed)
+    ps.run(warmup, collect=False)
+    ps.sync()
+    t0 = time.perf_counter()
+    ps.run(n_timed, collect=False)
+    ps.sync()
+    static_wall = time.perf_counter() - t0
+    ps.check_overflow()
+    static_tps = n_timed * ps.tuples_per_interval / static_wall
+
+    oracle_match = None
+    if cfg.churn_oracle:
+        # superset oracle: every scheduled registration active from the
+        # start; the serving run's results for a query active at interval
+        # i must BIT-MATCH the oracle's rows for that query at interval i
+        oracle = build_service(n_regs + len(windows) + 1,
+                               pad_pow2(n_regs + len(windows), 8))
+        ohandles: dict = {}
+        for cmds in schedule:
+            for cmd in cmds:
+                if cmd[0] == "register":
+                    _, rid, w, tenant = cmd
+                    ohandles[rid] = oracle.register(w, tenant=tenant)
+        oracle.run(warmup, collect=False)
+        oracle.sync()
+        oouts = oracle.run(n_timed, collect=True)
+        oracle.sync()
+        oracle.check_overflow()
+        oracle_match = True
+        for i, (bs, omap) in enumerate(zip(by_slot_per_interval,
+                                           slot_maps)):
+            obs_rows = oracle.results_by_slot(oouts[i])
+            for rid, slot in omap.items():
+                if _churn_rows(bs, slot) != _churn_rows(
+                        obs_rows, ohandles[rid].slot):
+                    oracle_match = False
+                    break
+            if not oracle_match:
+                break
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=float(np.percentile(lats, 99)) if lats else 0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    res.n_lat_samples = len(lats)
+    res.p50_emit_ms = float(np.percentile(lats, 50)) if lats else 0.0
+    res.emit_ms_device = wall / n_timed * 1e3
+    stats = svc.stats()
+    res.serving_retraces_after_warmup = int(retraces)
+    res.serving_registered = int(stats.get("serving_registered", 0))
+    res.serving_cancelled = int(stats.get("serving_cancelled", 0))
+    res.serving_rejected = int(stats.get("serving_rejected", 0))
+    res.serving_cache_hits = int(stats.get("serving_cache_hits", 0))
+    res.churn_ops = int(n_ops)
+    res.throughput_static = static_tps
+    res.throughput_delta_pct = (1.0 - res.tuples_per_sec
+                                / max(static_tps, 1e-9)) * 100.0
+    if oracle_match is not None:
+        res.oracle_match = bool(oracle_match)
+    # the full schedule, compactly: [interval, "r", reg_id, str(window),
+    # tenant] / [interval, "c", reg_id] — with the seed this is the
+    # complete reproduction recipe
+    res.churn_schedule = [
+        ([i, "r", cmd[1], str(cmd[2]), cmd[3]] if cmd[0] == "register"
+         else [i, "c", cmd[1]])
+        for i, cmds in enumerate(schedule) for cmd in cmds]
+    res.churn_seed = int(cfg.seed)
+    finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
+    return res
 
 
 def run_shaped_ooo_cell(cfg: BenchmarkConfig, window_spec: str,
@@ -1015,7 +1239,13 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                               "p99_emit_ms_trimmed", "n_stall_samples",
                               "n_trimmed_samples", "stall_flagged",
                               "tail_unattributed", "shaper_back_ms",
-                              "shaper_late_routed", "shaper_reordered"):
+                              "shaper_late_routed", "shaper_reordered",
+                              "serving_retraces_after_warmup",
+                              "serving_registered", "serving_cancelled",
+                              "serving_rejected", "serving_cache_hits",
+                              "churn_ops", "throughput_static",
+                              "throughput_delta_pct", "oracle_match",
+                              "churn_schedule", "churn_seed"):
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)

@@ -29,6 +29,7 @@ async def run_keyed_async(
         serve_port: Optional[int] = None,
         health=None,
         shaper=None,
+        control=None,
 ) -> None:
     """Consume (key, value, ts) from an async iterator; call ``emit`` for
     every (key, AggregateWindow) result. ``emit`` may be sync or async.
@@ -44,7 +45,14 @@ async def run_keyed_async(
 
     ``shaper`` (a :class:`scotty_tpu.shaper.ShaperConfig`, ISSUE 5)
     attaches the coalescing/sorting front-end to the operator for this
-    run; held records drain through ``emit`` when the source ends."""
+    run; held records drain through ``emit`` when the source ends.
+
+    ``control`` (ISSUE 6) is the register/cancel control path shared
+    with the iterable run loops: ``(after_records, command)`` rows, each
+    ``command`` called with the operator once that many records were
+    consumed."""
+    from .iterable import _apply_control, _control_cursor
+
     if shaper is not None:
         operator.attach_shaper(shaper)
     own_obs = obs if obs is not None and obs is not operator.obs else None
@@ -53,8 +61,12 @@ async def run_keyed_async(
     if serve_port is not None and eff_obs is not None:
         server = eff_obs.serve(port=serve_port, health=health)
         operator.obs_server = server
+    ctl, nxt = _control_cursor(control)
+    n_seen = 0
     try:
         async for key, value, ts in source:
+            nxt = _apply_control(operator, ctl, nxt, n_seen)
+            n_seen += 1
             items = operator.process_element(key, value, int(ts))
             if own_obs is not None:
                 own_obs.counter(_obs.INGEST_TUPLES).inc()
@@ -64,6 +76,7 @@ async def run_keyed_async(
                 r = emit(item)
                 if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
                     await r
+        nxt = _apply_control(operator, ctl, nxt, float("inf"))
         for item in operator.drain_shaper():
             r = emit(item)
             if asyncio.iscoroutine(r) or isinstance(r, Awaitable):

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
 from .. import obs as _obs
+from ..obs import flight as _flight
 from ..core.aggregates import AggregateFunction
 from ..core.operator import AggregateWindow
 from ..core.windows import Window
@@ -189,6 +190,59 @@ class KeyedScottyWindowOperator:
         out, self._shaper_results = self._shaper_results, []
         return out
 
+    # -- serving control path (ISSUE 6) ------------------------------------
+    def register_window(self, window: Window, tenant: str = "default") -> int:
+        """Register a window mid-stream on EVERY key — live per-key
+        operators immediately, keys first seen later at their creation —
+        and return a stable logical handle for :meth:`cancel_window`.
+        Host backend only (the keyed device batch bakes its spec into the
+        [K, ...] kernels; serve dynamic sets from
+        ``scotty_tpu.serving.QueryService`` there)."""
+        if self.backend != "host":
+            raise NotImplementedError(
+                "keyed register/cancel runs on the host backend; for "
+                "device-rate dynamic query sets use "
+                "scotty_tpu.serving.QueryService")
+        from ..core.windows import ContextFreeWindow, ForwardContextAware, \
+            ForwardContextFree
+
+        # validate EAGERLY (the same check each per-key simulator would
+        # make): with zero live keys the per-key loop below validates
+        # nothing, and an unsupported window must fail the registration —
+        # not the first process_element of a later-created key mid-stream
+        if not isinstance(window, ContextFreeWindow) or isinstance(
+                window, (ForwardContextAware, ForwardContextFree)):
+            raise NotImplementedError(
+                "serving register/cancel covers context-free grid windows; "
+                "session/context windows carry per-registration state")
+        if not hasattr(self, "_serving_regs"):
+            self._serving_regs = {}
+            self._serving_next = 0
+        h = self._serving_next
+        self._serving_next += 1
+        per_key = {key: op.register_window(window, tenant=tenant)
+                   for key, op in self._host_ops.items()}
+        self._serving_regs[h] = {"window": window, "tenant": tenant,
+                                 "per_key": per_key}
+        if self.obs is not None:
+            self.obs.counter(_obs.SERVING_REGISTERED).inc()
+            self.obs.flight_event(_flight.QUERY_REGISTER, f"{tenant}:{window}",
+                                  float(h))
+        return h
+
+    def cancel_window(self, handle: int, tenant: str = "default") -> None:
+        reg = getattr(self, "_serving_regs", {}).pop(handle, None)
+        if reg is None:
+            raise ValueError(
+                f"unknown or already-cancelled window handle {handle}")
+        for key, bh in reg["per_key"].items():
+            self._host_ops[key].cancel_window(bh, tenant=tenant)
+        if self.obs is not None:
+            self.obs.counter(_obs.SERVING_CANCELLED).inc()
+            self.obs.flight_event(_flight.QUERY_CANCEL,
+                                  f"{reg['tenant']}:{reg['window']}",
+                                  float(handle))
+
     # -- builder API (README.md:31-42 chaining) ----------------------------
     def add_window(self, window: Window) -> "KeyedScottyWindowOperator":
         self.windows.append(window)
@@ -214,6 +268,10 @@ class KeyedScottyWindowOperator:
             for a in self.aggregations:
                 op.add_aggregation(a)
             op.set_max_lateness(self.allowed_lateness)
+            # live serving registrations apply to late-arriving keys too
+            for reg in getattr(self, "_serving_regs", {}).values():
+                reg["per_key"][key] = op.register_window(
+                    reg["window"], tenant=reg["tenant"])
             self._host_ops[key] = op
         return op
 
@@ -419,6 +477,40 @@ class GlobalScottyWindowOperator:
     def add_window(self, window: Window) -> "GlobalScottyWindowOperator":
         self.windows.append(window)
         return self
+
+    # -- serving control path (ISSUE 6) ------------------------------------
+    def register_window(self, window: Window, tenant: str = "default") -> int:
+        """Register a window mid-stream; returns the backend's handle for
+        :meth:`cancel_window`. Delegates to the underlying operator's
+        serving path (host simulator / TpuWindowOperator); the sharded
+        global device backend has no per-window cancel and raises."""
+        op = self._operator()
+        if not hasattr(op, "register_window"):
+            raise NotImplementedError(
+                f"{type(op).__name__} has no serving control path; use "
+                "backend='host' or scotty_tpu.serving.QueryService")
+        h = op.register_window(window, tenant=tenant)
+        if not hasattr(self, "_serving_tenants"):
+            self._serving_tenants: dict = {}
+        self._serving_tenants[h] = tenant
+        if self.obs is not None:
+            self.obs.counter(_obs.SERVING_REGISTERED).inc()
+            self.obs.flight_event(_flight.QUERY_REGISTER, f"{tenant}:{window}",
+                                  float(h))
+        return h
+
+    def cancel_window(self, handle: int) -> None:
+        op = self._operator()
+        if not hasattr(op, "cancel_window"):
+            raise NotImplementedError(
+                f"{type(op).__name__} has no serving control path")
+        # flight attribution uses the REGISTRATION's tenant, matching the
+        # keyed wrapper — a cancel belongs to whoever registered the query
+        tenant = getattr(self, "_serving_tenants", {}).pop(handle, "default")
+        op.cancel_window(handle, tenant=tenant)
+        if self.obs is not None:
+            self.obs.counter(_obs.SERVING_CANCELLED).inc()
+            self.obs.flight_event(_flight.QUERY_CANCEL, tenant, float(handle))
 
     def add_aggregation(self, fn: AggregateFunction) -> "GlobalScottyWindowOperator":
         self.aggregations.append(fn)

@@ -20,10 +20,30 @@ from .. import obs as _obs
 from .base import GlobalScottyWindowOperator, KeyedScottyWindowOperator
 
 
+def _control_cursor(control):
+    """Normalize a run-loop control schedule (ISSUE 6): an iterable of
+    ``(after_records, command)`` rows, ``command`` a callable applied to
+    the operator — typically ``op.register_window(...)`` /
+    ``op.cancel_window(...)`` closures. Rows fire in order once the
+    record count reaches their threshold (and any remainder fires at
+    stream end, so a schedule can never be silently dropped)."""
+    if control is None:
+        return None, None
+    it = iter(sorted(control, key=lambda c: c[0]))
+    return it, next(it, None)
+
+
+def _apply_control(operator, it, nxt, n: int):
+    while nxt is not None and n >= nxt[0]:
+        nxt[1](operator)
+        nxt = next(it, None)
+    return nxt
+
+
 def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
               obs=None, dead_letter=None,
               poison_limit: int | None = None,
-              shaper=None) -> Iterator[Tuple]:
+              shaper=None, control=None) -> Iterator[Tuple]:
     """Drive a keyed operator from an iterable of (key, value, ts); yields
     (key, AggregateWindow) results as watermarks fire.
 
@@ -35,6 +55,12 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
     attaches the coalescing/sorting front-end to the operator for this
     run: records buffer into sorted blocks instead of trickling one at a
     time, and anything still held drains when the source ends.
+
+    ``control`` (ISSUE 6) is the register/cancel control path: an
+    iterable of ``(after_records, command)`` rows — each ``command`` is
+    called with the operator once that many records have been consumed
+    (e.g. ``lambda op: op.register_window(...)``), interleaving query
+    registration/cancellation deterministically with the stream.
     """
     from ..resilience.connectors import PoisonHandler
 
@@ -43,7 +69,11 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
     own_obs = obs if obs is not None and obs is not operator.obs else None
     poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                            obs=obs if obs is not None else operator.obs)
+    ctl, nxt = _control_cursor(control)
+    n_seen = 0
     for rec in source:
+        nxt = _apply_control(operator, ctl, nxt, n_seen)
+        n_seen += 1
         try:
             key, value, ts = rec
             ts = int(ts)
@@ -57,6 +87,7 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
                 own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
         for item in items:
             yield item
+    nxt = _apply_control(operator, ctl, nxt, float("inf"))
     for item in operator.drain_shaper() if hasattr(operator, "drain_shaper") \
             else ():
         yield item
@@ -65,10 +96,10 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
 def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
                obs=None, dead_letter=None,
                poison_limit: int | None = None,
-               shaper=None) -> Iterator:
+               shaper=None, control=None) -> Iterator:
     """Drive a global operator from an iterable of (value, ts) — same
     poison-record contract as :func:`run_keyed`, same optional
-    ``shaper`` front-end."""
+    ``shaper`` front-end, same ``control`` register/cancel path."""
     from ..resilience.connectors import PoisonHandler
 
     if shaper is not None:
@@ -76,7 +107,11 @@ def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
     own_obs = obs if obs is not None and obs is not operator.obs else None
     poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                            obs=obs if obs is not None else operator.obs)
+    ctl, nxt = _control_cursor(control)
+    n_seen = 0
     for rec in source:
+        nxt = _apply_control(operator, ctl, nxt, n_seen)
+        n_seen += 1
         try:
             value, ts = rec
             ts = int(ts)
@@ -90,6 +125,7 @@ def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
                 own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
         for item in items:
             yield item
+    nxt = _apply_control(operator, ctl, nxt, float("inf"))
     for item in operator.drain_shaper() if hasattr(operator, "drain_shaper") \
             else ():
         yield item

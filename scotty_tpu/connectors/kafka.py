@@ -69,7 +69,8 @@ class KafkaScottyWindowOperator:
             clock=None,
             serve_port: Optional[int] = None,
             health=None,
-            shaper=None) -> int:
+            shaper=None,
+            control=None) -> int:
         """``consumer``: any iterable of Kafka-like records (KafkaConsumer
         instances are iterables of ConsumerRecord). Returns records
         consumed (poison records count — they were consumed, then
@@ -95,8 +96,15 @@ class KafkaScottyWindowOperator:
         blocks on a silent topic there is no execution to evaluate it
         on — and anything still held drains through ``on_result`` at
         loop end.
+
+        ``control`` (ISSUE 6) is the register/cancel control path shared
+        with the iterable run loops: ``(after_records, command)`` rows,
+        each ``command`` called with the operator once that many records
+        were consumed (``lambda op: op.register_window(...)`` /
+        ``op.cancel_window(...)``); any remainder fires at loop end.
         """
         from ..resilience.connectors import PoisonHandler, watchdog_source
+        from .iterable import _apply_control, _control_cursor
 
         if shaper is not None:
             self.operator.attach_shaper(shaper, clock=clock)
@@ -110,8 +118,10 @@ class KafkaScottyWindowOperator:
             self.obs_server = self.operator.obs.serve(port=serve_port,
                                                       health=health)
         n = 0
+        ctl, nxt = _control_cursor(control)
         try:
             for record in consumer:
+                nxt = _apply_control(self.operator, ctl, nxt, n)
                 n += 1
                 try:
                     key, value, ts = self.deserialize(record)
@@ -123,6 +133,7 @@ class KafkaScottyWindowOperator:
                         on_result(item)
                 if max_records is not None and n >= max_records:
                     break
+            nxt = _apply_control(self.operator, ctl, nxt, float("inf"))
             for item in self.operator.drain_shaper():
                 on_result(item)
         finally:

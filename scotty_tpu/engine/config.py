@@ -89,10 +89,20 @@ class EngineConfig:
                 "expected one of ('fail', 'shed', 'grow')")
 
     def trigger_pad(self, n: int) -> int:
-        """Next power-of-two bucket ≥ n (≥ min_trigger_pad)."""
+        """Next power-of-two bucket ≥ n (≥ min_trigger_pad, ≤ max_triggers).
+
+        ``max_triggers`` is a HARD cap: a window set needing more trigger
+        rows than the cap raises here instead of silently returning a pad
+        above it (which would compile a query kernel bigger than the
+        documented bound and let ``n`` keep growing unnoticed).
+        """
+        if n > self.max_triggers:
+            raise ValueError(
+                f"{n} triggered windows exceeds EngineConfig.max_triggers="
+                f"{self.max_triggers}: raise max_triggers (pads the query "
+                "kernel larger), register fewer/coarser windows, or advance "
+                "watermarks more often so fewer triggers fire per interval")
         p = self.min_trigger_pad
         while p < n:
             p <<= 1
-        if p > self.max_triggers and n <= self.max_triggers:
-            p = self.max_triggers
-        return p
+        return min(p, self.max_triggers)

@@ -320,6 +320,11 @@ class TpuWindowOperator(WindowOperator):
         #: the surviving complement through the host oracle).
         self.shed_callback = None
         self.windows: List[ContextFreeWindow] = []
+        #: per-window active mask (ISSUE 6 serving control path): the
+        #: watermark trigger loop skips inactive windows, so
+        #: register_window/cancel_window never touch the compiled kernels
+        #: — registration order (and with it emission order) is preserved
+        self._win_active: List[bool] = []
         self.aggregations: List[AggregateFunction] = []
         self.max_lateness = 1000            # WindowManager.java:24 default
         self.max_fixed_window_size = 0
@@ -349,6 +354,7 @@ class TpuWindowOperator(WindowOperator):
             if window.measure != WindowMeasure.Time:
                 raise UnsupportedOnDevice("count-measure sessions: host only")
             self.windows.append(window)
+            self._win_active.append(True)
             return
         if isinstance(window, (ForwardContextAware, ForwardContextFree)):
             # user-defined context-aware windows run on the generic
@@ -368,6 +374,7 @@ class TpuWindowOperator(WindowOperator):
                     "(device_context_spec() is None); use "
                     "SlicingWindowOperator or HybridWindowOperator")
             self.windows.append(window)
+            self._win_active.append(True)
             return
         if not isinstance(window, (TumblingWindow, SlidingWindow,
                                    FixedBandWindow)):
@@ -380,6 +387,7 @@ class TpuWindowOperator(WindowOperator):
                 "count-measure fixed-band windows have no device path; use "
                 "SlicingWindowOperator")
         self.windows.append(window)
+        self._win_active.append(True)
         # the reference mixes count sizes into the (ms) GC delay bound —
         # WindowManager.java:121-127 takes clearDelay() of every
         # context-free window regardless of measure; mirrored for parity.
@@ -424,6 +432,7 @@ class TpuWindowOperator(WindowOperator):
                 "operator (count slicing would need a record replay)")
         self._flush()                      # old grid for already-fed tuples
         self.windows.append(window)
+        self._win_active.append(True)
         self.max_fixed_window_size = max(self.max_fixed_window_size,
                                          window.clear_delay())
         self._spec = self._grid_spec = self._compute_spec()
@@ -438,6 +447,119 @@ class TpuWindowOperator(WindowOperator):
             if dense_eligible(self._grid_spec) else 0
         self._min_grid = min_grid_period(self._grid_spec)
         self._ingest_dense = None
+
+    def _serving_compatible(self, window: Window) -> bool:
+        """Whether ``window`` can register against the BUILT kernels with
+        no rebuild: a Time-measure tumbling/sliding window whose edges all
+        land on slice cuts the existing union grid already makes —
+        tumbling: size a multiple of some registered period; sliding:
+        slide a multiple, and size a multiple of slide (or the residue
+        grid already in the spec). Anything else goes through the
+        `_add_window_dynamic` rebuild path."""
+        if self._session_windows or getattr(self, "_ctx_windows", None):
+            return False
+        if not isinstance(window, (TumblingWindow, SlidingWindow)) \
+                or window.measure != WindowMeasure.Time:
+            return False
+        periods = self._grid_spec.periods
+        if not periods:
+            return False
+        if isinstance(window, SlidingWindow):
+            sl, sz = int(window.slide), int(window.size)
+            if not any(sl % p == 0 for p in periods):
+                return False
+            if sz % sl == 0:
+                return True
+            return (sl, sz % sl) in self._grid_spec.offset_periods
+        return any(int(window.size) % p == 0 for p in periods)
+
+    def register_window(self, window: Window, tenant: str = "default") -> int:
+        """Serving control path (ISSUE 6): register a window mid-stream and
+        return an opaque handle for :meth:`cancel_window` (handles are
+        never reused — stale cancels raise instead of touching a
+        recycled slot).
+
+        When the window is :meth:`_serving_compatible` with the built
+        union grid, registration is PURE HOST BOOKKEEPING — the compiled
+        kernels are untouched and the next watermark simply enumerates
+        the new window's triggers (zero retrace; the query kernel's
+        trigger-pad bucket keeps it warm), reusing a cancelled
+        registration's window slot when one is free. Incompatible windows
+        fall back to the `_add_window_dynamic` kernel rebuild, counted as
+        a ``serving_retraces``. Like the dynamic-addition path, data GC'd
+        before registration is gone: the new window answers from the
+        slices still retained.
+        """
+        if not hasattr(self, "_serving_handles"):
+            self._serving_handles: dict = {}
+            self._serving_next = 0
+            self._win_free: list = []
+        retrace = False
+        if not self._built:
+            self.add_window_assigner(window)
+            idx = len(self.windows) - 1
+        elif self._serving_compatible(window):
+            self._flush()             # pending tuples precede registration
+            if self._win_free:
+                # recycle a cancelled registration's window slot so
+                # sustained churn bounds the list (and the per-watermark
+                # trigger scan) at PEAK concurrency, not total history
+                idx = self._win_free.pop()
+                self.windows[idx] = window
+                self._win_active[idx] = True
+            else:
+                self.windows.append(window)
+                self._win_active.append(True)
+                idx = len(self.windows) - 1
+            self.max_fixed_window_size = max(self.max_fixed_window_size,
+                                             window.clear_delay())
+        else:
+            self._add_window_dynamic(window)      # kernel rebuild
+            idx = len(self.windows) - 1
+            retrace = True
+        h = self._serving_next
+        self._serving_next += 1
+        self._serving_handles[h] = (idx, tenant)
+        if self.obs is not None:
+            from ..obs import flight as _flight
+
+            self.obs.counter(_obs.SERVING_REGISTERED).inc()
+            if retrace:
+                self.obs.counter(_obs.SERVING_RETRACES).inc()
+            self.obs.flight_event(_flight.QUERY_REGISTER,
+                                  f"{tenant}:{window}", float(h))
+        return h
+
+    def cancel_window(self, handle: int, tenant: str = "default") -> None:
+        """Deactivate a registered window: its triggers stop being
+        enumerated from the next watermark on (a host mask write — the
+        kernels, the slice state and every other window are untouched)
+        and its window slot joins the recycle list. Handles are opaque
+        and never reused (a stale handle raises; only
+        :meth:`register_window` registrations cancel — build-time windows
+        are the static contract). Session/context windows have no cancel
+        path (their sweeps carry per-window device state)."""
+        entry = getattr(self, "_serving_handles", {}).pop(handle, None)
+        if entry is None:
+            raise ValueError(
+                f"unknown or already-cancelled window handle {handle}")
+        idx, reg_tenant = entry
+        w = self.windows[idx]
+        if isinstance(w, (SessionWindow, ForwardContextAware,
+                          ForwardContextFree)):
+            self._serving_handles[handle] = entry     # nothing changed
+            raise UnsupportedOnDevice(
+                "session/context windows cannot be cancelled (their sweep "
+                "state is per-registration); only grid windows support "
+                "the serving control path")
+        self._win_active[idx] = False
+        self._win_free.append(idx)
+        if self.obs is not None:
+            from ..obs import flight as _flight
+
+            self.obs.counter(_obs.SERVING_CANCELLED).inc()
+            self.obs.flight_event(_flight.QUERY_CANCEL,
+                                  f"{reg_tenant}:{w}", float(handle))
 
     def add_aggregation(self, window_function: AggregateFunction) -> None:
         if self._built:
@@ -1494,7 +1616,9 @@ class TpuWindowOperator(WindowOperator):
                        else self._count_at(st, np.int64(watermark_ts)))
 
         trig_s, trig_e, trig_c = [], [], []
-        for w in self.windows:
+        for w, act in zip(self.windows, self._win_active):
+            if not act:
+                continue              # cancelled query: mask, not rebuild
             if isinstance(w, (SessionWindow, ForwardContextAware,
                               ForwardContextFree)):
                 continue              # context windows emit via their sweeps

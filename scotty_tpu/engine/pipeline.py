@@ -23,7 +23,8 @@ SlidingWindow.java:50-57).
 from __future__ import annotations
 
 import time
-from typing import List, Optional, Sequence
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -219,9 +220,144 @@ def build_trigger_grid(windows, wm_period_ms: int):
     return make_triggers_grouped, T_total
 
 
-def lower_interval(aggregations: Sequence[AggregateFunction], interval_out):
-    """Fetch + lower one interval's window results on host: list of
-    (start, end, count, [per-agg final value]) for non-empty windows."""
+QUERY_KIND_TUMBLING = 0
+QUERY_KIND_SLIDING = 1
+
+
+@dataclass(frozen=True)
+class SlotGeometry:
+    """Static geometry of a dynamic-query slot grid (scotty_tpu.serving).
+
+    The serving layer pads runtime window sets to power-of-two slot grids
+    so register/cancel stays inside one compiled executable: ``n_slots``
+    query rows, each answering up to ``triggers_per_slot`` triggers per
+    watermark interval, over the fixed aligned ``slice_grid``. Everything
+    here is trace-time static — changing any field is a new compile-cache
+    bucket (scotty_tpu.serving.cache), never an in-place mutation.
+    """
+
+    #: padded query-slot rows ([Q] mask/param arrays; power of two)
+    n_slots: int
+    #: static per-slot trigger lanes K: every admitted window must satisfy
+    #: ``wm_period // grid + 2 <= K`` (grid = slide for sliding windows,
+    #: size for tumbling)
+    triggers_per_slot: int
+    #: the aligned slice grid g (ms). Admission requires every window
+    #: size/slide to be a multiple — the aligned pipeline's exactness
+    #: condition (window edges land on slice edges)
+    slice_grid: int
+    #: retention bound fed to GC in place of the static set's max
+    #: ``clear_delay()`` — the largest window size admission will accept,
+    #: so slices live long enough for any query registered later
+    max_size: int
+
+    def __post_init__(self):
+        for f in ("n_slots", "triggers_per_slot", "slice_grid", "max_size"):
+            if int(getattr(self, f)) < 1:
+                raise ValueError(f"SlotGeometry.{f} must be >= 1")
+
+
+class QuerySlots(NamedTuple):
+    """Device-resident query table: the ``[Q]`` window-parameter rows and
+    the active mask carried in the serving step's donated state. A
+    register/cancel is ONE row write (``dynamic_update_slice`` via
+    ``.at[i].set``) — never a retrace."""
+
+    kinds: "jnp.ndarray"     # [Q] int32: QUERY_KIND_TUMBLING | _SLIDING
+    grids: "jnp.ndarray"     # [Q] int64: slide (sliding) / size (tumbling)
+    sizes: "jnp.ndarray"     # [Q] int64 window size
+    active: "jnp.ndarray"    # [Q] bool
+
+
+def init_query_slots(geometry: SlotGeometry,
+                     rows: Optional[dict] = None) -> QuerySlots:
+    """Fresh device table — all slots inactive (grid/size 1 so the masked
+    per-slot trigger arithmetic never divides by zero), or uploaded from a
+    host mirror dict of numpy rows (``kinds/grids/sizes/active``)."""
+    import jax
+    import jax.numpy as jnp
+
+    Q = geometry.n_slots
+    if rows is None:
+        kinds = np.zeros((Q,), np.int32)
+        grids = np.ones((Q,), np.int64)
+        sizes = np.ones((Q,), np.int64)
+        active = np.zeros((Q,), bool)
+    else:
+        kinds = np.asarray(rows["kinds"], np.int32)
+        grids = np.asarray(rows["grids"], np.int64)
+        sizes = np.asarray(rows["sizes"], np.int64)
+        active = np.asarray(rows["active"], bool)
+        if kinds.shape != (Q,):
+            raise ValueError(
+                f"query-table rows have {kinds.shape[0]} slots, geometry "
+                f"expects {Q}")
+    dev = jax.device_put((kinds, grids, sizes, active))
+    return QuerySlots(jnp.asarray(dev[0]), jnp.asarray(dev[1]),
+                      jnp.asarray(dev[2]), jnp.asarray(dev[3]))
+
+
+def build_slot_trigger_grid(geometry: SlotGeometry, wm_period_ms: int):
+    """Mask-aware trigger enumeration over a dynamic query-slot table.
+
+    The static :func:`build_trigger_grid` bakes each window's (grid, size,
+    kind) into the traced program; here they are DATA — ``[Q]`` device rows
+    read from the carried :class:`QuerySlots` — so registering or
+    cancelling a query never retraces. Per slot the same per-kind trigger
+    formulas run over a static ``[Q, K]`` lane grid (K =
+    ``geometry.triggers_per_slot``); lanes beyond a slot's own trigger
+    count, and whole slots with ``active=False``, fold into the validity
+    mask the query kernel already consumes.
+
+    Trigger semantics are identical to the static builder (tumbling: ends
+    on the size grid, ``end <= wm``; sliding: starts on the slide grid,
+    ``start >= 0 & end <= wm + 1`` — the reference guard
+    SlidingWindow.java:50-57 quirk included), so a slot's rows bit-match
+    the rows a static pipeline computes for the same window.
+
+    Returns ``(make_triggers(slots, last_wm, wm) -> (ws, we, valid), T)``
+    with ``T = Q * K``; row ``q*K + k`` belongs to slot ``q``.
+    """
+    import jax.numpy as jnp
+
+    Q, K = geometry.n_slots, geometry.triggers_per_slot
+    P = wm_period_ms
+
+    def make_triggers(slots: QuerySlots, last_wm, wm):
+        g = slots.grids[:, None]                       # [Q, 1]
+        sz = slots.sizes[:, None]
+        k = jnp.arange(K, dtype=jnp.int64)[None, :]    # [1, K]
+        # tumbling: ends on the size grid (grid == size)
+        t_ends = (last_wm // g + 1) * g + g * k
+        t_starts = t_ends - sz
+        t_ok = t_ends <= wm
+        # sliding: starts on the slide grid; ends = start + size are NOT
+        # grid multiples when size % slide != 0, so enumerate starts
+        s_starts = ((last_wm - sz) // g + 1) * g + g * k
+        s_ends = s_starts + sz
+        s_ok = (s_starts >= 0) & (s_ends <= wm + 1)
+        sliding = (slots.kinds == QUERY_KIND_SLIDING)[:, None]
+        ws = jnp.where(sliding, s_starts, t_starts)
+        we = jnp.where(sliding, s_ends, t_ends)
+        # exact per-slot trigger count (build_trigger_grid's maxk): the
+        # static lane count K only bounds it — admission enforces K is
+        # large enough for every admitted window
+        maxk = P // slots.grids + jnp.where(
+            slots.kinds == QUERY_KIND_SLIDING, 2, 1)
+        ok = (jnp.where(sliding, s_ok, t_ok)
+              & (k < maxk[:, None]) & slots.active[:, None])
+        return ws.reshape(-1), we.reshape(-1), ok.reshape(-1)
+
+    return make_triggers, Q * K
+
+
+def lower_interval_columns(aggregations: Sequence[AggregateFunction],
+                           interval_out):
+    """Fetch one interval's trigger columns and host-lower each
+    aggregation: ``(ws, we, cnt, [per-agg lowered [T] arrays])`` — the
+    one place the lowering contract lives (row-shaped consumers:
+    :func:`lower_interval`; slot-attributed consumers:
+    ``serving.QueryService.results_by_slot``)."""
     import jax
 
     ws, we, cnt, results = jax.device_get(interval_out)
@@ -229,6 +365,13 @@ def lower_interval(aggregations: Sequence[AggregateFunction], interval_out):
     for agg, res in zip(aggregations, results):
         spec = agg.device_spec()
         lowered.append(np.asarray(spec.lower(res, cnt)))
+    return ws, we, cnt, lowered
+
+
+def lower_interval(aggregations: Sequence[AggregateFunction], interval_out):
+    """Fetch + lower one interval's window results on host: list of
+    (start, end, count, [per-agg final value]) for non-empty windows."""
+    ws, we, cnt, lowered = lower_interval_columns(aggregations, interval_out)
     rows = []
     for i in range(ws.shape[0]):
         if cnt[i] > 0:
@@ -272,6 +415,13 @@ class FusedPipelineDriver:
     #: the carried DeviceMetrics (device pytree); None until reset() on a
     #: supporting pipeline
     dm = None
+    #: device-resident dynamic-query table (:class:`QuerySlots`) carried in
+    #: the serving step's donated state; None on every static pipeline
+    _qstate = None
+    #: times the jitted step's Python body ran — i.e. jit TRACES. The
+    #: serving layer's zero-steady-state-retrace contract is asserted on
+    #: this counter (scotty_tpu.serving; the churn bench records its delta)
+    _trace_count = 0
 
     def set_observability(self, obs) -> None:
         """Attach an :class:`scotty_tpu.obs.Observability`; pass ``None``
@@ -328,7 +478,12 @@ class FusedPipelineDriver:
         return not getattr(self, "_pipeline_ready", False)
 
     def _step_interval(self, key, i: int):
-        if self._uses_device_metrics:
+        if self._qstate is not None:
+            # serving mode: the query table rides the donated carry
+            (self.state, self.dm, self._qstate,
+             res) = self._step(self.state, self.dm, self._qstate, key,
+                               np.int64(i))
+        elif self._uses_device_metrics:
             self.state, self.dm, res = self._step(self.state, self.dm, key,
                                                   np.int64(i))
         else:
@@ -736,7 +891,8 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                  max_chunk_elems: int = 1 << 25, value_scale: float = 10_000.0,
                  out_of_order_pct: float = 0.0,
                  collect_device_metrics: bool = True,
-                 legacy_generator: bool = False):
+                 legacy_generator: bool = False,
+                 query_slots: Optional[SlotGeometry] = None):
         import jax
         import jax.numpy as jnp
 
@@ -775,7 +931,31 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             if a.device_spec() is None:
                 raise NotImplementedError(
                     "aligned pipeline: device-realizable aggregations only")
-        g = self.slice_grid(self.windows, wm_period_ms)
+        #: dynamic-query serving mode (scotty_tpu.serving): the trigger
+        #: grid reads a [Q] window-parameter table + active mask carried in
+        #: the step's donated state instead of baking self.windows in. The
+        #: slice grid and GC retention come from the SlotGeometry so state
+        #: evolution is independent of the registered set — the property
+        #: that makes register/cancel a mask write. None (default) leaves
+        #: the static step byte-identical.
+        self._query_slots = query_slots
+        self._qs_host = None
+        if query_slots is None:
+            g = self.slice_grid(self.windows, wm_period_ms)
+        else:
+            g = int(query_slots.slice_grid)
+            if wm_period_ms % g:
+                raise ValueError(
+                    f"SlotGeometry.slice_grid {g} must divide "
+                    f"wm_period_ms {wm_period_ms}")
+            for w in self.windows:
+                sl = int(w.slide) if isinstance(w, SlidingWindow) \
+                    else int(w.size)
+                if int(w.size) % g or sl % g:
+                    raise ValueError(
+                        f"{w}: size/slide must be multiples of the serving "
+                        f"slice grid {g} ms (aligned exactness)")
+            max_fixed = max(max_fixed, int(query_slots.max_size))
         if throughput * g % 1000:
             raise ValueError(
                 f"throughput {throughput} is not an integer number of tuples "
@@ -886,7 +1066,14 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         query = ec.build_query(spec, C, A)
         self._gc_kernel = jax.jit(ec.build_gc(spec, C, A), donate_argnums=0)
         self._init_state = lambda: ec.init_state(spec, C, A)
-        make_triggers, self.T = build_trigger_grid(self.windows, wm_period_ms)
+        if query_slots is None:
+            make_triggers, self.T = build_trigger_grid(self.windows,
+                                                       wm_period_ms)
+        else:
+            make_triggers, self.T = build_slot_trigger_grid(query_slots,
+                                                            wm_period_ms)
+        self._make_triggers = make_triggers
+        self._write_slot_fn = None
         P = wm_period_ms
 
         red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
@@ -1166,7 +1353,7 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                     parts.append(red[aspec.kind](lifted, axis=1))
             return parts
 
-        def step_impl(state, dm, key, interval_idx, d):
+        def step_impl(state, dm, qs, key, interval_idx, d):
             base = interval_idx * P
             if L:
                 state, dm = late_fold_active(state, dm, key, base)
@@ -1272,7 +1459,10 @@ class AlignedStreamPipeline(FusedPipelineDriver):
             )
             last_wm = jnp.where(interval_idx > 0, base,
                                 jnp.int64(first_lw))
-            ws, we, tmask = make_triggers(last_wm, base + P)
+            if qs is None:
+                ws, we, tmask = self._make_triggers(last_wm, base + P)
+            else:
+                ws, we, tmask = self._make_triggers(qs, last_wm, base + P)
             cnt, results = query(state, ws, we, tmask,
                                  jnp.zeros_like(tmask))
             if cdm:
@@ -1283,7 +1473,9 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                     + jnp.sum(tmask & (cnt > 0)),
                     slices_touched=dm.slices_touched + jnp.int64(S))
                 dm = _dev.record_occupancy(dm, state.n_slices, C)
-            return state, dm, (ws, we, cnt, results)
+            if qs is None:
+                return state, dm, (ws, we, cnt, results)
+            return state, dm, qs, (ws, we, cnt, results)
 
         self._step_impl = step_impl
         self._gen_rows = gen_rows
@@ -1309,10 +1501,24 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         self._n_chunks = self.S // d
         impl = self._step_impl
 
-        def step_at_d(state, dm, key, interval_idx):
-            return impl(state, dm, key, interval_idx, d)
+        if self._query_slots is None:
+            def step_at_d(state, dm, key, interval_idx):
+                # host-side trace counter: this body runs once per jit
+                # TRACE (the serving layer's zero-retrace contract reads
+                # it); no traced ops — the emitted HLO is unchanged
+                self._trace_count += 1
+                return impl(state, dm, None, key, interval_idx, d)
 
-        self._step = jax.jit(step_at_d, donate_argnums=(0, 1))
+            self._step = jax.jit(step_at_d, donate_argnums=(0, 1))
+        else:
+            def step_at_d(state, dm, qs, key, interval_idx):
+                self._trace_count += 1
+                return impl(state, dm, qs, key, interval_idx, d)
+
+            # the query table is part of the donated carry: XLA aliases it
+            # straight through (it is returned untouched), so the steady-
+            # state step moves zero extra bytes for it
+            self._step = jax.jit(step_at_d, donate_argnums=(0, 1, 2))
         self._pipeline_ready = False
 
     def chunk_candidates(self, k: int = 3) -> list:
@@ -1366,6 +1572,92 @@ class AlignedStreamPipeline(FusedPipelineDriver):
 
     def _init_pipeline_state(self) -> None:
         self.state = self._init_state()
+        if self._query_slots is not None:
+            self._qstate = init_query_slots(self._query_slots, self._qs_host)
+
+    # -- dynamic-query serving hooks (scotty_tpu.serving) ------------------
+    def set_query_rows(self, rows: Optional[dict]) -> None:
+        """Bind the HOST mirror of the query table (numpy ``kinds/grids/
+        sizes/active`` rows, kept by the serving layer's QueryTable — held
+        by reference, so in-place row writes stay visible). ``reset()``
+        and checkpoint restores re-upload the table from this mirror, so
+        a restore replays the active query set."""
+        if self._query_slots is None:
+            raise ValueError("not a serving pipeline (query_slots=None)")
+        self._qs_host = rows
+        if getattr(self, "_pipeline_ready", False):
+            self._qstate = init_query_slots(self._query_slots, rows)
+
+    def write_query_slot(self, slot: int, kind: int, grid: int, size: int,
+                         active: bool) -> None:
+        """One-row device table write — the register/cancel hot path. The
+        row index and parameters are traced arguments, so every write (any
+        slot, any geometry-compatible window) reuses ONE compiled
+        executable; the table buffer is donated and updated in place."""
+        import jax
+
+        if self._qstate is None:
+            if self._query_slots is None:
+                raise ValueError("not a serving pipeline")
+            self.reset()
+        if self._write_slot_fn is None:
+            def w(qs, i, kind, grid, size, act):
+                return QuerySlots(
+                    kinds=qs.kinds.at[i].set(kind),
+                    grids=qs.grids.at[i].set(grid),
+                    sizes=qs.sizes.at[i].set(size),
+                    active=qs.active.at[i].set(act))
+
+            self._write_slot_fn = jax.jit(w, donate_argnums=0)
+        self._qstate = self._write_slot_fn(
+            self._qstate, np.int32(slot), np.int32(kind), np.int64(grid),
+            np.int64(size), np.bool_(active))
+
+    def set_slot_geometry(self, geometry: SlotGeometry) -> None:
+        """Rebuild the step at a new slot-grid bucket (a counted retrace;
+        scotty_tpu.serving.cache keeps the old bucket's executable warm).
+        The carried slice state is untouched — its shapes are independent
+        of the query set — so a rebucket continues the stream exactly."""
+        if self._query_slots is None:
+            raise ValueError("not a serving pipeline (query_slots=None)")
+        if int(geometry.slice_grid) != self.grid:
+            raise ValueError(
+                f"slot-geometry slice grid {geometry.slice_grid} != the "
+                f"pipeline's aligned grid {self.grid}: the slice grid is "
+                "state-shaping and cannot change at a rebucket")
+        ready = getattr(self, "_pipeline_ready", False)
+        self._query_slots = geometry
+        self._make_triggers, self.T = build_slot_trigger_grid(
+            geometry, self.wm_period_ms)
+        self.set_rows_per_chunk(self.rows_per_chunk)
+        # rebucketing must NOT wipe mid-stream state (set_rows_per_chunk
+        # marks the pipeline for reset — correct for autotuning, wrong
+        # here); the caller re-uploads the re-padded table
+        self._pipeline_ready = ready
+
+    def compiled_step(self):
+        """(step, make_triggers, T, geometry, rows_per_chunk) — what the
+        serving compile cache stores per bucket."""
+        return (self._step, self._make_triggers, self.T, self._query_slots,
+                self.rows_per_chunk)
+
+    def adopt_compiled_step(self, entry) -> None:
+        """Re-enter a previously compiled bucket (cache hit): swap the
+        jitted step back in WITHOUT building a fresh closure — jax's jit
+        cache is keyed on the function object, so this reuses the warm
+        executable and traces nothing."""
+        step, make_triggers, T, geometry, d = entry
+        if self._query_slots is None:
+            raise ValueError("not a serving pipeline (query_slots=None)")
+        if int(geometry.slice_grid) != self.grid:
+            raise ValueError("cached bucket was built for a different "
+                             "slice grid")
+        self._step = step
+        self._make_triggers = make_triggers
+        self.T = T
+        self._query_slots = geometry
+        self.rows_per_chunk = d
+        self._n_chunks = self.S // d
 
     def _gc(self, bound) -> None:
         self.state = self._gc_kernel(self.state, bound)

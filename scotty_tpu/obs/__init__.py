@@ -130,6 +130,18 @@ SHAPER_LATE_ROUTED = "shaper_late_routed"
 SHAPER_SLACK_OVERFLOWS = "shaper_slack_overflows"
 SHAPER_FILL_RATIO = "shaper_fill_ratio"
 
+# dynamic-query serving contract (ISSUE 6 — scotty_tpu.serving; counters
+# moved by QueryService's control plane, gauges refreshed on every
+# register/cancel; per-tenant rollups are serving_tenant_active_<tenant>)
+SERVING_REGISTERED = "serving_registered"
+SERVING_CANCELLED = "serving_cancelled"
+SERVING_REJECTED = "serving_rejected"
+SERVING_RETRACES = "serving_retraces"
+SERVING_CACHE_HITS = "serving_cache_hits"
+SERVING_CACHE_MISSES = "serving_cache_misses"
+SERVING_CACHE_EVICTIONS = "serving_cache_evictions"
+SERVING_ACTIVE_QUERIES = "serving_active_queries"
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -173,6 +185,17 @@ METRIC_HELP = {
     SHAPER_SLACK_OVERFLOWS:
         "shaped batches whose late residue exceeded late_capacity",
     SHAPER_FILL_RATIO: "flushed shaper block size / batch_size",
+    SERVING_REGISTERED: "queries registered with the serving layer",
+    SERVING_CANCELLED: "queries cancelled (slots recycled)",
+    SERVING_REJECTED: "query registrations refused by admission control",
+    SERVING_RETRACES:
+        "serving-step recompiles forced by slot-grid bucket changes",
+    SERVING_CACHE_HITS:
+        "registers answered from a warm executable (current or cached "
+        "bucket)",
+    SERVING_CACHE_MISSES: "bucket changes that found no cached executable",
+    SERVING_CACHE_EVICTIONS: "compile-cache entries evicted (LRU)",
+    SERVING_ACTIVE_QUERIES: "currently active queries across all tenants",
     RESILIENCE_SHED_TUPLES: "tuples dropped by the SHED overflow policy",
     RESILIENCE_GROW_EVENTS: "GROW capacity doublings",
     RESILIENCE_CHECKPOINTS: "automatic supervisor checkpoints",
@@ -368,6 +391,9 @@ __all__ = [
     "EMIT_LATENCY_MS",
     "SHAPER_REORDERED_TUPLES", "SHAPER_FLUSHES", "SHAPER_HELD_TUPLES",
     "SHAPER_LATE_ROUTED", "SHAPER_SLACK_OVERFLOWS", "SHAPER_FILL_RATIO",
+    "SERVING_REGISTERED", "SERVING_CANCELLED", "SERVING_REJECTED",
+    "SERVING_RETRACES", "SERVING_CACHE_HITS", "SERVING_CACHE_MISSES",
+    "SERVING_CACHE_EVICTIONS", "SERVING_ACTIVE_QUERIES",
     "RESILIENCE_SHED_TUPLES", "RESILIENCE_GROW_EVENTS",
     "RESILIENCE_CHECKPOINTS", "RESILIENCE_RESTARTS",
     "RESILIENCE_SOURCE_RETRIES", "RESILIENCE_POISON_RECORDS",

@@ -75,6 +75,13 @@ DEFAULT_THRESHOLDS = {
         "shaper_held_tuples": {"direction": "lower", "default": 0},
         "shaper_reordered_tuples": {"direction": "lower", "default": 0,
                                     "rel_tol": 0.10},
+        # serving contract (ISSUE 6): steady-state serving must neither
+        # start recompiling (a retrace appearing or growing after warmup
+        # means the zero-retrace mask/bucket machinery regressed) nor
+        # start refusing registrations a baseline admitted. Both counters
+        # are lazily created, so "default": 0 gates the appearing case.
+        "serving_retraces": {"direction": "lower", "default": 0},
+        "serving_rejected": {"direction": "lower", "default": 0},
         # operations contract (ISSUE 4): flight-ring wraparound drops and
         # unhealthy /healthz verdicts appearing between two exports gate —
         # a run that silently lost its own black-box tail, or that an

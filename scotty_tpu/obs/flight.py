@@ -99,6 +99,15 @@ MARK = "mark"
 SHAPER_FLUSH = "shaper_flush"
 SHAPER_HELD = "shaper_held"
 SHAPER_OVERFLOW = "shaper_overflow"
+# dynamic-query serving events (ISSUE 6, scotty_tpu.serving): every
+# control-plane operation lands in the ring — register/cancel (name =
+# tenant:window, value = slot), admission reject, compile-cache eviction,
+# and slot-grid rebuckets (name = QxK geometry)
+QUERY_REGISTER = "query_register"
+QUERY_CANCEL = "query_cancel"
+QUERY_REJECT = "query_reject"
+QUERY_EVICT = "query_evict"
+QUERY_REBUCKET = "query_rebucket"
 
 
 class FlightRecorder:

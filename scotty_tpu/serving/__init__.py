@@ -1,0 +1,38 @@
+"""Dynamic multi-query serving (ISSUE 6): register/cancel thousands of
+windows at runtime with zero steady-state retraces.
+
+The reference Scotty's headline claim is thousands of concurrent windows
+answered from one shared slice store; every scotty_tpu pipeline used to
+bake its window set into the jitted step at build time. This package is
+the production version of the claim on the static-shape XLA engine:
+
+* :class:`QueryService` — the serving facade: ``register(window,
+  tenant=...)`` / ``cancel(handle)`` against a shared-slice aligned
+  pipeline; device-resident ``[Q]`` active-query masks (one row write per
+  control operation, never a retrace), a geometry-bucketed compile cache,
+  admission control with per-tenant quotas, ``serving_*`` telemetry and
+  flight events, and query-table checkpointing (restores replay the
+  active set).
+* :class:`QueryTable` / :class:`QueryHandle` — host slot bookkeeping with
+  LIFO free-slot recycling and per-slot generations.
+* :class:`QueryAdmission` / :class:`QueryRejected` — the fail/shed
+  admission policy (the PR 3 overflow discipline at the control plane).
+* :class:`GeometryCache` / :class:`BucketKey` / :func:`pad_pow2` — the
+  power-of-two bucketed executable cache.
+
+The engine-side machinery (the masked trigger grid, the donated-state
+query table) lives in :mod:`scotty_tpu.engine.pipeline`
+(``SlotGeometry``, ``QuerySlots``, ``build_slot_trigger_grid``); this
+package depends on the engine, never the reverse.
+"""
+
+from .admission import QueryAdmission, QueryRejected
+from .cache import BucketKey, GeometryCache, pad_pow2
+from .service import QueryService, replay_schedule
+from .table import QueryHandle, QueryTable, ServingUnsupported, window_row
+
+__all__ = [
+    "QueryService", "QueryAdmission", "QueryRejected", "QueryHandle",
+    "QueryTable", "ServingUnsupported", "window_row", "GeometryCache",
+    "BucketKey", "pad_pow2", "replay_schedule",
+]

@@ -1,0 +1,77 @@
+"""Query admission control: slot capacity + per-tenant quotas.
+
+The control-plane twin of the PR 3 data-plane overflow policies
+(:mod:`scotty_tpu.resilience.policy`): where SHED decides which *tuples*
+an overloaded engine drops, :class:`QueryAdmission` decides which *query
+registrations* an over-subscribed serving layer refuses — with the same
+discipline: ``fail`` raises an actionable error, ``shed`` refuses
+quietly but EXACTLY accounted (``serving_rejected`` counter, a
+``query_reject`` flight event, and an auditable ``reject_callback`` —
+the dead-letter face).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+class QueryRejected(RuntimeError):
+    """A register was refused by admission control (capacity or quota).
+
+    Carries ``reason`` (``"capacity"`` | ``"quota"``) and ``tenant``.
+    Raised only under ``on_reject="fail"``; the ``"shed"`` policy returns
+    ``None`` from register instead.
+    """
+
+    def __init__(self, msg: str, reason: str, tenant: str):
+        super().__init__(msg)
+        self.reason = reason
+        self.tenant = tenant
+
+
+@dataclass(frozen=True)
+class QueryAdmission:
+    """Static admission policy for a :class:`~scotty_tpu.serving.
+    QueryService`.
+
+    ``max_queries`` caps ACTIVE queries across all tenants (the slot grid
+    never grows past its power-of-two pad); ``per_tenant_quota`` caps one
+    tenant's active queries (0 = unlimited); ``on_reject`` follows the
+    resilience vocabulary: ``"fail"`` raises :class:`QueryRejected`,
+    ``"shed"`` refuses quietly-but-counted and hands the refused window to
+    ``reject_callback(window, tenant, reason)`` when set.
+    """
+
+    max_queries: int = 1024
+    per_tenant_quota: int = 0
+    on_reject: str = "fail"
+    reject_callback: Optional[Callable] = None
+
+    def __post_init__(self):
+        if self.max_queries < 1:
+            raise ValueError("QueryAdmission.max_queries must be >= 1")
+        if self.per_tenant_quota < 0:
+            raise ValueError("QueryAdmission.per_tenant_quota must be >= 0")
+        if self.on_reject not in ("fail", "shed"):
+            raise ValueError(
+                f"unknown on_reject {self.on_reject!r}: expected 'fail' or "
+                "'shed' (the resilience overflow-policy vocabulary)")
+
+    def check(self, n_active: int, tenant_active: int,
+              tenant: str) -> Optional[str]:
+        """``None`` when admissible, else the rejection reason."""
+        if n_active >= self.max_queries:
+            return "capacity"
+        if self.per_tenant_quota and tenant_active >= self.per_tenant_quota:
+            return "quota"
+        return None
+
+    def reject_message(self, reason: str, tenant: str) -> str:
+        if reason == "capacity":
+            return (f"query capacity exhausted: {self.max_queries} active "
+                    "queries (QueryAdmission.max_queries) — cancel queries "
+                    "or raise the cap")
+        return (f"tenant {tenant!r} is at its quota of "
+                f"{self.per_tenant_quota} active queries "
+                "(QueryAdmission.per_tenant_quota)")

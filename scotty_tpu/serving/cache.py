@@ -1,0 +1,88 @@
+"""Geometry-bucketed compile cache for serving executables.
+
+Register/cancel inside one slot-grid bucket never recompiles (the mask
+write is data); what CAN force a recompile is a bucket change — more
+slots than the current power-of-two pad, or a finer-slide window needing
+more trigger lanes per slot. This cache keeps each bucket's jitted step
+(and its trigger builder) alive so returning to a previously-seen bucket
+reuses the warm executable instead of retracing: cache keys are the
+static fields that shape the executable — window-class family, measure,
+the power-of-two pad buckets (slots × trigger lanes, computed with the
+same next-power-of-two discipline as ``EngineConfig.trigger_pad``), the
+generation chunking, and the full frozen ``EngineConfig``. Hits, misses,
+and LRU evictions are all counted (``serving_cache_*``).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Optional
+
+
+def pad_pow2(n: int, floor: int) -> int:
+    """Next power-of-two bucket >= n (>= floor) — the same bucketing rule
+    as ``EngineConfig.trigger_pad``, with the floor a serving parameter
+    instead of ``min_trigger_pad`` (slot grids are usually far smaller
+    than trigger pads)."""
+    if n < 0:
+        raise ValueError(f"pad_pow2: n must be >= 0, got {n}")
+    p = max(1, int(floor))
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclass(frozen=True)
+class BucketKey:
+    """Everything static that shapes a serving executable."""
+
+    window_family: str          # "time-grid" (tumbling/sliding) for now
+    measure: str                # "Time"
+    n_slots: int                # padded [Q]
+    triggers_per_slot: int      # padded K
+    slice_grid: int
+    max_size: int
+    rows_per_chunk: int
+    engine_config: object       # frozen EngineConfig dataclass (hashable)
+    wm_period_ms: int
+
+
+class GeometryCache:
+    """Bounded LRU of ``BucketKey -> compiled-step entry`` (the tuple
+    :meth:`AlignedStreamPipeline.compiled_step` returns)."""
+
+    def __init__(self, capacity: int = 8):
+        if capacity < 1:
+            raise ValueError("GeometryCache capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._entries: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: BucketKey):
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return entry
+
+    def put(self, key: BucketKey, entry) -> Optional[BucketKey]:
+        """Insert (or refresh) an entry; returns the evicted key, if any."""
+        self._entries[key] = entry
+        self._entries.move_to_end(key)
+        if len(self._entries) > self.capacity:
+            old_key, _ = self._entries.popitem(last=False)
+            self.evictions += 1
+            return old_key
+        return None
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "hits": self.hits,
+                "misses": self.misses, "evictions": self.evictions}

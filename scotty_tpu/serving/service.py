@@ -1,0 +1,499 @@
+"""QueryService — the dynamic multi-query serving layer (ISSUE 6 tentpole).
+
+Sits between callers and the fused aligned engine: thousands of
+concurrent windows answered from ONE shared slice store (the reference's
+headline general-slicing claim, SURVEY §2), with queries registered and
+cancelled at runtime:
+
+* **register/cancel is a mask write, not a retrace** — the window
+  parameters and active mask live in a device-resident ``[Q]`` table
+  (:class:`~scotty_tpu.engine.pipeline.QuerySlots`) carried in the jitted
+  step's donated state; :meth:`register`/:meth:`cancel` writes one row
+  through a single shared jitted writer. Cancelled slots recycle through
+  the host table's LIFO free-list.
+* **geometry-bucketed compile cache** — window sets pad to power-of-two
+  slot grids (:func:`~.cache.pad_pow2`, the ``EngineConfig.trigger_pad``
+  bucketing discipline); a register that outgrows the current bucket
+  swaps buckets through :class:`~.cache.GeometryCache`, so returning to
+  a warm bucket reuses its executable (``serving_cache_hits``) and only
+  a genuinely new bucket compiles (``serving_retraces``).
+* **admission + tenancy** — :class:`~.admission.QueryAdmission` caps
+  total and per-tenant active queries with the PR 3 fail/shed
+  discipline; every register/cancel/reject/evict lands a flight-recorder
+  event and moves the ``serving_*`` counters, with per-tenant active
+  rollups (``serving_tenant_active_<tenant>``) on the PR 4
+  ``/metrics``·``/vars`` endpoint.
+
+The engine state (slice buffer, RNG, interval counter) is INDEPENDENT of
+the registered query set — the aligned generator fills every slice row
+regardless — which is what makes all of the above sound: a query
+registered mid-stream immediately answers windows over slices that were
+ingested before it existed (shared slicing), and a differential oracle
+can replay the same churn schedule against an always-active superset and
+demand bit-equality (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import obs as _obs
+from ..engine.config import EngineConfig
+from ..engine.pipeline import (
+    AlignedStreamPipeline,
+    SlotGeometry,
+)
+from ..obs import flight as _flight
+from .admission import QueryAdmission, QueryRejected
+from .cache import BucketKey, GeometryCache, pad_pow2
+from .table import QueryHandle, QueryTable, window_row
+
+TABLE_SCHEMA = "scotty_tpu.query_table/1"
+
+_TENANT_RE = re.compile(r"[^0-9a-zA-Z_]")
+
+
+def _tenant_metric(tenant: str) -> str:
+    return "serving_tenant_active_" + _TENANT_RE.sub("_", tenant)
+
+
+class QueryService:
+    """Register/cancel windows against a shared-slice serving pipeline.
+
+    ``slice_grid`` fixes the aligned slice grid (every admitted window's
+    size/slide must be a multiple); ``max_window_size`` fixes GC
+    retention (the largest admissible window). Both are state-shaping and
+    immutable for the service's lifetime — everything else (slot count,
+    trigger lanes) rebuckets on demand.
+    """
+
+    def __init__(self, aggregations: Sequence, *,
+                 slice_grid: int,
+                 max_window_size: int,
+                 throughput: int,
+                 wm_period_ms: int = 1000,
+                 max_lateness: int = 1000,
+                 seed: int = 0,
+                 config: Optional[EngineConfig] = None,
+                 admission: Optional[QueryAdmission] = None,
+                 windows: Sequence = (),
+                 min_slots: int = 8,
+                 min_trigger_lanes: int = 8,
+                 cache_capacity: int = 8,
+                 obs=None,
+                 **pipeline_kwargs):
+        self.config = config or EngineConfig()
+        self.admission = admission or QueryAdmission()
+        self.obs = obs
+        self.slice_grid = int(slice_grid)
+        self.max_window_size = int(max_window_size)
+        self.wm_period_ms = int(wm_period_ms)
+        self.min_slots = int(min_slots)
+        self.min_trigger_lanes = int(min_trigger_lanes)
+        self.cache = GeometryCache(cache_capacity)
+        self._counters = {}
+        self._gauged_tenants: set = set()
+        #: jit traces already attributed to serving_retraces (the first
+        #: trace is the initial build, never a retrace)
+        self._counted_retraces = 0
+
+        # initial bucket: sized for the seed window set (padded), lanes
+        # sized for its finest slide
+        rows = [window_row(w, self.slice_grid, self.max_window_size)
+                for w in windows]
+        lanes = max([self.min_trigger_lanes]
+                    + [self._lanes_for(k, g) for (k, g, _) in rows])
+        q0 = pad_pow2(max(len(rows), 1), self.min_slots)
+        geometry = SlotGeometry(
+            n_slots=q0, triggers_per_slot=pad_pow2(lanes,
+                                                   self.min_trigger_lanes),
+            slice_grid=self.slice_grid, max_size=self.max_window_size)
+        self._check_trigger_budget(geometry)
+        self.table = QueryTable(geometry.n_slots)
+        self.pipeline = AlignedStreamPipeline(
+            [], list(aggregations), config=self.config,
+            throughput=throughput, wm_period_ms=wm_period_ms,
+            max_lateness=max_lateness, seed=seed,
+            query_slots=geometry, **pipeline_kwargs)
+        self.pipeline.set_query_rows(self.table.rows)
+        self.cache.put(self._bucket_key(geometry),
+                       self.pipeline.compiled_step())
+        self._warm_traces = None          # set by mark_warm()
+        #: slots whose host rows changed but whose device rows haven't:
+        #: control operations write the host mirror eagerly and the device
+        #: LAZILY at the next step (a few slots -> per-row jitted writes;
+        #: a churn burst -> one whole-table upload), so a burst of N
+        #: registers costs one transfer, not N dispatches
+        self._dirty: set = set()
+        for w, r in zip(windows, rows):
+            h = self._admit_row(w, *r, tenant="default")
+            if h is None:       # pragma: no cover — seed set under shed
+                raise QueryRejected(
+                    "seed window set exceeds admission limits", "capacity",
+                    "default")
+
+    # -- geometry ----------------------------------------------------------
+    def _lanes_for(self, kind: int, grid: int) -> int:
+        from ..engine.pipeline import QUERY_KIND_SLIDING
+
+        return self.wm_period_ms // int(grid) \
+            + (2 if kind == QUERY_KIND_SLIDING else 1)
+
+    def _bucket_key(self, geometry: SlotGeometry) -> BucketKey:
+        return BucketKey(
+            window_family="time-grid", measure="Time",
+            n_slots=geometry.n_slots,
+            triggers_per_slot=geometry.triggers_per_slot,
+            slice_grid=geometry.slice_grid, max_size=geometry.max_size,
+            rows_per_chunk=self.pipeline.rows_per_chunk
+            if hasattr(self, "pipeline") else 0,
+            engine_config=self.config, wm_period_ms=self.wm_period_ms)
+
+    def _check_trigger_budget(self, geometry: SlotGeometry) -> None:
+        T = geometry.n_slots * geometry.triggers_per_slot
+        if T > self.config.max_triggers:
+            raise ValueError(
+                f"slot grid {geometry.n_slots} x {geometry.triggers_per_slot}"
+                f" = {T} trigger rows exceeds EngineConfig.max_triggers="
+                f"{self.config.max_triggers}: raise max_triggers, coarsen "
+                "the slice grid, or cap the query count lower")
+
+    @property
+    def geometry(self) -> SlotGeometry:
+        return self.pipeline._query_slots
+
+    # -- telemetry ---------------------------------------------------------
+    def _count(self, name: str, delta: int = 1) -> None:
+        self._counters[name] = self._counters.get(name, 0) + delta
+        if self.obs is not None:
+            self.obs.counter(name).inc(delta)
+
+    def _gauges(self) -> None:
+        if self.obs is None:
+            return
+        self.obs.gauge(_obs.SERVING_ACTIVE_QUERIES).set(self.table.n_active)
+        rollup = self.table.tenant_rollup()
+        for tenant, n in rollup.items():
+            self.obs.gauge(_tenant_metric(tenant)).set(n)
+        # a tenant whose last query was cancelled must read 0, not its
+        # final nonzero value forever
+        for tenant in self._gauged_tenants - set(rollup):
+            self.obs.gauge(_tenant_metric(tenant)).set(0)
+        self._gauged_tenants = set(rollup)
+
+    def _flight(self, kind: str, name: str, value: float = 0.0) -> None:
+        if self.obs is not None:
+            self.obs.flight_event(kind, name, value)
+
+    def _reconcile_retraces(self) -> None:
+        """Fold ACTUAL jit traces into ``serving_retraces``: the counter
+        tracks the pipeline's trace counter (minus the initial build),
+        not the cache-miss count — so a cached-but-never-executed bucket
+        adopted as a "hit" still counts when its first run traces."""
+        extra = int(self.pipeline._trace_count) - 1 - self._counted_retraces
+        if extra > 0:
+            self._count(_obs.SERVING_RETRACES, extra)
+            self._counted_retraces += extra
+
+    def stats(self) -> dict:
+        """Serving counters + cache stats + live trace count (the churn
+        bench serializes this)."""
+        self._reconcile_retraces()
+        out = dict(self._counters)
+        out.update({f"cache_{k}": v for k, v in self.cache.stats().items()})
+        out["active_queries"] = self.table.n_active
+        out["n_slots"] = self.geometry.n_slots
+        out["triggers_per_slot"] = self.geometry.triggers_per_slot
+        out["trace_count"] = int(self.pipeline._trace_count)
+        out["tenants"] = self.table.tenant_rollup()
+        return out
+
+    def mark_warm(self) -> None:
+        """Freeze the warmup trace baseline: :attr:`retraces_since_warm`
+        counts jit traces AFTER this point (the churn bench's
+        zero-steady-state-retrace acceptance reads it)."""
+        self._warm_traces = int(self.pipeline._trace_count)
+
+    @property
+    def retraces_since_warm(self) -> int:
+        base = self._warm_traces
+        if base is None:
+            raise ValueError("mark_warm() was never called")
+        return int(self.pipeline._trace_count) - base
+
+    # -- the control plane -------------------------------------------------
+    def register(self, window, tenant: str = "default"
+                 ) -> Optional[QueryHandle]:
+        """Admit + activate one window query; returns its handle, or
+        ``None`` when admission sheds it (``on_reject="shed"``).
+
+        Structural impossibility (wrong window class/measure, edges off
+        the slice grid, size beyond retention) raises
+        :class:`~.table.ServingUnsupported` regardless of policy — those
+        are caller errors, not load."""
+        kind, grid, size = window_row(window, self.slice_grid,
+                                      self.max_window_size)
+        return self._admit_row(window, kind, grid, size, tenant)
+
+    def _admit_row(self, window, kind: int, grid: int, size: int,
+                   tenant: str) -> Optional[QueryHandle]:
+        reason = self.admission.check(self.table.n_active,
+                                      self.table.tenant_active(tenant),
+                                      tenant)
+        if reason is not None:
+            self._count(_obs.SERVING_REJECTED)
+            self._flight(_flight.QUERY_REJECT, f"{tenant}:{window}")
+            if self.admission.reject_callback is not None:
+                self.admission.reject_callback(window, tenant, reason)
+            if self.admission.on_reject == "fail":
+                raise QueryRejected(
+                    self.admission.reject_message(reason, tenant),
+                    reason, tenant)
+            return None
+
+        geom = self.geometry
+        lanes = self._lanes_for(kind, grid)
+        want_lanes = geom.triggers_per_slot
+        want_slots = geom.n_slots
+        if lanes > want_lanes:
+            want_lanes = pad_pow2(lanes, self.min_trigger_lanes)
+        if self.table.n_free == 0:
+            want_slots = pad_pow2(self.table.n_slots + 1, self.min_slots)
+        if want_lanes != geom.triggers_per_slot \
+                or want_slots != geom.n_slots:
+            self._rebucket(want_slots, want_lanes)
+        else:
+            # a register that stays in the current bucket IS the warm-
+            # executable case the cache exists for
+            self.cache.hits += 1
+            self._count(_obs.SERVING_CACHE_HITS)
+
+        handle = self.table.allocate(kind, grid, size, tenant)
+        self._dirty.add(handle.slot)
+        self._count(_obs.SERVING_REGISTERED)
+        self._flight(_flight.QUERY_REGISTER, f"{tenant}:{window}",
+                     float(handle.slot))
+        self._gauges()
+        return handle
+
+    def cancel(self, handle: QueryHandle) -> None:
+        """Deactivate a query: one device mask write; the slot returns to
+        the free-list and is recycled LIFO by the next register."""
+        slot = self.table.release(handle)
+        self._dirty.add(slot)
+        self._count(_obs.SERVING_CANCELLED)
+        self._flight(_flight.QUERY_CANCEL, handle.tenant, float(slot))
+        self._gauges()
+
+    def _rebucket(self, n_slots: int, lanes: int) -> None:
+        geom = SlotGeometry(n_slots=n_slots, triggers_per_slot=lanes,
+                            slice_grid=self.slice_grid,
+                            max_size=self.max_window_size)
+        self._check_trigger_budget(geom)
+        if geom.n_slots > self.table.n_slots:
+            self.table.grow(geom.n_slots)
+        key = self._bucket_key(geom)
+        entry = self.cache.get(key)
+        if entry is not None:
+            self.pipeline.adopt_compiled_step(entry)
+            self._count(_obs.SERVING_CACHE_HITS)
+        else:
+            self.pipeline.set_slot_geometry(geom)
+            evicted = self.cache.put(key, self.pipeline.compiled_step())
+            self._count(_obs.SERVING_CACHE_MISSES)
+            # the fresh closure traces on its next call; serving_retraces
+            # counts ACTUAL traces via _reconcile_retraces, not misses
+            if evicted is not None:
+                self._count(_obs.SERVING_CACHE_EVICTIONS)
+                self._flight(_flight.QUERY_EVICT,
+                             f"{evicted.n_slots}x{evicted.triggers_per_slot}")
+        # re-upload the (possibly re-padded) table at the new geometry
+        self.pipeline.set_query_rows(self.table.rows)
+        self._dirty.clear()               # the upload carried every row
+        self._flight(_flight.QUERY_REBUCKET,
+                     f"{geom.n_slots}x{geom.triggers_per_slot}")
+
+    def compact(self) -> bool:
+        """Shrink the slot grid back to the active set's needs (padded).
+
+        Rebucketing only ever grows during registration; after a
+        cancel-heavy phase this walks the geometry back down — usually
+        onto a bucket whose executable is still in the compile cache, so
+        compaction is a warm swap, not a retrace. Slots above the new pad
+        must all be free (live handles pin their slots); when they are
+        not, compaction is skipped. Returns True when the bucket
+        changed."""
+        geom = self.geometry
+        occupied = np.flatnonzero(self.table.active)
+        top = int(occupied.max()) + 1 if occupied.size else 0
+        want_slots = pad_pow2(max(top, 1), self.min_slots)
+        active_lanes = [self._lanes_for(int(self.table.kinds[s]),
+                                        int(self.table.grids[s]))
+                        for s in occupied]
+        want_lanes = pad_pow2(max(active_lanes, default=1),
+                              self.min_trigger_lanes)
+        if want_slots >= geom.n_slots and want_lanes >= \
+                geom.triggers_per_slot:
+            return False
+        want_slots = min(want_slots, geom.n_slots)
+        want_lanes = min(want_lanes, geom.triggers_per_slot)
+        # shrink the host table too (generation counters are retired, not
+        # reset — a later grow resumes them, keeping stale handles dead)
+        self.table.shrink(want_slots)
+        self._rebucket(want_slots, want_lanes)
+        return True
+
+    def _sync_table(self) -> None:
+        """Flush pending control-plane writes to the device table: up to a
+        handful of slots as single jitted row writes (the one-row-write
+        hot path), a churn burst as ONE whole-table upload."""
+        if not self._dirty:
+            return
+        if len(self._dirty) <= 4:
+            for slot in sorted(self._dirty):
+                self.pipeline.write_query_slot(
+                    slot, int(self.table.kinds[slot]),
+                    int(self.table.grids[slot]),
+                    int(self.table.sizes[slot]),
+                    bool(self.table.active[slot]))
+        else:
+            self.pipeline.set_query_rows(self.table.rows)
+        self._dirty.clear()
+
+    # -- the data plane (pipeline passthrough) -----------------------------
+    def run(self, n_intervals: int, collect: bool = True):
+        self._sync_table()
+        out = self.pipeline.run(n_intervals, collect=collect)
+        self._reconcile_retraces()       # the step traces inside run()
+        return out
+
+    def sync(self) -> int:
+        return self.pipeline.sync()
+
+    def check_overflow(self) -> None:
+        self.pipeline.check_overflow()
+
+    def set_observability(self, obs) -> None:
+        self.obs = obs
+        self.pipeline.set_observability(obs)
+        self._gauges()
+
+    def lowered_results(self, interval_out) -> list:
+        return self.pipeline.lowered_results(interval_out)
+
+    def results_by_slot(self, interval_out) -> dict:
+        """One interval's emissions attributed to slots: ``{slot: [(start,
+        end, count, [values...]), ...]}`` — trigger row ``q*K + k``
+        belongs to slot ``q``. Only non-empty rows appear."""
+        from ..engine.pipeline import lower_interval_columns
+
+        K = self.geometry.triggers_per_slot
+        ws, we, cnt, lowered = lower_interval_columns(
+            self.pipeline.aggregations, interval_out)
+        if ws.shape[0] != self.geometry.n_slots * K:
+            raise ValueError(
+                f"interval output has {ws.shape[0]} trigger rows but the "
+                f"CURRENT geometry is {self.geometry.n_slots} x {K}: the "
+                "service rebucketed since this output was produced — "
+                "attribute results before registering queries that change "
+                "the bucket (slot attribution depends on the geometry the "
+                "step ran under)")
+        out: dict = {}
+        for i in range(ws.shape[0]):
+            if cnt[i] > 0:
+                out.setdefault(i // K, []).append(
+                    (int(ws[i]), int(we[i]), int(cnt[i]),
+                     [lw[i] for lw in lowered]))
+        return out
+
+    # -- checkpoint / restore (ISSUE 6: restores replay the active set) ----
+    def save(self, path: str) -> None:
+        """Snapshot engine state (the PR 3 pipeline checkpoint) PLUS the
+        query table, so a restore replays the exact active query set —
+        handles, free-list order, tenants, and slot generations
+        included."""
+        from ..utils.checkpoint import save_pipeline
+
+        save_pipeline(self.pipeline, path)
+        geom = self.geometry
+        doc = {
+            "schema": TABLE_SCHEMA,
+            "table": self.table.state_dict(),
+            "geometry": {
+                "n_slots": geom.n_slots,
+                "triggers_per_slot": geom.triggers_per_slot,
+                "slice_grid": geom.slice_grid,
+                "max_size": geom.max_size,
+            },
+        }
+        tmp = os.path.join(path, f"query_table.json.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, "query_table.json"))
+
+    def restore(self, path: str) -> None:
+        """Restore engine state + query table into this service (same
+        constructor configuration). The table re-uploads to the device
+        before the state restore, so the first post-restore interval
+        already answers the saved active set."""
+        from ..utils.checkpoint import restore_pipeline
+
+        with open(os.path.join(path, "query_table.json")) as f:
+            doc = json.load(f)
+        if doc.get("schema") != TABLE_SCHEMA:
+            raise ValueError(
+                f"{path}: not a serving checkpoint "
+                f"(schema={doc.get('schema')!r})")
+        gd = doc["geometry"]
+        if int(gd["slice_grid"]) != self.slice_grid \
+                or int(gd["max_size"]) != self.max_window_size:
+            raise ValueError(
+                "serving checkpoint was taken under a different slice "
+                "grid / retention bound — construct the service with the "
+                "same slice_grid and max_window_size as saved")
+        geom = SlotGeometry(n_slots=int(gd["n_slots"]),
+                            triggers_per_slot=int(gd["triggers_per_slot"]),
+                            slice_grid=self.slice_grid,
+                            max_size=self.max_window_size)
+        self.table = QueryTable.from_state_dict(doc["table"])
+        if geom != self.geometry:
+            self._rebucket(geom.n_slots, geom.triggers_per_slot)
+        self.pipeline.set_query_rows(self.table.rows)
+        self._dirty.clear()
+        restore_pipeline(self.pipeline, path)
+        self._gauges()
+
+
+def replay_schedule(service: QueryService, schedule: List[tuple],
+                    handles: Optional[dict] = None) -> dict:
+    """Apply one interval's worth of churn commands to ``service``.
+
+    ``schedule`` rows are ``("register", reg_id, window, tenant)`` or
+    ``("cancel", reg_id)``; ``handles`` maps live reg_ids to their
+    QueryHandles and is updated in place (created when None). Returns the
+    handle map — the churn bench and the differential suite replay the
+    SAME seeded schedule through service and oracle with this one
+    function, so the two runs cannot drift."""
+    if handles is None:
+        handles = {}
+    for cmd in schedule:
+        if cmd[0] == "register":
+            _, reg_id, window, tenant = cmd
+            h = service.register(window, tenant=tenant)
+            if h is not None:
+                handles[reg_id] = h
+        elif cmd[0] == "cancel":
+            _, reg_id = cmd
+            h = handles.pop(reg_id, None)
+            if h is not None:       # the matching register may have been
+                service.cancel(h)   # shed by admission (on_reject="shed")
+        else:
+            raise ValueError(f"unknown churn command {cmd[0]!r}")
+    return handles

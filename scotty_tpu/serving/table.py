@@ -1,0 +1,231 @@
+"""Host-side query table: slot allocation, free-list recycling, tenants.
+
+The device face of the table is :class:`scotty_tpu.engine.pipeline.
+QuerySlots` (the ``[Q]`` parameter rows + active mask carried in the
+serving step's donated state); this module owns the authoritative HOST
+mirror — numpy rows the pipeline re-uploads on reset/restore — plus
+everything the device does not need: which slot belongs to which handle,
+per-slot generation counters (so a stale cancel cannot free someone
+else's recycled slot), tenant attribution, and the LIFO free-list that
+recycles cancelled slots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.windows import SlidingWindow, TumblingWindow, Window, \
+    WindowMeasure
+from ..engine.pipeline import QUERY_KIND_SLIDING, QUERY_KIND_TUMBLING
+
+
+class ServingUnsupported(ValueError):
+    """The window cannot be served from the slot grid at all (wrong class,
+    wrong measure, edges off the slice grid, size beyond the retention
+    bound) — a caller error, never subject to the shed policy."""
+
+
+def window_row(window: Window, slice_grid: int, max_size: int):
+    """Validate + lower a window to its ``(kind, grid, size)`` table row.
+
+    Admission conditions are the aligned pipeline's exactness conditions:
+    Time-measure tumbling/sliding only, size and slide multiples of the
+    slice grid, size within the geometry's GC retention bound.
+    """
+    if not isinstance(window, (TumblingWindow, SlidingWindow)):
+        raise ServingUnsupported(
+            f"{type(window).__name__} has no dynamic-serving path (Time "
+            "tumbling/sliding only); register it at build time or use the "
+            "operator's rebuild path")
+    if window.measure != WindowMeasure.Time:
+        raise ServingUnsupported(
+            "count-measure windows have no dynamic-serving path (the slot "
+            "trigger grid enumerates event-time edges)")
+    size = int(window.size)
+    grid = int(window.slide) if isinstance(window, SlidingWindow) else size
+    kind = QUERY_KIND_SLIDING if isinstance(window, SlidingWindow) \
+        else QUERY_KIND_TUMBLING
+    if size % slice_grid or grid % slice_grid:
+        raise ServingUnsupported(
+            f"{window}: size/slide must be multiples of the serving slice "
+            f"grid {slice_grid} ms — window edges must land on slice edges")
+    if grid < 1:
+        raise ServingUnsupported(f"{window}: non-positive slide/size")
+    if size > max_size:
+        raise ServingUnsupported(
+            f"{window}: size {size} exceeds the geometry's retention bound "
+            f"max_size={max_size} — slices would be GC'd from under it")
+    return kind, grid, size
+
+
+@dataclass(frozen=True)
+class QueryHandle:
+    """Opaque registration handle: ``slot`` is the physical table row,
+    ``gen`` the slot's generation at registration (stale handles — a slot
+    recycled since — are rejected on cancel)."""
+
+    slot: int
+    gen: int
+    kind: int
+    grid: int
+    size: int
+    tenant: str
+
+
+class QueryTable:
+    """Fixed-capacity slot table with LIFO free-slot recycling."""
+
+    def __init__(self, n_slots: int):
+        self.n_slots = int(n_slots)
+        self.kinds = np.zeros((n_slots,), np.int32)
+        self.grids = np.ones((n_slots,), np.int64)
+        self.sizes = np.ones((n_slots,), np.int64)
+        self.active = np.zeros((n_slots,), bool)
+        self.gens = np.zeros((n_slots,), np.int64)
+        self.tenants: List[Optional[str]] = [None] * n_slots
+        # LIFO free-list: a cancel immediately re-serves its slot to the
+        # next register (the recycling property the churn suite asserts)
+        self._free: List[int] = list(range(n_slots - 1, -1, -1))
+        # generation counters of slots dropped by shrink(): a later grow()
+        # must resume them, NOT restart at 0 — a zeroed generation would
+        # let a pre-shrink stale handle cancel a new tenant's live query
+        self._retired_gens: dict = {}
+
+    # -- the host mirror the pipeline re-uploads ---------------------------
+    @property
+    def rows(self) -> dict:
+        """Live references (NOT copies): row writes stay visible to the
+        pipeline's reset/restore re-upload."""
+        return {"kinds": self.kinds, "grids": self.grids,
+                "sizes": self.sizes, "active": self.active}
+
+    @property
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def tenant_active(self, tenant: str) -> int:
+        return sum(1 for i, t in enumerate(self.tenants)
+                   if self.active[i] and t == tenant)
+
+    def tenant_rollup(self) -> dict:
+        out: dict = {}
+        for i, t in enumerate(self.tenants):
+            if self.active[i] and t is not None:
+                out[t] = out.get(t, 0) + 1
+        return out
+
+    # -- allocation --------------------------------------------------------
+    def allocate(self, kind: int, grid: int, size: int,
+                 tenant: str) -> QueryHandle:
+        if not self._free:
+            raise RuntimeError(
+                f"query table full ({self.n_slots} slots, none free) — "
+                "the serving layer should have rebucketed or rejected "
+                "before allocating")
+        slot = self._free.pop()
+        self.kinds[slot] = kind
+        self.grids[slot] = grid
+        self.sizes[slot] = size
+        self.active[slot] = True
+        self.tenants[slot] = tenant
+        return QueryHandle(slot=slot, gen=int(self.gens[slot]), kind=kind,
+                           grid=grid, size=size, tenant=tenant)
+
+    def release(self, handle: QueryHandle) -> int:
+        slot = handle.slot
+        if slot < 0 or slot >= self.n_slots \
+                or int(self.gens[slot]) != handle.gen \
+                or not self.active[slot]:
+            raise ValueError(
+                f"stale or unknown query handle (slot {slot}, gen "
+                f"{handle.gen}): the slot was already cancelled or "
+                "recycled")
+        self.active[slot] = False
+        self.tenants[slot] = None
+        self.gens[slot] += 1          # invalidate any copies of the handle
+        self._free.append(slot)       # LIFO: recycled first
+        return slot
+
+    def grow(self, n_slots: int) -> None:
+        """Re-pad to a larger slot count (a rebucket); existing rows keep
+        their slots, new slots join the free-list BELOW the recycled ones
+        (so recycling stays LIFO-first)."""
+        if n_slots < self.n_slots:
+            raise ValueError(
+                f"query table cannot shrink ({self.n_slots} -> {n_slots}): "
+                "live handles pin their slots")
+        extra = n_slots - self.n_slots
+        if not extra:
+            return
+        self.kinds = np.concatenate(
+            [self.kinds, np.zeros((extra,), np.int32)])
+        self.grids = np.concatenate([self.grids, np.ones((extra,), np.int64)])
+        self.sizes = np.concatenate([self.sizes, np.ones((extra,), np.int64)])
+        self.active = np.concatenate([self.active, np.zeros((extra,), bool)])
+        # re-created slots RESUME their retired generation (see __init__)
+        new_gens = [self._retired_gens.pop(s, 0)
+                    for s in range(self.n_slots, n_slots)]
+        self.gens = np.concatenate(
+            [self.gens, np.asarray(new_gens, np.int64)])
+        self.tenants.extend([None] * extra)
+        self._free = list(range(n_slots - 1, self.n_slots - 1, -1)) \
+            + self._free
+        self.n_slots = n_slots
+
+    def shrink(self, n_slots: int) -> None:
+        """Drop the free slots above ``n_slots`` (compaction). Their
+        generation counters are retired, not forgotten: re-growing
+        resumes them, so stale handles from before the shrink can never
+        alias a recycled slot."""
+        if n_slots >= self.n_slots:
+            return
+        if self.active[n_slots:].any():
+            raise ValueError(
+                f"cannot shrink to {n_slots} slots: live queries occupy "
+                "higher slots (handles pin their slots)")
+        for s in range(n_slots, self.n_slots):
+            self._retired_gens[s] = int(self.gens[s])
+        self.kinds = self.kinds[:n_slots]
+        self.grids = self.grids[:n_slots]
+        self.sizes = self.sizes[:n_slots]
+        self.active = self.active[:n_slots]
+        self.gens = self.gens[:n_slots]
+        self.tenants = self.tenants[:n_slots]
+        self._free = [s for s in self._free if s < n_slots]
+        self.n_slots = n_slots
+
+    # -- checkpointing (ISSUE 6: restores replay the active set) -----------
+    def state_dict(self) -> dict:
+        return {
+            "n_slots": self.n_slots,
+            "kinds": self.kinds.tolist(),
+            "grids": self.grids.tolist(),
+            "sizes": self.sizes.tolist(),
+            "active": [bool(a) for a in self.active],
+            "gens": self.gens.tolist(),
+            "tenants": list(self.tenants),
+            "free": list(self._free),
+            "retired_gens": {str(k): v
+                             for k, v in self._retired_gens.items()},
+        }
+
+    @classmethod
+    def from_state_dict(cls, d: dict) -> "QueryTable":
+        t = cls(int(d["n_slots"]))
+        t.kinds[:] = np.asarray(d["kinds"], np.int32)
+        t.grids[:] = np.asarray(d["grids"], np.int64)
+        t.sizes[:] = np.asarray(d["sizes"], np.int64)
+        t.active[:] = np.asarray(d["active"], bool)
+        t.gens[:] = np.asarray(d["gens"], np.int64)
+        t.tenants = list(d["tenants"])
+        t._free = [int(i) for i in d["free"]]
+        t._retired_gens = {int(k): int(v)
+                           for k, v in d.get("retired_gens", {}).items()}
+        return t

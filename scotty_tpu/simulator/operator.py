@@ -609,6 +609,43 @@ class SlicingWindowOperator(WindowOperator):
     def add_window_assigner(self, window: Window) -> None:
         self.window_manager.add_window_assigner(window)
 
+    # -- serving control path (ISSUE 6) ------------------------------------
+    def register_window(self, window: Window, tenant: str = "default") -> int:
+        """Mid-stream registration handle (the host face of
+        ``TpuWindowOperator.register_window`` — connectors delegate to
+        whichever backend they run on). Handles are opaque and stable:
+        cancelling one never shifts another."""
+        if not isinstance(window, ContextFreeWindow) or isinstance(
+                window, (ForwardContextAware, ForwardContextFree)):
+            raise NotImplementedError(
+                "serving register/cancel covers context-free grid windows; "
+                "session/context windows carry per-registration state")
+        self.add_window_assigner(window)
+        if not hasattr(self, "_serving_handles"):
+            self._serving_handles = {}
+            self._serving_next = 0
+        h = self._serving_next
+        self._serving_next += 1
+        self._serving_handles[h] = window
+        return h
+
+    def cancel_window(self, handle: int, tenant: str = "default") -> None:
+        """Stop enumerating a registered window's triggers. Slices its
+        grid already cut stay cut (refinement is harmless — range
+        aggregation is unaffected), matching the device operator's
+        mask-only cancel."""
+        w = getattr(self, "_serving_handles", {}).pop(handle, None)
+        if w is None:
+            raise ValueError(
+                f"unknown or already-cancelled window handle {handle}")
+        cf = self.window_manager.get_context_free_windows()
+        for i, ww in enumerate(cf):
+            if ww is w:
+                del cf[i]
+                return
+        raise ValueError(f"window for handle {handle} is no longer "
+                         "registered")
+
     def add_aggregation(self, window_function: AggregateFunction) -> None:
         self.window_manager.add_aggregation(window_function)
 

@@ -1,6 +1,7 @@
 """Tier-1 lint: the engine core stays silent (ISSUE 1 satellite; extended
-to connectors/ and bench/ in ISSUE 2), nothing sleeps on the wall
-clock outside the injectable-clock module (ISSUE 3 satellite), and the
+to connectors/ and bench/ in ISSUE 2, serving/ in ISSUE 6), nothing
+sleeps on the wall clock outside the injectable-clock module (ISSUE 3
+satellite; serving/ is covered by the all-of-scotty_tpu sweep), and the
 obs layer never reads the wall clock directly (ISSUE 4 satellite).
 
 The reference's engine never logs — its only output was the benchmark-side
@@ -25,7 +26,7 @@ import pathlib
 import scotty_tpu
 
 PKG_ROOT = pathlib.Path(scotty_tpu.__file__).parent
-SILENT_DIRS = ("engine", "core", "connectors", "bench")
+SILENT_DIRS = ("engine", "core", "connectors", "bench", "serving")
 #: the single module allowed to call time.sleep (SystemClock lives there)
 SLEEP_EXEMPT = PKG_ROOT / "resilience" / "clock.py"
 

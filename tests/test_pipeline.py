@@ -298,9 +298,9 @@ def test_aligned_chunk_shape_retune_keeps_results():
     traced_ds = []
     orig_impl = p._step_impl
 
-    def spy(state, dm, key, ii, d):
+    def spy(state, dm, qs, key, ii, d):
         traced_ds.append(d)
-        return orig_impl(state, dm, key, ii, d)
+        return orig_impl(state, dm, qs, key, ii, d)
 
     p._step_impl = spy
     base_rows = emit(p)

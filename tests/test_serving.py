@@ -1,0 +1,731 @@
+"""Dynamic multi-query serving (ISSUE 6): differential churn suite,
+zero-retrace/recycling property tests, admission + cache + checkpoint
+coverage, and the operator/connector control paths.
+
+The central oracle (test_churn_bitmatch_superset_oracle): the aligned
+engine's state evolution is INDEPENDENT of the registered query set and
+every trigger row's range query is independent of every other, so a
+serving run under an arbitrary register/cancel schedule must produce,
+for each query active at interval i, EXACTLY the bytes an always-active
+superset run produces for that query at interval i. Any mask, slot
+write, recycling, or bucketing bug breaks bit-equality.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import obs as _obs
+from scotty_tpu.core.aggregates import SumAggregation
+from scotty_tpu.core.windows import (
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+from scotty_tpu.engine.pipeline import (
+    AlignedStreamPipeline,
+    SlotGeometry,
+    build_slot_trigger_grid,
+    build_trigger_grid,
+    init_query_slots,
+)
+from scotty_tpu.serving import (
+    GeometryCache,
+    QueryAdmission,
+    QueryRejected,
+    QueryService,
+    ServingUnsupported,
+    pad_pow2,
+    replay_schedule,
+)
+
+Time = WindowMeasure.Time
+
+SMALL = EngineConfig(capacity=1 << 12, annex_capacity=8, min_trigger_pad=32)
+
+
+def make_service(windows=(), max_queries=64, quota=0, on_reject="fail",
+                 cache_capacity=8, obs=None, seed=7, throughput=10_000,
+                 min_slots=8):
+    return QueryService(
+        [SumAggregation()], slice_grid=100, max_window_size=4000,
+        throughput=throughput, wm_period_ms=1000, max_lateness=1000,
+        seed=seed, config=SMALL,
+        admission=QueryAdmission(max_queries=max_queries,
+                                 per_tenant_quota=quota,
+                                 on_reject=on_reject),
+        windows=list(windows), min_slots=min_slots,
+        cache_capacity=cache_capacity, obs=obs)
+
+
+def rows_of(by_slot, slot):
+    return [(s, e, c, tuple(np.float32(v).tobytes() for v in vals))
+            for (s, e, c, vals) in by_slot.get(slot, ())]
+
+
+# ---------------------------------------------------------------------------
+# the masked trigger grid itself
+# ---------------------------------------------------------------------------
+
+
+def test_slot_trigger_grid_matches_static_builder():
+    """Per window, the masked [Q, K] grid's valid trigger rows equal the
+    static builder's — same (start, end) sets at several watermarks,
+    including the first-watermark clamp and the sliding end<=wm+1 quirk."""
+    import jax
+
+    windows = [TumblingWindow(Time, 500), SlidingWindow(Time, 4000, 1000),
+               SlidingWindow(Time, 1500, 500)]
+    P = 1000
+    static_mk, _ = build_trigger_grid(windows, P)
+    geom = SlotGeometry(n_slots=4, triggers_per_slot=8, slice_grid=100,
+                        max_size=4000)
+    slot_mk, T = build_slot_trigger_grid(geom, P)
+    assert T == 32
+    rows = {"kinds": np.zeros(4, np.int32), "grids": np.ones(4, np.int64),
+            "sizes": np.ones(4, np.int64), "active": np.zeros(4, bool)}
+    from scotty_tpu.serving import window_row
+
+    for q, w in enumerate(windows):
+        k, g, s = window_row(w, 100, 4000)
+        rows["kinds"][q], rows["grids"][q], rows["sizes"][q] = k, g, s
+        rows["active"][q] = True
+    qs = init_query_slots(geom, rows)
+    for (last_wm, wm) in ((0, 1000), (1000, 2000), (7000, 8000)):
+        sws, swe, sok = jax.device_get(
+            static_mk(np.int64(last_wm), np.int64(wm)))
+        mws, mwe, mok = jax.device_get(
+            slot_mk(qs, np.int64(last_wm), np.int64(wm)))
+        static_rows = sorted(zip(sws[sok].tolist(), swe[sok].tolist()))
+        masked = sorted(zip(mws[mok].tolist(), mwe[mok].tolist()))
+        assert masked == static_rows, (last_wm, wm)
+        # slot 3 is inactive: none of its lanes may be valid
+        assert not mok[3 * 8:].any()
+
+
+def test_masked_frozen_set_matches_static_pipeline_bitexact():
+    """A serving pipeline with a frozen query set emits the exact bytes
+    of a static pipeline whose window set implies the same slice grid
+    (same geometry => same generated stream => same state => the same
+    per-row range queries)."""
+    windows = [SlidingWindow(Time, 400, 100), TumblingWindow(Time, 200)]
+    static = AlignedStreamPipeline(
+        windows, [SumAggregation()], config=SMALL, throughput=10_000,
+        wm_period_ms=1000, max_lateness=1000, seed=3)
+    assert static.grid == 100
+    svc = make_service(windows, seed=3)
+    souts = static.run(4)
+    static.sync()
+    vouts = svc.run(4)
+    svc.sync()
+    for so, vo in zip(souts, vouts):
+        srows = sorted((s, e, c, tuple(np.float32(v).tobytes()
+                                       for v in vals))
+                       for (s, e, c, vals) in static.lowered_results(so))
+        vrows = sorted((s, e, c, tuple(np.float32(v).tobytes()
+                                       for v in vals))
+                       for (s, e, c, vals) in svc.lowered_results(vo))
+        assert srows == vrows
+    static.check_overflow()
+    svc.check_overflow()
+
+
+# ---------------------------------------------------------------------------
+# zero-retrace + recycling properties
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_reregister_same_bucket_zero_retraces_and_recycles():
+    svc = make_service([SlidingWindow(Time, 4000, 1000)])
+    h = svc.register(TumblingWindow(Time, 500), tenant="alice")
+    svc.run(2, collect=False)
+    svc.sync()
+    svc.mark_warm()
+    first_slot = h.slot
+    for i in range(6):
+        svc.cancel(h)
+        h = svc.register(TumblingWindow(Time, 1000) if i % 2
+                         else TumblingWindow(Time, 500), tenant="alice")
+        # LIFO free-list: the freed slot is recycled immediately
+        assert h.slot == first_slot
+        svc.run(1, collect=False)
+    svc.sync()
+    svc.check_overflow()
+    assert svc.retraces_since_warm == 0
+    assert svc.stats().get("serving_retraces", 0) == 0
+
+
+def test_stale_handle_cancel_raises():
+    svc = make_service()
+    h = svc.register(TumblingWindow(Time, 500))
+    svc.cancel(h)
+    with pytest.raises(ValueError, match="stale or unknown"):
+        svc.cancel(h)
+    h2 = svc.register(TumblingWindow(Time, 500))
+    assert h2.slot == h.slot and h2.gen == h.gen + 1
+    with pytest.raises(ValueError, match="stale or unknown"):
+        svc.cancel(h)          # recycled slot, old generation
+
+
+def test_serving_unsupported_windows_raise():
+    svc = make_service()
+    with pytest.raises(ServingUnsupported, match="no dynamic-serving"):
+        svc.register(SessionWindow(Time, 1000))
+    with pytest.raises(ServingUnsupported, match="slice grid"):
+        svc.register(TumblingWindow(Time, 250))      # off the 100ms grid
+    with pytest.raises(ServingUnsupported, match="retention"):
+        svc.register(TumblingWindow(Time, 400000))   # beyond max_size
+    with pytest.raises(ServingUnsupported, match="count-measure"):
+        svc.register(TumblingWindow(WindowMeasure.Count, 100))
+
+
+# ---------------------------------------------------------------------------
+# the differential churn suite (superset oracle, bit-exact)
+# ---------------------------------------------------------------------------
+
+
+def test_churn_bitmatch_superset_oracle():
+    rng = np.random.default_rng(11)
+    pool = [TumblingWindow(Time, 500), TumblingWindow(Time, 1000),
+            SlidingWindow(Time, 2000, 500), SlidingWindow(Time, 4000, 1000),
+            SlidingWindow(Time, 1000, 200)]
+    # seeded schedule: 40 ops over 8 intervals, max ~6 live
+    schedule = [[] for _ in range(8)]
+    live, next_id = [], 0
+    for i in range(8):
+        for _ in range(5):
+            if live and (len(live) >= 6 or rng.random() < 0.45):
+                rid = live.pop(int(rng.integers(len(live))))
+                schedule[i].append(("cancel", rid))
+            else:
+                w = pool[int(rng.integers(len(pool)))]
+                schedule[i].append(
+                    ("register", next_id, w, f"t{next_id % 3}"))
+                live.append(next_id)
+                next_id += 1
+
+    svc = make_service([SlidingWindow(Time, 4000, 1000)], seed=5)
+    svc.run(6, collect=False)        # warmup past the widest span
+    svc.sync()
+    svc.mark_warm()
+    handles, slot_maps, outs = {}, [], []
+    for cmds in schedule:
+        replay_schedule(svc, cmds, handles)
+        slot_maps.append({rid: h.slot for rid, h in handles.items()})
+        outs.extend(svc.run(1))
+    svc.sync()
+    svc.check_overflow()
+    assert svc.retraces_since_warm == 0
+
+    # superset oracle: same seed/geometry, every registration active from
+    # the start, generous slots
+    oracle = make_service([SlidingWindow(Time, 4000, 1000)], seed=5,
+                          max_queries=next_id + 4, min_slots=8)
+    ohandles = {}
+    for cmds in schedule:
+        for cmd in cmds:
+            if cmd[0] == "register":
+                ohandles[cmd[1]] = oracle.register(cmd[2], tenant=cmd[3])
+    oracle.run(6, collect=False)
+    oracle.sync()
+    oouts = oracle.run(8)
+    oracle.sync()
+    oracle.check_overflow()
+
+    compared = 0
+    for i, omap in enumerate(slot_maps):
+        srows = svc.results_by_slot(outs[i])
+        orows = oracle.results_by_slot(oouts[i])
+        for rid, slot in omap.items():
+            assert rows_of(srows, slot) == rows_of(
+                orows, ohandles[rid].slot), (i, rid)
+            compared += len(rows_of(srows, slot))
+    assert compared > 20            # the comparison actually saw emissions
+
+
+def test_register_mid_stream_sees_preexisting_slices():
+    """The shared-slice claim: a query registered at interval r answers
+    windows over data ingested BEFORE r (no per-query state to backfill)."""
+    svc = make_service([TumblingWindow(Time, 500)], seed=9)
+    svc.run(3, collect=False)
+    svc.sync()
+    h = svc.register(SlidingWindow(Time, 4000, 1000))
+    out = svc.run(1)[0]
+    svc.sync()
+    rows = svc.results_by_slot(out).get(h.slot)
+    assert rows, "freshly registered window emitted nothing"
+    (s, e, c, vals) = rows[0]
+    # the window spans 4 s — intervals 0..3's tuples, all pre-registration
+    assert e - s == 4000 and c == 4 * svc.pipeline.tuples_per_interval
+    svc.check_overflow()
+
+
+# ---------------------------------------------------------------------------
+# admission + tenancy
+# ---------------------------------------------------------------------------
+
+
+def test_admission_quota_and_capacity():
+    obs = _obs.Observability(flight=_obs.FlightRecorder(128))
+    svc = make_service(max_queries=4, quota=2, obs=obs)
+    a1 = svc.register(TumblingWindow(Time, 500), tenant="alice")
+    svc.register(TumblingWindow(Time, 1000), tenant="alice")
+    with pytest.raises(QueryRejected) as ei:
+        svc.register(TumblingWindow(Time, 2000), tenant="alice")
+    assert ei.value.reason == "quota"
+    svc.register(TumblingWindow(Time, 500), tenant="bob")
+    svc.register(TumblingWindow(Time, 500), tenant="carol")
+    with pytest.raises(QueryRejected) as ei:
+        svc.register(TumblingWindow(Time, 500), tenant="dave")
+    assert ei.value.reason == "capacity"
+    assert svc.stats()["serving_rejected"] == 2
+    snap = obs.snapshot()
+    assert snap["serving_rejected"] == 2
+    assert snap["serving_tenant_active_alice"] == 2
+    kinds = {e["kind"] for e in obs.flight.events()}
+    assert {"query_register", "query_reject"} <= kinds
+    # cancelling frees quota again
+    svc.cancel(a1)
+    assert svc.register(TumblingWindow(Time, 500), tenant="alice")
+
+
+def test_admission_shed_policy_counts_and_calls_back():
+    shed = []
+    adm = QueryAdmission(max_queries=1, on_reject="shed",
+                         reject_callback=lambda w, t, r: shed.append((t, r)))
+    svc = QueryService(
+        [SumAggregation()], slice_grid=100, max_window_size=4000,
+        throughput=10_000, wm_period_ms=1000, max_lateness=1000, seed=7,
+        config=SMALL, admission=adm)
+    assert svc.register(TumblingWindow(Time, 500)) is not None
+    assert svc.register(TumblingWindow(Time, 1000), tenant="t2") is None
+    assert shed == [("t2", "capacity")]
+    assert svc.stats()["serving_rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# geometry-bucketed compile cache
+# ---------------------------------------------------------------------------
+
+
+def test_rebucket_miss_hit_and_compact_back_to_warm_bucket():
+    svc = make_service([SlidingWindow(Time, 4000, 1000)], max_queries=256)
+    svc.run(2, collect=False)
+    svc.sync()
+    svc.mark_warm()
+    g0 = svc.geometry
+    # a finer-slide window outgrows the lane bucket: miss + retrace
+    h = svc.register(SlidingWindow(Time, 1000, 100))
+    assert svc.geometry.triggers_per_slot > g0.triggers_per_slot
+    svc.run(1, collect=False)
+    svc.sync()
+    assert svc.retraces_since_warm == 1
+    st = svc.stats()
+    assert st["serving_cache_misses"] == 1 and st["serving_retraces"] == 1
+    # cancel it and compact: back onto the ORIGINAL bucket — a cache hit,
+    # no new trace
+    svc.cancel(h)
+    assert svc.compact() is True
+    assert svc.geometry == g0
+    svc.run(1, collect=False)
+    svc.sync()
+    assert svc.retraces_since_warm == 1          # unchanged: warm swap
+    assert svc.stats()["serving_cache_misses"] == 1
+    svc.check_overflow()
+
+
+def test_slot_growth_rebuckets_and_lru_evicts():
+    obs = _obs.Observability(flight=_obs.FlightRecorder(256))
+    svc = make_service(max_queries=64, cache_capacity=1, obs=obs,
+                       min_slots=2)
+    svc.run(1, collect=False)
+    svc.sync()
+    handles = [svc.register(TumblingWindow(Time, 500)) for _ in range(2)]
+    # third register outgrows the 2-slot pad: rebucket to 4 slots; with
+    # cache_capacity=1 the original bucket is evicted
+    handles.append(svc.register(TumblingWindow(Time, 500)))
+    assert svc.geometry.n_slots == 4
+    st = svc.stats()
+    assert st["serving_cache_misses"] == 1
+    assert st["serving_cache_evictions"] == 1
+    assert "query_evict" in {e["kind"] for e in obs.flight.events()}
+    svc.run(1, collect=False)
+    svc.sync()
+    svc.check_overflow()
+
+
+def test_compact_then_grow_keeps_stale_handles_dead():
+    """Review finding: compact() used to truncate generation counters, so
+    a later grow reset them to 0 and a pre-compact stale handle could
+    cancel another tenant's live query in the recycled slot."""
+    svc = make_service(max_queries=64, min_slots=2)
+    hs = [svc.register(TumblingWindow(Time, 500), tenant="alice")
+          for _ in range(3)]                  # grows past min_slots
+    high = max(hs, key=lambda h: h.slot)
+    for h in hs:
+        svc.cancel(h)
+    assert svc.compact() is True              # drops the high slots
+    # regrow: a new tenant's query lands in the recycled high slot
+    regs = []
+    while True:
+        h = svc.register(TumblingWindow(Time, 500), tenant="bob")
+        regs.append(h)
+        if h.slot == high.slot:
+            break
+    with pytest.raises(ValueError, match="stale or unknown"):
+        svc.cancel(high)                      # stale pre-compact handle
+    assert svc.table.tenant_active("bob") == len(regs)
+
+
+def test_tenant_gauge_zeroes_after_last_cancel():
+    """Review finding: a tenant whose last query was cancelled kept its
+    final nonzero serving_tenant_active_<t> gauge forever."""
+    obs = _obs.Observability()
+    svc = make_service(obs=obs)
+    h1 = svc.register(TumblingWindow(Time, 500), tenant="alice")
+    h2 = svc.register(TumblingWindow(Time, 1000), tenant="alice")
+    assert obs.snapshot()["serving_tenant_active_alice"] == 2
+    svc.cancel(h1)
+    svc.cancel(h2)
+    assert obs.snapshot()["serving_tenant_active_alice"] == 0
+
+
+def test_replay_schedule_tolerates_shed_registers():
+    """Review finding: a cancel whose matching register was shed by
+    admission used to KeyError mid-schedule."""
+    svc = QueryService(
+        [SumAggregation()], slice_grid=100, max_window_size=4000,
+        throughput=10_000, wm_period_ms=1000, max_lateness=1000, seed=7,
+        config=SMALL,
+        admission=QueryAdmission(max_queries=1, on_reject="shed"))
+    schedule = [
+        ("register", 0, TumblingWindow(Time, 500), "a"),
+        ("register", 1, TumblingWindow(Time, 1000), "b"),   # shed
+        ("cancel", 1),                                      # no-op
+        ("cancel", 0),
+    ]
+    handles = replay_schedule(svc, schedule)
+    assert handles == {}
+    assert svc.table.n_active == 0
+    assert svc.stats()["serving_rejected"] == 1
+
+
+def test_pad_pow2_and_cache_lru_unit():
+    assert pad_pow2(0, 8) == 8
+    assert pad_pow2(8, 8) == 8
+    assert pad_pow2(9, 8) == 16
+    assert pad_pow2(1000, 8) == 1024
+    with pytest.raises(ValueError):
+        pad_pow2(-1, 8)
+    c = GeometryCache(2)
+    c.put("a", 1), c.put("b", 2)
+    assert c.get("a") == 1                 # refreshes LRU order
+    assert c.put("c", 3) == "b"            # b was least-recent
+    assert c.get("b") is None
+    assert c.stats()["evictions"] == 1
+
+
+def test_trigger_budget_checked_against_max_triggers():
+    with pytest.raises(ValueError, match="max_triggers"):
+        QueryService(
+            [SumAggregation()], slice_grid=100, max_window_size=4000,
+            throughput=10_000, wm_period_ms=1000, seed=1,
+            config=EngineConfig(capacity=1 << 12, annex_capacity=8,
+                                min_trigger_pad=32, max_triggers=64),
+            min_slots=64, min_trigger_lanes=8)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/restore: the query table rides the snapshot
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_restore_replays_active_set(tmp_path):
+    path = str(tmp_path / "ckpt")
+    svc = make_service([SlidingWindow(Time, 4000, 1000)], seed=13)
+    h1 = svc.register(TumblingWindow(Time, 500), tenant="alice")
+    h2 = svc.register(TumblingWindow(Time, 1000), tenant="bob")
+    svc.run(5, collect=False)
+    svc.sync()
+    svc.cancel(h1)                       # free-list state matters too
+    svc.run(1, collect=False)
+    svc.sync()
+    svc.save(path)
+    cont = [svc.results_by_slot(o) for o in svc.run(3)]
+    svc.sync()
+
+    svc2 = make_service([SlidingWindow(Time, 4000, 1000)], seed=13)
+    svc2.restore(path)
+    rest = [svc2.results_by_slot(o) for o in svc2.run(3)]
+    svc2.sync()
+    assert len(cont) == len(rest)
+    for a, b in zip(cont, rest):
+        assert {k: rows_of(a, k) for k in a} == {k: rows_of(b, k)
+                                                for k in b}
+    # table bookkeeping restored exactly: the cancelled slot is the next
+    # one recycled, stale handles still rejected
+    h3 = svc2.register(TumblingWindow(Time, 2000), tenant="carol")
+    assert h3.slot == h1.slot and h3.gen == h1.gen + 1
+    with pytest.raises(ValueError):
+        svc2.cancel(QueryHandleLike(h2))
+    svc2.check_overflow()
+
+
+class QueryHandleLike:
+    """A stale copy of a handle whose generation has moved on."""
+
+    def __init__(self, h):
+        self.slot, self.gen = h.slot, h.gen - 1
+        self.kind, self.grid, self.size, self.tenant = (h.kind, h.grid,
+                                                        h.size, h.tenant)
+
+
+def test_restore_refuses_wrong_grid(tmp_path):
+    path = str(tmp_path / "ckpt")
+    svc = make_service([SlidingWindow(Time, 4000, 1000)])
+    svc.run(2, collect=False)
+    svc.sync()
+    svc.save(path)
+    other = QueryService(
+        [SumAggregation()], slice_grid=200, max_window_size=4000,
+        throughput=10_000, wm_period_ms=1000, seed=7, config=SMALL)
+    with pytest.raises(ValueError, match="slice grid"):
+        other.restore(path)
+
+
+# ---------------------------------------------------------------------------
+# operator + connector control paths
+# ---------------------------------------------------------------------------
+
+
+def run_operator_churn(op, sim, stream, watermarks, commands):
+    """Drive device operator + simulator through the same stream with the
+    same register/cancel points; compare per-watermark emissions (the
+    engine-vs-simulator discipline of test_engine_differential, plus
+    serving control commands keyed on tuple position)."""
+    from tests.test_engine_differential import compare
+
+    cmd_at = {}
+    for (after_idx, fn) in commands:
+        cmd_at.setdefault(after_idx, []).append(fn)
+    pos = 0
+    for after_idx, wm in watermarks:
+        while pos <= after_idx and pos < len(stream):
+            for fn in cmd_at.get(pos, ()):
+                fn()
+            v, ts = stream[pos]
+            sim.process_element(v, ts)
+            op.process_element(v, ts)
+            pos += 1
+        compare(sim.process_watermark(wm), op.process_watermark(wm), wm)
+
+
+def test_operator_register_cancel_matches_simulator_zero_rebuild():
+    from scotty_tpu.simulator import SlicingWindowOperator
+
+    sim = SlicingWindowOperator()
+    op = TpuWindowOperator(config=SMALL)
+    for o in (sim, op):
+        o.add_window_assigner(TumblingWindow(Time, 10))
+        o.add_aggregation(SumAggregation())
+        o.set_max_lateness(1000)
+    stream = [(i % 7 + 1, i * 3) for i in range(60)]
+    holders = {}
+
+    def reg():
+        # compatible: 20 is a multiple of the registered period 10 —
+        # zero kernel rebuild on the device operator
+        w = TumblingWindow(Time, 20)
+        holders["op"] = op.register_window(w)
+        holders["sim"] = sim.register_window(w)
+
+    def cancel():
+        op.cancel_window(holders["op"])
+        sim.cancel_window(holders["sim"])
+
+    # force the build with the first watermark region, then register
+    run_operator_churn(op, sim, stream, [(9, 30)], [])
+    ingest_before = op._ingest
+    query_before = op._query
+    run_operator_churn(op, sim, stream,
+                       [(19, 60), (29, 90), (39, 120), (59, 181)],
+                       [(12, reg), (32, cancel)])
+    assert op._ingest is ingest_before          # no kernel rebuild
+    assert op._query is query_before
+
+
+def test_operator_incompatible_register_rebuilds_and_counts():
+    """A window whose edges miss the built union grid cannot be served by
+    masking — register_window falls back to the kernel-rebuild path
+    (counted as a serving retrace). Early windows straddling the addition
+    follow the documented `_add_window_dynamic` deviation, so this test
+    asserts the rebuild + accounting + that the new window emits, not a
+    simulator bit-match."""
+    obs = _obs.Observability()
+    op = TpuWindowOperator(config=SMALL, obs=obs)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(1000)
+    stream = [(i + 1, i * 4) for i in range(40)]
+    for v, ts in stream[:13]:
+        op.process_element(v, ts)
+    op.process_watermark(30)
+    ingest_before = op._ingest
+    h = op.register_window(TumblingWindow(Time, 15))   # 15 % 10 != 0
+    assert op._ingest is not ingest_before      # kernels were rebuilt
+    for v, ts in stream[13:]:
+        op.process_element(v, ts)
+    out = op.process_watermark(161)
+    assert [w for w in out if w.get_end() - w.get_start() == 15]
+    op.cancel_window(h)
+    out2 = op.process_watermark(200)
+    assert not [w for w in out2 if w.get_end() - w.get_start() == 15]
+    snap = obs.snapshot()
+    assert snap["serving_registered"] == 1
+    assert snap["serving_retraces"] == 1
+    assert snap["serving_cancelled"] == 1
+
+
+def test_operator_churn_recycles_window_slots():
+    """Review finding: sustained operator-path churn must bound
+    self.windows at peak concurrency (cancelled slots recycle), and
+    stale handles must never touch a recycled slot."""
+    op = TpuWindowOperator(config=SMALL)
+    op.add_window_assigner(TumblingWindow(Time, 10))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(1000)
+    for i in range(5):
+        op.process_element(i + 1, i * 3)
+    op.process_watermark(12)                      # build
+    n0 = len(op.windows)
+    first = op.register_window(TumblingWindow(Time, 20))
+    op.cancel_window(first)
+    for k in range(20):
+        h = op.register_window(TumblingWindow(Time, 20 if k % 2 else 40))
+        op.cancel_window(h)
+        assert h != first                         # handles never reused
+    assert len(op.windows) == n0 + 1              # slot recycled, no growth
+    with pytest.raises(ValueError, match="unknown or already-cancelled"):
+        op.cancel_window(first)                   # stale handle stays dead
+
+
+def test_connector_run_global_control_path():
+    from scotty_tpu.connectors.base import (
+        GlobalScottyWindowOperator,
+        PeriodicWatermarks,
+    )
+    from scotty_tpu.connectors.iterable import run_global
+
+    def results(control):
+        op = GlobalScottyWindowOperator(
+            windows=[TumblingWindow(Time, 100)],
+            aggregations=[SumAggregation()],
+            watermark_policy=PeriodicWatermarks(period=100),
+            allowed_lateness=1)
+        src = ((float(i), i * 10) for i in range(100))
+        return [(w.get_start(), w.get_end(), tuple(w.get_agg_values()))
+                for w in run_global(src, op, control=control)], op
+
+    base, _ = results(None)
+    hold = {}
+    ctl = [
+        (30, lambda op: hold.update(
+            h=op.register_window(TumblingWindow(Time, 200)))),
+        (70, lambda op: op.cancel_window(hold["h"])),
+    ]
+    churned, op = results(ctl)
+    extra = [r for r in churned if r[1] - r[0] == 200]
+    assert extra, "registered window never emitted"
+    # it emitted only while active: ends within (300, 700]
+    assert all(300 < e <= 701 for (_, e, _) in extra)
+    base_set = [r for r in churned if r[1] - r[0] == 100]
+    assert base_set == base                  # the static query unaffected
+
+
+def test_connector_keyed_control_applies_to_new_keys():
+    from scotty_tpu.connectors.base import (
+        KeyedScottyWindowOperator,
+        PeriodicWatermarks,
+    )
+
+    op = KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 100)],
+        aggregations=[SumAggregation()],
+        watermark_policy=PeriodicWatermarks(period=100),
+        allowed_lateness=1)
+    out = []
+    for i in range(30):                      # key "a" only
+        out.extend(op.process_element("a", 1.0, i * 10))
+    h = op.register_window(TumblingWindow(Time, 300))
+    for i in range(30, 90):                  # key "b" appears later
+        out.extend(op.process_element("a", 1.0, i * 10))
+        out.extend(op.process_element("b", 2.0, i * 10))
+    wide = [(k, w.get_start(), w.get_end()) for k, w in out
+            if w.get_end() - w.get_start() == 300]
+    assert {k for k, _, _ in wide} == {"a", "b"}
+    op.cancel_window(h)
+    out2 = []
+    for i in range(90, 150):
+        out2.extend(op.process_element("a", 1.0, i * 10))
+        out2.extend(op.process_element("b", 2.0, i * 10))
+    assert not [w for _, w in out2
+                if w.get_end() - w.get_start() == 300]
+
+
+# ---------------------------------------------------------------------------
+# satellites: trigger_pad cap, diff gate, churn bench cell
+# ---------------------------------------------------------------------------
+
+
+def test_trigger_pad_raises_above_max_triggers():
+    cfg = EngineConfig(min_trigger_pad=32, max_triggers=256)
+    assert cfg.trigger_pad(10) == 32
+    assert cfg.trigger_pad(200) == 256
+    assert cfg.trigger_pad(256) == 256
+    with pytest.raises(ValueError) as ei:
+        cfg.trigger_pad(257)
+    assert "max_triggers=256" in str(ei.value)
+    assert "257" in str(ei.value)
+
+
+def test_diff_gate_serving_thresholds(tmp_path):
+    import json
+
+    from scotty_tpu.obs.diff import diff_exports
+
+    base = [{"name": "c", "windows": "w", "engine": "QueryChurn",
+             "aggregation": "sum", "tuples_per_sec": 100.0,
+             "metrics": {"metrics": {}}}]
+    cand_bad = [dict(base[0], metrics={"metrics": {
+        "serving_retraces": 3, "serving_rejected": 1}})]
+    bp = tmp_path / "base.json"
+    cp = tmp_path / "cand.json"
+    bp.write_text(json.dumps(base))
+    cp.write_text(json.dumps(cand_bad))
+    findings = diff_exports(str(bp), str(cp))
+    bad = {f["metric"] for f in findings if f["status"] == "regressed"}
+    assert {"serving_retraces", "serving_rejected"} <= bad
+    cp.write_text(json.dumps(base))
+    findings = diff_exports(str(bp), str(cp))
+    assert not [f for f in findings if f["status"] == "regressed"]
+
+
+@pytest.mark.slow
+def test_query_churn_bench_cell():
+    from scotty_tpu.bench.harness import BenchmarkConfig
+    from scotty_tpu.bench.runner import run_query_churn_cell
+
+    cfg = BenchmarkConfig(
+        name="churn-test", throughput=100_000, runtime_s=5,
+        watermark_period_ms=1000, capacity=1 << 12, max_lateness=1000,
+        seed=42, churn_ops=50, churn_max_active=24, churn_tenants=3,
+        churn_oracle=True)
+    res = run_query_churn_cell(cfg, "Sliding(4000,1000)+Tumbling(1000)",
+                               "sum")
+    assert res.serving_retraces_after_warmup == 0
+    assert res.oracle_match is True
+    assert res.churn_ops >= 50
+    assert res.serving_registered + res.serving_cancelled >= 50
+    assert len(res.churn_schedule) == res.churn_ops
+    assert res.throughput_static > 0
