@@ -91,6 +91,22 @@ def __getattr__(name):
         from .serving import QueryAdmission
 
         return QueryAdmission
+    if name == "LineRateFeed":
+        from .ingest import LineRateFeed
+
+        return LineRateFeed
+    if name == "RingConfig":
+        from .ingest import RingConfig
+
+        return RingConfig
+    if name == "SoakConfig":
+        from .soak import SoakConfig
+
+        return SoakConfig
+    if name == "SoakRunner":
+        from .soak import SoakRunner
+
+        return SoakRunner
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -105,4 +121,5 @@ __all__ = [
     "KeyedTpuWindowOperator", "GlobalTpuWindowOperator",
     "StreamShaper", "ShaperConfig",
     "QueryService", "QueryAdmission",
+    "LineRateFeed", "RingConfig", "SoakConfig", "SoakRunner",
 ]

@@ -176,6 +176,18 @@ class BenchmarkConfig:
     #: replay the same churn schedule through an always-active superset
     #: oracle and bit-compare per-query emissions (doubles cell wall time)
     churn_oracle: bool = True
+    #: ingest-ring staging depth for the IngestExternal/Soak cells
+    #: (ISSUE 7); 0 = the RingConfig default (8)
+    ring_depth: int = 0
+    #: ring staging-block rows; 0 = the cell's batch size (IngestExternal)
+    #: / 1024 (Soak)
+    ring_block_size: int = 0
+    #: Soak cell wall-clock duration (SystemClock seconds; the runner's
+    #: --soak-seconds flag overrides); 0 = the 5 s CI default
+    soak_seconds: float = 0.0
+    #: Soak cell offered load (records per second; --offered-rate
+    #: overrides); 0 = the 50 000/s default
+    offered_rate: float = 0.0
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -205,6 +217,10 @@ class BenchmarkConfig:
             churn_max_active=raw.get("churnMaxActive", 256),
             churn_tenants=raw.get("churnTenants", 4),
             churn_oracle=raw.get("churnOracle", True),
+            ring_depth=raw.get("ringDepth", 0),
+            ring_block_size=raw.get("ringBlockSize", 0),
+            soak_seconds=raw.get("soakSeconds", 0.0),
+            offered_rate=raw.get("offeredRate", 0.0),
         )
 
 

@@ -10,6 +10,16 @@ connector-side ingest metrics — ``ingest_tuples``/``windows_emitted`` in
 :func:`run_keyed_async`, the source ``queue_depth`` gauge in
 :func:`queue_source`. The registry is thread-safe, so a producer thread
 filling the queue and the consumer task share one registry safely.
+
+Backpressure (ISSUE 7): an unbounded producer queue is a hidden infinite
+buffer that defeats every downstream bound — use :func:`bounded_queue`
+(``maxsize`` defaults to :data:`DEFAULT_QUEUE_MAXSIZE`). Producer-side
+behavior at the bound is the standard asyncio contract: ``await
+queue.put(item)`` BLOCKS until the consumer frees a slot (end-to-end
+backpressure to the producer), ``queue.put_nowait(item)`` raises
+``asyncio.QueueFull`` (the producer's explicit shed decision).
+:func:`queue_source` flags an unbounded queue in the flight ring so a
+postmortem shows where the bound was missing.
 """
 
 from __future__ import annotations
@@ -19,6 +29,24 @@ from typing import AsyncIterator, Awaitable, Callable, Optional, Tuple
 
 from .. import obs as _obs
 from .base import KeyedScottyWindowOperator
+
+#: default bound for :func:`bounded_queue` — deep enough to ride bursts,
+#: small enough that a stalled consumer pushes back on the producer
+#: within one block's worth of records rather than one heap's worth
+DEFAULT_QUEUE_MAXSIZE = 1024
+
+
+def bounded_queue(maxsize: int = DEFAULT_QUEUE_MAXSIZE) -> "asyncio.Queue":
+    """The sanctioned producer queue for :func:`queue_source` /
+    :func:`run_keyed_async` (module docstring: producer-side semantics at
+    the bound). ``maxsize`` must be positive — an unbounded queue defeats
+    ring backpressure by construction."""
+    if maxsize <= 0:
+        raise ValueError(
+            "bounded_queue needs maxsize > 0 — an unbounded producer "
+            "queue is a hidden infinite buffer (pass asyncio.Queue() "
+            "explicitly if you really want one)")
+    return asyncio.Queue(maxsize=maxsize)
 
 
 async def run_keyed_async(
@@ -30,6 +58,9 @@ async def run_keyed_async(
         health=None,
         shaper=None,
         control=None,
+        idle_poll_s: Optional[float] = None,
+        ingest_ring=None,
+        shed_callback: Optional[Callable] = None,
 ) -> None:
     """Consume (key, value, ts) from an async iterator; call ``emit`` for
     every (key, AggregateWindow) result. ``emit`` may be sync or async.
@@ -50,38 +81,107 @@ async def run_keyed_async(
     ``control`` (ISSUE 6) is the register/cancel control path shared
     with the iterable run loops: ``(after_records, command)`` rows, each
     ``command`` called with the operator once that many records were
-    consumed."""
-    from .iterable import _apply_control, _control_cursor
+    consumed.
+
+    ``idle_poll_s`` (ISSUE 7 satellite — the max_delay_ms honesty fix):
+    wait at most this long for the next record; a timeout is an IDLE
+    TICK that evaluates the accumulator deadline (``poll_shaper``) and
+    pumps the ingest ring, so held records flush on time while the
+    source is silent. The pending ``__anext__`` is NOT cancelled on a
+    tick (an async generator would die), it just keeps waiting.
+
+    ``ingest_ring`` (a :class:`scotty_tpu.ingest.RingConfig`, ISSUE 7)
+    stages records through the bounded backpressure ring — block/shed/
+    fail on full, exact ``ingest_ring_*`` accounting, block-at-a-time
+    vectorized replay; ``shed_callback(vals, ts, keys)`` sees records a
+    'shed' policy dropped. Pair it with :func:`bounded_queue` so the
+    producer side is bounded too."""
+    from .iterable import (_apply_control, _control_cursor, _counted,
+                           _make_ring, _pop, _pop_counted,
+                           _ring_polls_deadline)
 
     if shaper is not None:
         operator.attach_shaper(shaper)
     own_obs = obs if obs is not None and obs is not operator.obs else None
     eff_obs = obs if obs is not None else operator.obs
+    ring = None
+    ring_results: list = []
+    if ingest_ring is not None:
+        ring = _make_ring(ingest_ring, operator, True, eff_obs,
+                          shed_callback, ring_results)
+    ring_poll = _ring_polls_deadline(operator, ring)
     server = None
     if serve_port is not None and eff_obs is not None:
         server = eff_obs.serve(port=serve_port, health=health)
         operator.obs_server = server
     ctl, nxt = _control_cursor(control)
     n_seen = 0
+
+    async def _emit(item) -> None:
+        r = emit(item)
+        if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
+            await r
+
+    ait = source.__aiter__()
+    pending = None
     try:
-        async for key, value, ts in source:
+        while True:
+            if idle_poll_s is None:
+                try:
+                    rec = await ait.__anext__()
+                except StopAsyncIteration:
+                    break
+            else:
+                if pending is None:
+                    pending = asyncio.ensure_future(ait.__anext__())
+                done, _ = await asyncio.wait({pending},
+                                             timeout=idle_poll_s)
+                if not done:                  # idle tick; keep waiting
+                    if ring is not None:
+                        ring.poll()
+                        for item in _pop_counted(ring_results, own_obs):
+                            await _emit(item)
+                    for item in _counted(operator.poll_shaper(),
+                                         own_obs):
+                        await _emit(item)
+                    continue
+                try:
+                    rec = pending.result()
+                except StopAsyncIteration:
+                    pending = None
+                    break
+                pending = None
+            key, value, ts = rec
+            if nxt is not None and n_seen >= nxt[0] and ring is not None:
+                ring.drain()                  # control barrier
+                for item in _pop_counted(ring_results, own_obs):
+                    await _emit(item)
             nxt = _apply_control(operator, ctl, nxt, n_seen)
             n_seen += 1
-            items = operator.process_element(key, value, int(ts))
+            if ring is not None:
+                ring.offer_one(value, int(ts), key)
+                if ring_poll:           # per-arrival deadline parity
+                    items = _pop(ring_results) + operator.poll_shaper()
+                else:
+                    items = _pop(ring_results)
+            else:
+                items = operator.process_element(key, value, int(ts))
             if own_obs is not None:
                 own_obs.counter(_obs.INGEST_TUPLES).inc()
                 if items:
                     own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
             for item in items:
-                r = emit(item)
-                if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
-                    await r
+                await _emit(item)
+        if ring is not None:
+            ring.drain()
+            for item in _pop_counted(ring_results, own_obs):
+                await _emit(item)
         nxt = _apply_control(operator, ctl, nxt, float("inf"))
         for item in operator.drain_shaper():
-            r = emit(item)
-            if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
-                await r
+            await _emit(item)
     finally:
+        if pending is not None:
+            pending.cancel()
         if server is not None:
             server.close()
             operator.obs_server = None
@@ -97,13 +197,19 @@ async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None,
     before a possibly-long wait — a perpetually stale value on an idle
     consumer) and throttled to every ``depth_sample_every``-th item.
 
+    Use :func:`bounded_queue` to build the queue: an unbounded one is
+    flight-marked (``queue_source_unbounded``) because it silently
+    defeats every downstream bound (module docstring).
+
     ``stall_timeout_s`` arms the preemptive no-progress watchdog: every
     ``get`` that exceeds the timeout counts a ``resilience_stall_events``
     and calls ``on_stall(seconds_waited)``; after ``max_stalls``
     consecutive timeouts (None = keep waiting forever) the source raises
     ``SourceStalled`` so a supervisor can restart the producer."""
-    from ..resilience.connectors import SourceStalled
+    from ..resilience.connectors import SourceStalled, flag_stall
 
+    if obs is not None and queue.maxsize <= 0:
+        obs.flight_event("mark", "queue_source_unbounded")
     n = 0
     while True:
         if stall_timeout_s is None:
@@ -117,12 +223,8 @@ async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None,
                     break
                 except asyncio.TimeoutError:
                     stalls += 1
-                    if obs is not None:
-                        obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
-                        obs.flight_event("stall", "queue_source",
-                                         stalls * stall_timeout_s)
-                    if on_stall is not None:
-                        on_stall(stalls * stall_timeout_s)
+                    flag_stall(obs, "queue_source",
+                               stalls * stall_timeout_s, on_stall)
                     if max_stalls is not None and stalls >= max_stalls:
                         raise SourceStalled(
                             f"queue source made no progress for "

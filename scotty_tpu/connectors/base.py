@@ -21,6 +21,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
+import numpy as np
+
 from .. import obs as _obs
 from ..obs import flight as _flight
 from ..core.aggregates import AggregateFunction
@@ -188,6 +190,36 @@ class KeyedScottyWindowOperator:
         if self._shaper is not None:
             self._shaper.flush()
         out, self._shaper_results = self._shaper_results, []
+        return out
+
+    def poll_shaper(self) -> List[Tuple[Hashable, AggregateWindow]]:
+        """Idle-tick deadline poll (ISSUE 7 satellite): evaluate an
+        attached shaper's ``max_delay_ms`` deadline with no new record —
+        the run loops call it on idle ticks so a quiet source still
+        flushes held records on time. Returns whatever a deadline flush
+        replayed (empty when nothing was due)."""
+        if self._shaper is not None and not self._in_replay:
+            self._shaper.poll()
+        out, self._shaper_results = self._shaper_results, []
+        return out
+
+    def process_block(self, keys, vals, tss
+                      ) -> List[Tuple[Hashable, AggregateWindow]]:
+        """Vectorized block ingestion — the ingest-ring replay path
+        (ISSUE 7): with an attached shaper the whole block lands through
+        the accumulator's ``offer_block`` (array-slice copies, no
+        per-record Python work); bare operators replay per record.
+        Result order is exactly what per-record ``process_element``
+        calls over the same records would produce."""
+        if self._shaper is not None:
+            self._shaper.offer_block(vals, np.asarray(tss, np.int64),
+                                     keys=keys)
+            out, self._shaper_results = self._shaper_results, []
+            return out
+        out: List[Tuple[Hashable, AggregateWindow]] = []
+        for k, v, t in zip(keys, vals,
+                           np.asarray(tss, np.int64).tolist()):
+            out.extend(self._process_element_now(k, v, int(t)))
         return out
 
     # -- serving control path (ISSUE 6) ------------------------------------
@@ -472,6 +504,26 @@ class GlobalScottyWindowOperator:
         if self._shaper is not None:
             self._shaper.flush()
         out, self._shaper_results = self._shaper_results, []
+        return out
+
+    def poll_shaper(self) -> List[AggregateWindow]:
+        """Idle-tick deadline poll — see
+        :meth:`KeyedScottyWindowOperator.poll_shaper`."""
+        if self._shaper is not None and not self._in_replay:
+            self._shaper.poll()
+        out, self._shaper_results = self._shaper_results, []
+        return out
+
+    def process_block(self, vals, tss) -> List[AggregateWindow]:
+        """Vectorized block ingestion (ingest-ring replay path) — see
+        :meth:`KeyedScottyWindowOperator.process_block`."""
+        if self._shaper is not None:
+            self._shaper.offer_block(vals, np.asarray(tss, np.int64))
+            out, self._shaper_results = self._shaper_results, []
+            return out
+        out: List[AggregateWindow] = []
+        for v, t in zip(vals, np.asarray(tss, np.int64).tolist()):
+            out.extend(self._process_element_now(v, int(t)))
         return out
 
     def add_window(self, window: Window) -> "GlobalScottyWindowOperator":

@@ -10,6 +10,26 @@ Telemetry: when the operator carries an attached
 optional ``obs`` parameter here covers the bare-operator case (tuples
 accepted + windows emitted at the connector boundary) without double
 counting.
+
+Idle ticks (ISSUE 7 satellite): a source may yield the :data:`IDLE_TICK`
+sentinel between real records — the loop then evaluates the attached
+shaper's ``max_delay_ms`` deadline (:meth:`poll_shaper`) and pumps the
+ingest ring, so a chunked or quiet source still flushes held records on
+time. (``None`` remains a poison record, as it always was.) A source
+that simply *blocks* in ``__next__`` still cannot be polled — yield
+ticks if bounded delay matters on silence; the kafka adapter's polling
+mode and the asyncio loop's ``idle_poll_s`` generate ticks themselves.
+
+Ingest ring (ISSUE 7 tentpole): ``ingest_ring=`` (a
+:class:`scotty_tpu.ingest.RingConfig`) stages records in a bounded
+preallocated ring and replays them into the operator a BLOCK at a time
+(:meth:`process_block` — with an attached shaper that is one vectorized
+``offer_block`` per block instead of a Python call per record).
+Ring-full engages the configured policy: ``block`` pauses the source
+(backpressure), ``shed`` drops with exact ``ingest_ring_shed`` counts
+(``shed_callback`` sees every dropped record, so an oracle can replay
+the survivors), ``fail`` raises. Results surface in the same order the
+unstaged loop yields them, and bit-match it.
 """
 
 from __future__ import annotations
@@ -18,6 +38,10 @@ from typing import Iterable, Iterator, List, Tuple
 
 from .. import obs as _obs
 from .base import GlobalScottyWindowOperator, KeyedScottyWindowOperator
+
+#: sentinel a source yields as an IDLE TICK (module docstring): the run
+#: loops poll deadlines/the ring instead of treating it as a record
+IDLE_TICK = object()
 
 
 def _control_cursor(control):
@@ -40,16 +64,56 @@ def _apply_control(operator, it, nxt, n: int):
     return nxt
 
 
+def _make_ring(config, operator, keyed: bool, obs, shed_callback,
+               results: List):
+    """Build the run-loop RingIngestor: blocks replay through the
+    operator's vectorized ``process_block``, results land in
+    ``results`` for the loop to yield in order. When the operator
+    carries a bounded-delay shaper, the ring's open-block stage
+    deadline rides the same ``max_delay_ms`` on the same clock, so a
+    slow-but-active (never idle) source still flushes on time."""
+    from ..ingest import RingIngestor
+
+    if keyed:
+        sink = lambda keys, vals, tss: results.extend(   # noqa: E731
+            operator.process_block(keys, vals, tss))
+    else:
+        sink = lambda vals, tss: results.extend(         # noqa: E731
+            operator.process_block(vals, tss))
+    acc = getattr(getattr(operator, "_shaper", None), "accumulator", None)
+    delay_ms = getattr(acc, "max_delay_ms", None)
+    return RingIngestor.for_sink(
+        config, sink, keyed=keyed,
+        obs=obs if obs is not None else operator.obs,
+        shed_callback=shed_callback,
+        clock=acc.clock if acc is not None else None,
+        stage_deadline_s=None if delay_ms is None else delay_ms / 1000.0)
+
+
+def _ring_polls_deadline(operator, ring) -> bool:
+    """Whether the ring path must ALSO evaluate the accumulator deadline
+    on every record arrival (the unstaged loop does so implicitly
+    through per-record offers): true when a bounded-delay shaper is
+    attached — a slow-but-active source never idles, so arrivals are
+    the only evaluation points it gets."""
+    if ring is None:
+        return False
+    acc = getattr(getattr(operator, "_shaper", None), "accumulator", None)
+    return getattr(acc, "max_delay_ms", None) is not None
+
+
 def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
               obs=None, dead_letter=None,
               poison_limit: int | None = None,
-              shaper=None, control=None) -> Iterator[Tuple]:
+              shaper=None, control=None,
+              ingest_ring=None, shed_callback=None) -> Iterator[Tuple]:
     """Drive a keyed operator from an iterable of (key, value, ts); yields
     (key, AggregateWindow) results as watermarks fire.
 
     Records that fail to destructure or whose ts is not integral are
     POISON (ISSUE 3): counted, handed to ``dead_letter(record, exc)`` and
     skipped instead of killing the loop — engine errors still propagate.
+    An :data:`IDLE_TICK` record polls deadlines (module docstring).
 
     ``shaper`` (a :class:`scotty_tpu.shaper.ShaperConfig`, ISSUE 5)
     attaches the coalescing/sorting front-end to the operator for this
@@ -61,6 +125,11 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
     called with the operator once that many records have been consumed
     (e.g. ``lambda op: op.register_window(...)``), interleaving query
     registration/cancellation deterministically with the stream.
+
+    ``ingest_ring`` (a :class:`scotty_tpu.ingest.RingConfig`, ISSUE 7)
+    stages records through the bounded backpressure ring (module
+    docstring); ``shed_callback(vals, ts, keys)`` sees records a 'shed'
+    policy dropped.
     """
     from ..resilience.connectors import PoisonHandler
 
@@ -69,9 +138,31 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
     own_obs = obs if obs is not None and obs is not operator.obs else None
     poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                            obs=obs if obs is not None else operator.obs)
+    ring = None
+    ring_results: List[Tuple] = []
+    if ingest_ring is not None:
+        ring = _make_ring(ingest_ring, operator, True,
+                          obs if obs is not None else operator.obs,
+                          shed_callback, ring_results)
+    ring_poll = _ring_polls_deadline(operator, ring)
     ctl, nxt = _control_cursor(control)
     n_seen = 0
     for rec in source:
+        if rec is IDLE_TICK:                  # idle tick (module docstring)
+            if ring is not None:
+                ring.poll()
+                for item in _pop_counted(ring_results, own_obs):
+                    yield item
+            for item in _counted(operator.poll_shaper(), own_obs):
+                yield item
+            continue
+        if nxt is not None and n_seen >= nxt[0] and ring is not None:
+            # a control command is due: records staged in the ring must
+            # land first, or the command would see an operator that is
+            # behind the record count the schedule names
+            ring.drain()
+            for item in _pop_counted(ring_results, own_obs):
+                yield item
         nxt = _apply_control(operator, ctl, nxt, n_seen)
         n_seen += 1
         try:
@@ -80,12 +171,23 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
         except (TypeError, ValueError) as e:
             poison.handle(rec, e)
             continue
-        items = operator.process_element(key, value, ts)
+        if ring is not None:
+            ring.offer_one(value, ts, key)
+            if ring_poll:               # per-arrival deadline parity
+                items = _pop(ring_results) + operator.poll_shaper()
+            else:
+                items = _pop(ring_results)
+        else:
+            items = operator.process_element(key, value, ts)
         if own_obs is not None:
             own_obs.counter(_obs.INGEST_TUPLES).inc()
             if items:
                 own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
         for item in items:
+            yield item
+    if ring is not None:
+        ring.drain()
+        for item in _pop_counted(ring_results, own_obs):
             yield item
     nxt = _apply_control(operator, ctl, nxt, float("inf"))
     for item in operator.drain_shaper() if hasattr(operator, "drain_shaper") \
@@ -93,13 +195,36 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
         yield item
 
 
+def _pop(buf: List) -> List:
+    out = list(buf)
+    buf.clear()
+    return out
+
+
+def _counted(items, own_obs):
+    """Connector-boundary ``windows_emitted`` parity for windows yielded
+    OUTSIDE the per-record counting block (idle-tick shaper flushes,
+    ring drains): the same flush triggered by a record arrival counts,
+    so one triggered by a tick must too."""
+    if own_obs is not None and items:
+        own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
+    return items
+
+
+def _pop_counted(buf: List, own_obs) -> List:
+    return _counted(_pop(buf), own_obs)
+
+
 def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
                obs=None, dead_letter=None,
                poison_limit: int | None = None,
-               shaper=None, control=None) -> Iterator:
+               shaper=None, control=None,
+               ingest_ring=None, shed_callback=None) -> Iterator:
     """Drive a global operator from an iterable of (value, ts) — same
     poison-record contract as :func:`run_keyed`, same optional
-    ``shaper`` front-end, same ``control`` register/cancel path."""
+    ``shaper`` front-end, same ``control`` register/cancel path, same
+    ``ingest_ring`` bounded staging + :data:`IDLE_TICK` idle ticks
+    (``None`` remains a poison record here too)."""
     from ..resilience.connectors import PoisonHandler
 
     if shaper is not None:
@@ -107,9 +232,28 @@ def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
     own_obs = obs if obs is not None and obs is not operator.obs else None
     poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                            obs=obs if obs is not None else operator.obs)
+    ring = None
+    ring_results: List = []
+    if ingest_ring is not None:
+        ring = _make_ring(ingest_ring, operator, False,
+                          obs if obs is not None else operator.obs,
+                          shed_callback, ring_results)
+    ring_poll = _ring_polls_deadline(operator, ring)
     ctl, nxt = _control_cursor(control)
     n_seen = 0
     for rec in source:
+        if rec is IDLE_TICK:                  # idle tick
+            if ring is not None:
+                ring.poll()
+                for item in _pop_counted(ring_results, own_obs):
+                    yield item
+            for item in _counted(operator.poll_shaper(), own_obs):
+                yield item
+            continue
+        if nxt is not None and n_seen >= nxt[0] and ring is not None:
+            ring.drain()
+            for item in _pop_counted(ring_results, own_obs):
+                yield item
         nxt = _apply_control(operator, ctl, nxt, n_seen)
         n_seen += 1
         try:
@@ -118,12 +262,23 @@ def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
         except (TypeError, ValueError) as e:
             poison.handle(rec, e)
             continue
-        items = operator.process_element(value, ts)
+        if ring is not None:
+            ring.offer_one(value, ts)
+            if ring_poll:               # per-arrival deadline parity
+                items = _pop(ring_results) + operator.poll_shaper()
+            else:
+                items = _pop(ring_results)
+        else:
+            items = operator.process_element(value, ts)
         if own_obs is not None:
             own_obs.counter(_obs.INGEST_TUPLES).inc()
             if items:
                 own_obs.counter(_obs.WINDOWS_EMITTED).inc(len(items))
         for item in items:
+            yield item
+    if ring is not None:
+        ring.drain()
+        for item in _pop_counted(ring_results, own_obs):
             yield item
     nxt = _apply_control(operator, ctl, nxt, float("inf"))
     for item in operator.drain_shaper() if hasattr(operator, "drain_shaper") \
@@ -132,16 +287,18 @@ def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
 
 
 def collect_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
-                  final_watermark: int | None = None, obs=None) -> List[Tuple]:
-    out = list(run_keyed(source, operator, obs=obs))
+                  final_watermark: int | None = None, obs=None,
+                  **kwargs) -> List[Tuple]:
+    out = list(run_keyed(source, operator, obs=obs, **kwargs))
     if final_watermark is not None:
         out.extend(operator.process_watermark(final_watermark))
     return out
 
 
 def collect_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
-                   final_watermark: int | None = None, obs=None) -> List:
-    out = list(run_global(source, operator, obs=obs))
+                   final_watermark: int | None = None, obs=None,
+                   **kwargs) -> List:
+    out = list(run_global(source, operator, obs=obs, **kwargs))
     if final_watermark is not None:
         out.extend(operator.process_watermark(final_watermark))
     return out

@@ -40,6 +40,69 @@ def _default_deserialize(record) -> Tuple:
     return key, val, int(record.timestamp)
 
 
+def _poll_takes_timeout_ms(consumer) -> bool:
+    """Detect the consumer's poll face ONCE, by signature: kafka-python
+    takes ``timeout_ms=``, confluent_kafka takes positional SECONDS (its
+    C-implemented method has no inspectable signature). Probing with a
+    per-call try/except would swallow genuine ``TypeError``s raised
+    inside a kafka-python poll and misroute every later call."""
+    import inspect
+
+    try:
+        params = inspect.signature(consumer.poll).parameters
+    except (TypeError, ValueError):      # C impl (confluent-style)
+        return False
+    return "timeout_ms" in params
+
+
+def _poll_records(consumer, idle_poll_ms: int, clock=None,
+                  stall_timeout_s=None, obs=None, on_stall=None):
+    """Drive a Kafka consumer's poll face — ``poll(timeout_ms=...)``
+    (kafka-python) or positional-seconds ``poll(timeout)``
+    (confluent_kafka; see :func:`_poll_takes_timeout_ms`) — as
+    an endless record iterator yielding :data:`~scotty_tpu.connectors.
+    iterable.IDLE_TICK` on every empty poll — the idle tick that keeps
+    bounded-delay flushes honest on silent topics. Only ``max_records``
+    (or an external stop) ends a polling loop.
+
+    Polling mode owns the stall watchdog itself: a post-hoc
+    ``watchdog_source`` around this iterator would only ever see
+    sub-``idle_poll_ms`` gaps (every empty poll yields a tick), so
+    instead the QUIET time on the injectable clock accumulates across
+    empty polls and every ``stall_timeout_s`` of it flags a stall (the
+    ``queue_source`` discipline: a continuing stall keeps counting)."""
+    from ..resilience.clock import SystemClock
+    from ..resilience.connectors import flag_stall
+    from .iterable import IDLE_TICK
+
+    clock = clock or SystemClock()
+    quiet_from = None
+    poll_kw = _poll_takes_timeout_ms(consumer)
+    while True:
+        if poll_kw:
+            polled = consumer.poll(timeout_ms=idle_poll_ms)
+        else:
+            polled = consumer.poll(idle_poll_ms / 1000.0)
+        if not polled:
+            if stall_timeout_s is not None:
+                now = clock.now()
+                if quiet_from is None:
+                    quiet_from = now
+                elif now - quiet_from > stall_timeout_s:
+                    flag_stall(obs, "kafka_poll", now - quiet_from,
+                               on_stall)
+                    quiet_from = now     # a continuing stall re-flags
+            yield IDLE_TICK
+            continue
+        quiet_from = None
+        if isinstance(polled, dict):      # kafka-python: {tp: [records]}
+            for records in polled.values():
+                for r in records:
+                    yield r
+        else:                             # a bare record (confluent-style)
+            yield polled
+
+
 class KafkaScottyWindowOperator:
     """Consume a Kafka topic, window it, hand results to ``on_result``.
 
@@ -70,7 +133,10 @@ class KafkaScottyWindowOperator:
             serve_port: Optional[int] = None,
             health=None,
             shaper=None,
-            control=None) -> int:
+            control=None,
+            idle_poll_ms: Optional[int] = None,
+            ingest_ring=None,
+            shed_callback: Optional[Callable] = None) -> int:
         """``consumer``: any iterable of Kafka-like records (KafkaConsumer
         instances are iterables of ConsumerRecord). Returns records
         consumed (poison records count — they were consumed, then
@@ -102,17 +168,49 @@ class KafkaScottyWindowOperator:
         each ``command`` called with the operator once that many records
         were consumed (``lambda op: op.register_window(...)`` /
         ``op.cancel_window(...)``); any remainder fires at loop end.
+
+        ``idle_poll_ms`` (ISSUE 7 satellite — the max_delay_ms honesty
+        fix): when the consumer exposes Kafka's ``poll(timeout_ms=...)``
+        face, the loop drives it in polling mode with that timeout; an
+        empty poll is an IDLE TICK that evaluates the accumulator
+        deadline (``poll_shaper``) and pumps the ingest ring, so a
+        silent topic still flushes held records on time. In polling mode
+        the loop only ends at ``max_records`` — set it (or stop
+        externally). Plain iterables may yield the
+        :data:`~scotty_tpu.connectors.iterable.IDLE_TICK` sentinel for
+        the same effect.
+
+        ``ingest_ring`` (a :class:`scotty_tpu.ingest.RingConfig`, ISSUE
+        7) stages records through the bounded backpressure ring —
+        block/shed/fail on full, exact ``ingest_ring_*`` accounting,
+        block-at-a-time vectorized replay; ``shed_callback(vals, ts,
+        keys)`` sees records a 'shed' policy dropped.
         """
         from ..resilience.connectors import PoisonHandler, watchdog_source
-        from .iterable import _apply_control, _control_cursor
+        from .iterable import (IDLE_TICK, _apply_control, _control_cursor,
+                               _make_ring, _pop, _ring_polls_deadline)
 
         if shaper is not None:
             self.operator.attach_shaper(shaper, clock=clock)
         poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,
                                obs=self.operator.obs)
-        if stall_timeout_s is not None:
+        if idle_poll_ms is not None and hasattr(consumer, "poll"):
+            # polling mode carries its own stall accounting — wrapping
+            # the tick stream in watchdog_source instead would measure
+            # only sub-idle_poll_ms gaps and never flag a dead producer
+            consumer = _poll_records(consumer, idle_poll_ms, clock=clock,
+                                     stall_timeout_s=stall_timeout_s,
+                                     obs=self.operator.obs)
+        elif stall_timeout_s is not None:
             consumer = watchdog_source(consumer, stall_timeout_s,
                                        clock=clock, obs=self.operator.obs)
+        ring = None
+        ring_results: list = []
+        if ingest_ring is not None:
+            ring = _make_ring(ingest_ring, self.operator, True,
+                              self.operator.obs, shed_callback,
+                              ring_results)
+        ring_poll = _ring_polls_deadline(self.operator, ring)
         self.obs_server = None
         if serve_port is not None and self.operator.obs is not None:
             self.obs_server = self.operator.obs.serve(port=serve_port,
@@ -121,6 +219,19 @@ class KafkaScottyWindowOperator:
         ctl, nxt = _control_cursor(control)
         try:
             for record in consumer:
+                if record is IDLE_TICK:       # idle tick (quiet topic)
+                    if ring is not None:
+                        ring.poll()
+                        for item in _pop(ring_results):
+                            on_result(item)
+                    for item in self.operator.poll_shaper():
+                        on_result(item)
+                    continue
+                if nxt is not None and n >= nxt[0] and ring is not None:
+                    # control barrier: staged records land first
+                    ring.drain()
+                    for item in _pop(ring_results):
+                        on_result(item)
                 nxt = _apply_control(self.operator, ctl, nxt, n)
                 n += 1
                 try:
@@ -128,11 +239,24 @@ class KafkaScottyWindowOperator:
                 except Exception as e:   # noqa: BLE001 — poison boundary
                     poison.handle(record, e)
                 else:
-                    for item in self.operator.process_element(key, value,
-                                                              ts):
+                    if ring is not None:
+                        ring.offer_one(value, ts, key)
+                        if ring_poll:   # per-arrival deadline parity
+                            items = (_pop(ring_results)
+                                     + self.operator.poll_shaper())
+                        else:
+                            items = _pop(ring_results)
+                    else:
+                        items = self.operator.process_element(key, value,
+                                                              ts)
+                    for item in items:
                         on_result(item)
                 if max_records is not None and n >= max_records:
                     break
+            if ring is not None:
+                ring.drain()
+                for item in _pop(ring_results):
+                    on_result(item)
             nxt = _apply_control(self.operator, ctl, nxt, float("inf"))
             for item in self.operator.drain_shaper():
                 on_result(item)

@@ -294,6 +294,11 @@ class TpuWindowOperator(WindowOperator):
         #: (default) leaves every pre-shaper path byte-identical.
         self._shaper = None
         self._shaper_feeding = False
+        #: line-rate ingest feed (scotty_tpu.ingest.LineRateFeed, ISSUE
+        #: 7): attaches itself at construction. Watermark dispatch drains
+        #: its staged records first (same contract as the shaper) and
+        #: check_overflow folds its ingest_ring_* telemetry.
+        self._ingest_feed = None
         if shaper is not None:
             from ..shaper import ShaperConfig, StreamShaper
 
@@ -1566,6 +1571,11 @@ class TpuWindowOperator(WindowOperator):
             # the shaper's accumulator — drain it first (the shaper's
             # bounded-delay contract also caps how much can be here)
             self._shaper.flush()
+        if self._ingest_feed is not None:
+            # same contract for the ingest ring: records still staged
+            # (accumulator slack band, partial block, prefetch stage)
+            # must land before the watermark sweeps past them
+            self._ingest_feed.drain()
         self._flush()
         if self._pure_session:
             outs = self._sweep_sessions(watermark_ts)
@@ -1782,6 +1792,10 @@ class TpuWindowOperator(WindowOperator):
             # shaper drain-point check: raises ShaperOverflow on a lost
             # late residue and folds the shaper_* telemetry
             self._shaper.check()
+        if self._ingest_feed is not None:
+            # ingest-ring drain-point fold (ingest_ring_* counters +
+            # occupancy gauges — scotty_tpu.ingest)
+            self._ingest_feed.check()
         if not self._built:
             return
         if self._state is not None:

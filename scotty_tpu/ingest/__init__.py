@@ -1,0 +1,29 @@
+"""Bounded, credit-based ingest at the host→device boundary (ISSUE 7).
+
+The pieces (see each module's docstring):
+
+* :mod:`.ring` — :class:`IngestRing`, the fixed-depth ring of
+  preallocated numpy staging blocks; :class:`RingConfig`, the
+  ``ingest_ring=`` face on the connector run loops; :class:`RingFull`.
+* :mod:`.feeder` — :class:`DeviceRingFeeder` (prefetching H2D consumer),
+  :class:`BlockSinkFeeder` (host replay consumer) and
+  :class:`RingIngestor` (the producer facade owning the
+  block/shed/fail backpressure policy and the exact accounting).
+* :mod:`.pipeline` — :class:`LineRateFeed`, the one-object wiring of
+  accumulator → ring → prefetch feeder for a ``TpuWindowOperator``.
+
+Telemetry rides the ``ingest_ring_*`` obs contract; ring-full and shed
+decisions land in the flight recorder; the soak harness
+(:mod:`scotty_tpu.soak`) audits the conservation identity these counters
+carry.
+"""
+
+from .feeder import BlockSinkFeeder, DeviceRingFeeder, RingIngestor
+from .pipeline import LineRateFeed
+from .ring import IngestRing, RingBlock, RingConfig, RingFull
+
+__all__ = [
+    "IngestRing", "RingBlock", "RingConfig", "RingFull",
+    "RingIngestor", "BlockSinkFeeder", "DeviceRingFeeder",
+    "LineRateFeed",
+]

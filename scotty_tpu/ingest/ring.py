@@ -1,0 +1,278 @@
+"""Bounded ingest ring: preallocated host staging blocks with credits.
+
+The host→device boundary used to be a per-record Python loop feeding
+synchronous transfers, backed by buffers that could grow without bound
+(an unbounded producer queue, the accumulator's chunk lists) — nothing
+pushed back on a source that outran the engine, and nothing proved that
+records were never silently lost. :class:`IngestRing` replaces that edge
+with a **fixed-depth ring of preallocated numpy staging blocks**:
+
+* **Preallocated**: ``depth`` blocks of ``block_size`` rows (values +
+  int64 timestamps, plus an object-array key column when ``keyed``) are
+  allocated once at construction. Producing is an array-slice copy into
+  the open block — no per-record boxing, no list growth.
+* **Credit-based**: a block is a credit. The producer fills the open
+  block (:meth:`offer_block` / :meth:`offer_one`); a full block commits
+  and becomes visible to the consumer (:meth:`take` → :meth:`free`).
+  When every credit is committed-or-checked-out the ring is FULL — a
+  first-class backpressure signal (:meth:`has_space` / the truncated
+  ``offer_block`` return), never an implicit allocation. What to do
+  about it (block the source, shed, fail) is the
+  :class:`~scotty_tpu.ingest.feeder.RingIngestor`'s policy, mirroring
+  the PR 3 ``overflow_policy`` discipline.
+* **Exactly accounted**: ``offered`` / ``delivered`` / ``shed`` /
+  ``occupancy`` are plain integers maintained on every transition, so
+  the soak harness's tuple-conservation audit can demand
+  ``offered == delivered + shed + occupancy`` to the tuple at any
+  quiescent point (the obs fold exposes them under the
+  ``ingest_ring_*`` contract names).
+
+Single-threaded by design: the synchronous run loops interleave producer
+and consumer in one thread (the asyncio path's cross-thread boundary is
+the bounded ``asyncio.Queue`` in front of the ring). Slots recycle FIFO.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+class RingFull(RuntimeError):
+    """Raised only under ``policy='fail'``: the ring was full and the
+    caller asked for an error instead of backpressure or shedding."""
+
+
+@dataclass(frozen=True)
+class RingConfig:
+    """Static ingest-ring configuration (the ``ingest_ring=`` face on the
+    connector run loops and the line-rate device feed).
+
+    * ``depth`` — staging blocks in the ring (the credit count). Bounded
+      memory: ``depth * block_size`` records, allocated once.
+    * ``block_size`` — rows per staging block (``None`` = the operator's
+      ``config.batch_size``, or 1024 for host connectors).
+    * ``policy`` — what ring-full does to the producer: ``"block"``
+      (default) pumps the consumer until a credit frees — the source is
+      effectively paused, which is end-to-end backpressure in a
+      synchronous loop; ``"shed"`` drops the records that did not fit,
+      with exact ``ingest_ring_shed`` counts and a ``shed_callback`` so
+      an oracle can replay the survivors (the PR 3 SHED discipline at
+      the host edge); ``"fail"`` raises :class:`RingFull`.
+    * ``stall_timeout_s`` — consumer watchdog: a single blocked-credit
+      wait (or consumer delivery) exceeding this on the injectable clock
+      counts a ``resilience_stall_events`` and flight-records a stall,
+      exactly like the PR 3 source watchdogs — a consumer that stops
+      draining is as much an incident as a source that stops producing.
+    * ``pump_at`` — committed blocks that trigger an automatic consumer
+      pump in the run-loop wiring (1 = deliver as soon as a block fills;
+      0 = NO automatic pumping — the consumer runs only on idle ticks,
+      drains and ring-full backpressure, which is how the differential
+      tests force deterministic full/shed scenarios).
+    * ``prefetch`` — device-feeder staging depth: how many transferred
+      blocks may wait in the prefetch stage before the oldest's ingest
+      is dispatched (1 = classic double buffering).
+    """
+
+    depth: int = 8
+    block_size: Optional[int] = None
+    policy: str = "block"
+    stall_timeout_s: Optional[float] = None
+    pump_at: int = 1
+    prefetch: int = 1
+
+    def __post_init__(self):
+        if self.policy not in ("block", "shed", "fail"):
+            raise ValueError(
+                f"unknown ring policy {self.policy!r}: expected 'block', "
+                "'shed' or 'fail'")
+        if self.depth < 2:
+            raise ValueError("ring depth must be >= 2 (one block filling, "
+                             "one draining)")
+        if not (0 <= self.pump_at <= self.depth):
+            raise ValueError(
+                f"pump_at={self.pump_at} must be within [0, depth]")
+        if self.prefetch < 1:
+            raise ValueError("prefetch must be >= 1")
+
+
+class RingBlock:
+    """A checked-out committed block: read-only views into the slot's
+    preallocated storage, valid until :meth:`IngestRing.free`."""
+
+    __slots__ = ("seq", "vals", "ts", "keys", "n", "ts_min", "ts_max")
+
+    def __init__(self, seq, vals, ts, keys, n, ts_min, ts_max):
+        self.seq = seq
+        self.vals = vals
+        self.ts = ts
+        self.keys = keys
+        self.n = n
+        self.ts_min = ts_min
+        self.ts_max = ts_max
+
+
+class IngestRing:
+    """The bounded staging ring (module docstring). Producer face:
+    :meth:`offer_block` / :meth:`offer_one` / :meth:`flush_open`;
+    consumer face: :meth:`take` / :meth:`free`."""
+
+    def __init__(self, depth: int, block_size: int, keyed: bool = False,
+                 value_dtype=np.float32):
+        if depth < 2:
+            raise ValueError("ring depth must be >= 2")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.depth = int(depth)
+        self.block_size = int(block_size)
+        self.keyed = keyed
+        self.value_dtype = value_dtype
+        B = self.block_size
+        if value_dtype is None:
+            self._vals = [np.empty(B, object) for _ in range(depth)]
+        else:
+            self._vals = [np.empty(B, value_dtype) for _ in range(depth)]
+        self._ts = [np.empty(B, np.int64) for _ in range(depth)]
+        self._keys = [np.empty(B, object) for _ in range(depth)] \
+            if keyed else None
+        self._ns = [0] * depth            # valid rows per committed slot
+        self._fill = 0                    # rows in the open slot
+        self._seq_w = 0                   # blocks ever committed
+        self._seq_r = 0                   # blocks ever taken
+        self._seq_f = 0                   # blocks ever freed
+        # exact lifetime accounting (the conservation identity's terms)
+        self.offered = 0                  # records accepted into the ring
+        self.delivered = 0                # records freed by the consumer
+        self.blocks = 0                   # blocks committed
+        self.full_events = 0              # producer found the ring full
+        self.highwater = 0                # occupancy high-water (records)
+
+    # -- state -------------------------------------------------------------
+    @property
+    def occupancy(self) -> int:
+        """Records currently staged (committed + checked-out + open)."""
+        return self.offered - self.delivered
+
+    @property
+    def committed_blocks(self) -> int:
+        """Blocks committed and not yet taken."""
+        return self._seq_w - self._seq_r
+
+    @property
+    def checked_out_blocks(self) -> int:
+        return self._seq_r - self._seq_f
+
+    def has_space(self) -> bool:
+        """Whether at least one record can be accepted right now —
+        ``False`` IS the backpressure signal."""
+        return self._seq_w - self._seq_f < self.depth
+
+    # -- producer ----------------------------------------------------------
+    def coerce_block(self, vals, ts, keys=None):
+        """Convert one offered chunk to the ring's array types —
+        :meth:`offer_block` and the retrying
+        :meth:`~scotty_tpu.ingest.feeder.RingIngestor.offer_block` both
+        route through the shaper's shared
+        :func:`~scotty_tpu.shaper.host.coerce_records` (the one guard
+        for the object-payload boxing hazard; idempotent, so retry
+        slices re-coerce for free)."""
+        from ..shaper.host import coerce_records
+
+        return coerce_records(vals, ts, keys, self.value_dtype,
+                              self.keyed, "ring")
+
+    def offer_block(self, vals, ts, keys=None) -> int:
+        """Copy records into the ring via array-slice writes; returns how
+        many were ACCEPTED (< the offered count means the ring filled —
+        the caller's policy decides what happens to the remainder)."""
+        v, t, k = self.coerce_block(vals, ts, keys)
+        pos, n = 0, t.size
+        while pos < n:
+            if not self.has_space():
+                self.full_events += 1
+                break
+            i = self._seq_w % self.depth
+            take = min(n - pos, self.block_size - self._fill)
+            f = self._fill
+            self._vals[i][f:f + take] = v[pos:pos + take]
+            self._ts[i][f:f + take] = t[pos:pos + take]
+            if self.keyed:
+                self._keys[i][f:f + take] = k[pos:pos + take]
+            self._fill += take
+            pos += take
+            self.offered += take
+            if self._fill == self.block_size:
+                self._commit(i)
+        self.highwater = max(self.highwater, self.occupancy)
+        return pos
+
+    def offer_one(self, val, ts, key=None) -> bool:
+        """Scalar fast path (per-record run loops): one slot assignment,
+        no array boxing. Returns False when the ring is full."""
+        if not self.has_space():
+            self.full_events += 1
+            return False
+        i = self._seq_w % self.depth
+        f = self._fill
+        self._vals[i][f] = val
+        self._ts[i][f] = int(ts)
+        if self.keyed:
+            self._keys[i][f] = key
+        self._fill += 1
+        self.offered += 1
+        if self._fill == self.block_size:
+            self._commit(i)
+        self.highwater = max(self.highwater, self.occupancy)
+        return True
+
+    def flush_open(self) -> bool:
+        """Commit the partially-filled open block (drain/deadline path);
+        returns whether a block was committed."""
+        if self._fill == 0:
+            return False
+        self._commit(self._seq_w % self.depth)
+        return True
+
+    def _commit(self, i: int) -> None:
+        n = self._fill
+        self._ns[i] = n
+        self._fill = 0
+        self._seq_w += 1
+        self.blocks += 1
+
+    # -- consumer ----------------------------------------------------------
+    def take(self) -> Optional[RingBlock]:
+        """Check out the oldest committed block (None when none are
+        committed). The block's views stay valid until :meth:`free`."""
+        if self._seq_r >= self._seq_w:
+            return None
+        seq = self._seq_r
+        i = seq % self.depth
+        n = self._ns[i]
+        self._seq_r += 1
+        ts = self._ts[i]
+        ts_min = int(ts[:n].min()) if n else 0
+        ts_max = int(ts[:n].max()) if n else 0
+        return RingBlock(seq, self._vals[i], ts,
+                         self._keys[i] if self.keyed else None,
+                         n, ts_min, ts_max)
+
+    def free(self, block: RingBlock) -> None:
+        """Return a checked-out block's credit (FIFO: blocks free in take
+        order — the prefetch stage consumes them in order anyway)."""
+        if block.seq != self._seq_f:
+            raise ValueError(
+                f"ring blocks free FIFO: expected seq {self._seq_f}, got "
+                f"{block.seq}")
+        self._seq_f += 1
+        self.delivered += block.n
+
+    def snapshot(self) -> dict:
+        """Exact accounting snapshot (tests + the soak audit read it)."""
+        return {"offered": self.offered, "delivered": self.delivered,
+                "occupancy": self.occupancy, "blocks": self.blocks,
+                "full_events": self.full_events,
+                "highwater": self.highwater, "depth": self.depth,
+                "block_size": self.block_size}

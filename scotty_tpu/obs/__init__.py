@@ -142,6 +142,26 @@ SERVING_CACHE_MISSES = "serving_cache_misses"
 SERVING_CACHE_EVICTIONS = "serving_cache_evictions"
 SERVING_ACTIVE_QUERIES = "serving_active_queries"
 
+# ingest-ring contract (ISSUE 7 — scotty_tpu.ingest; the bounded host
+# staging ring between sources and the device boundary. Counters are
+# folded at pump/drain points; all are exact integers, so the soak
+# harness's tuple-conservation audit can demand
+# offered == delivered + shed + occupancy to the tuple)
+INGEST_RING_OFFERED = "ingest_ring_offered"
+INGEST_RING_DELIVERED = "ingest_ring_delivered"
+INGEST_RING_SHED = "ingest_ring_shed"
+INGEST_RING_BLOCKS = "ingest_ring_blocks"
+INGEST_RING_FULL_EVENTS = "ingest_ring_full_events"
+INGEST_RING_OCCUPANCY = "ingest_ring_occupancy"
+INGEST_RING_HIGHWATER = "ingest_ring_highwater"
+
+# soak contract (ISSUE 7 — scotty_tpu.soak; the endurance harness's own
+# bookkeeping. soak_invariant_failures appearing gates the default
+# ``obs diff``: a soak that failed an audit must never pass as clean)
+SOAK_AUDITS = "soak_audits"
+SOAK_INVARIANT_FAILURES = "soak_invariant_failures"
+SOAK_RECORDS_SEEN = "soak_records_seen"
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -196,6 +216,22 @@ METRIC_HELP = {
     SERVING_CACHE_MISSES: "bucket changes that found no cached executable",
     SERVING_CACHE_EVICTIONS: "compile-cache entries evicted (LRU)",
     SERVING_ACTIVE_QUERIES: "currently active queries across all tenants",
+    INGEST_RING_OFFERED: "records offered to the ingest ring",
+    INGEST_RING_DELIVERED:
+        "records the ring's consumer delivered downstream (device ingest "
+        "or operator replay)",
+    INGEST_RING_SHED:
+        "records shed at the ring boundary (policy='shed' while full)",
+    INGEST_RING_BLOCKS: "staging blocks committed to the ring",
+    INGEST_RING_FULL_EVENTS:
+        "times a producer found the ring full (backpressure engaged)",
+    INGEST_RING_OCCUPANCY: "records currently staged in the ring",
+    INGEST_RING_HIGHWATER: "ring occupancy high-water (records)",
+    SOAK_AUDITS: "soak invariant audits performed",
+    SOAK_INVARIANT_FAILURES: "soak audits that found a violated invariant",
+    SOAK_RECORDS_SEEN:
+        "records the soak loop pulled from its source (offer attempts; "
+        "the left-hand side of the conservation identity)",
     RESILIENCE_SHED_TUPLES: "tuples dropped by the SHED overflow policy",
     RESILIENCE_GROW_EVENTS: "GROW capacity doublings",
     RESILIENCE_CHECKPOINTS: "automatic supervisor checkpoints",
@@ -391,6 +427,10 @@ __all__ = [
     "EMIT_LATENCY_MS",
     "SHAPER_REORDERED_TUPLES", "SHAPER_FLUSHES", "SHAPER_HELD_TUPLES",
     "SHAPER_LATE_ROUTED", "SHAPER_SLACK_OVERFLOWS", "SHAPER_FILL_RATIO",
+    "INGEST_RING_OFFERED", "INGEST_RING_DELIVERED", "INGEST_RING_SHED",
+    "INGEST_RING_BLOCKS", "INGEST_RING_FULL_EVENTS",
+    "INGEST_RING_OCCUPANCY", "INGEST_RING_HIGHWATER",
+    "SOAK_AUDITS", "SOAK_INVARIANT_FAILURES", "SOAK_RECORDS_SEEN",
     "SERVING_REGISTERED", "SERVING_CANCELLED", "SERVING_REJECTED",
     "SERVING_RETRACES", "SERVING_CACHE_HITS", "SERVING_CACHE_MISSES",
     "SERVING_CACHE_EVICTIONS", "SERVING_ACTIVE_QUERIES",

@@ -75,6 +75,14 @@ DEFAULT_THRESHOLDS = {
         "shaper_held_tuples": {"direction": "lower", "default": 0},
         "shaper_reordered_tuples": {"direction": "lower", "default": 0,
                                     "rel_tol": 0.10},
+        # ingest-ring / soak contract (ISSUE 7): records shed at the ring
+        # boundary, backpressure engaging where a baseline never pushed
+        # back, and soak invariant failures are regressions even when the
+        # headline throughput held. All lazily created ("default": 0
+        # gates the appearing case, like the resilience set).
+        "ingest_ring_shed": {"direction": "lower", "default": 0},
+        "ingest_ring_full_events": {"direction": "lower", "default": 0},
+        "soak_invariant_failures": {"direction": "lower", "default": 0},
         # serving contract (ISSUE 6): steady-state serving must neither
         # start recompiling (a retrace appearing or growing after warmup
         # means the zero-retrace mask/bucket machinery regressed) nor

@@ -99,6 +99,15 @@ MARK = "mark"
 SHAPER_FLUSH = "shaper_flush"
 SHAPER_HELD = "shaper_held"
 SHAPER_OVERFLOW = "shaper_overflow"
+# ingest-ring / soak events (ISSUE 7, scotty_tpu.ingest + scotty_tpu.soak):
+# backpressure engaging (ring found full), records shed at the ring
+# boundary (value = count), a soak audit pass (value = audit index) and a
+# soak invariant violation (name = invariant) — a postmortem of an
+# hours-long run shows exactly when the boundary started pushing back
+RING_FULL = "ring_full"
+RING_SHED = "ring_shed"
+SOAK_AUDIT = "soak_audit"
+SOAK_INVARIANT = "soak_invariant"
 # dynamic-query serving events (ISSUE 6, scotty_tpu.serving): every
 # control-plane operation lands in the ring — register/cancel (name =
 # tenant:window, value = slot), admission reject, compile-cache eviction,

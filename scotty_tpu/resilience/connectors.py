@@ -105,6 +105,21 @@ class PoisonHandler:
                 f"{self.limit}") from exc
 
 
+def flag_stall(obs, name: str, gap_s: float, on_stall=None) -> None:
+    """Count + flight-record one watchdog detection — the single emission
+    point shared by the source watchdogs (:func:`watchdog_source`, the
+    asyncio ``queue_source``) and the ingest-ring CONSUMER watchdog
+    (scotty_tpu.ingest): a consumer that stops draining credits is the
+    same class of incident as a source that stops producing, and lands in
+    the same ``resilience_stall_events`` counter and ``stall`` flight
+    events the health endpoint and postmortems already watch."""
+    if obs is not None:
+        obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
+        obs.flight_event("stall", name, gap_s)
+    if on_stall is not None:
+        on_stall(gap_s)
+
+
 def watchdog_source(source, stall_timeout_s: float,
                     clock: Optional[Clock] = None, obs=None,
                     on_stall: Optional[Callable[[float], None]] = None
@@ -133,9 +148,5 @@ def watchdog_source(source, stall_timeout_s: float,
             return
         gap = clock.now() - t_pull
         if gap > stall_timeout_s:
-            if obs is not None:
-                obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
-                obs.flight_event("stall", "watchdog_source", gap)
-            if on_stall is not None:
-                on_stall(gap)
+            flag_stall(obs, "watchdog_source", gap, on_stall)
         yield item

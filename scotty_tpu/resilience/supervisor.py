@@ -188,6 +188,49 @@ class Supervisor:
         with open(path) as f:
             return EngineConfig(**json.load(f))
 
+    # -- custom streaming loops (ISSUE 7: the soak harness) ----------------
+    def commit_checkpoint(self, pos: int, save_fn: Callable[[str], None],
+                          offset: Optional[int] = None) -> None:
+        """Generic atomic checkpoint commit for custom streaming loops
+        (the soak harness drives one): ``save_fn(dir)`` writes the
+        target's state into a fresh ``ckpt-<pos>`` directory; the offset
+        sidecar and the ``os.replace`` pointer flip follow exactly the
+        run_pipeline/run_operator discipline, and committing resets the
+        consecutive-restart budget (progress was made)."""
+        with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
+            d = self._new_ckpt_dir(pos)
+            save_fn(d)
+            if offset is not None:
+                with open(os.path.join(d, "offset.json"), "w") as f:
+                    json.dump({"offset": int(offset)}, f)
+            self._commit_ckpt(d)
+        self._count(_obs.RESILIENCE_CHECKPOINTS)
+        self._flight("checkpoint", "offset",
+                     pos if offset is None else offset)
+        self.restarts = 0
+
+    def latest_checkpoint(self):
+        """``(dir, offset)`` of the last committed checkpoint (offset 0
+        without a sidecar), or ``None`` before the first commit."""
+        ckpt = self._current_ckpt()
+        if ckpt is None:
+            return None
+        offset = 0
+        p = os.path.join(ckpt, "offset.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                offset = int(json.load(f)["offset"])
+        return ckpt, offset
+
+    def handle_failure(self, exc: BaseException) -> None:
+        """Public face of the restart path for custom loops: restart
+        accounting + postmortem bundle + bounded backoff on the
+        injectable clock; raises :class:`SupervisorGaveUp` once
+        ``max_restarts`` consecutive recoveries failed. The caller then
+        restores from :meth:`latest_checkpoint` and rewinds its source
+        to the checkpointed offset."""
+        self._backoff(exc)
+
     # -- pipeline mode -----------------------------------------------------
     def run_pipeline(self, factory: Callable, n_intervals: int,
                      fault: Optional[Callable[[int], None]] = None) -> list:

@@ -165,6 +165,15 @@ class StreamShaper:
         self._record_host_telemetry()
         return n
 
+    def offer_block(self, vals, ts, keys=None) -> int:
+        """Buffer one staged block of host records through the
+        accumulator's vectorized block-fill path (ISSUE 7) — exactly
+        equivalent to per-record offers, without the per-record Python
+        work. The ingest-ring replay path lands whole blocks here."""
+        n = self.accumulator.offer_block(vals, ts, keys=keys)
+        self._record_host_telemetry()
+        return n
+
     def poll(self) -> int:
         """Idle-source tick: fire an expired bounded-delay flush even
         when no new records arrive."""

@@ -1,0 +1,37 @@
+"""Soak/endurance harness (ISSUE 7): prove the system survives sustained
+external load — no silent drops, no unbounded queues, no leaks.
+
+* :mod:`.source` — seeded offered-load record source with the chaos mix
+  (late storms / poison / flaky fetches / one-shot consumer crashes).
+* :mod:`.invariants` — the audit functions: exact tuple conservation,
+  watermark monotonicity, ring boundedness, the memory ratchet.
+* :mod:`.harness` — :class:`SoakRunner` / :func:`run_soak`: the paced
+  loop on the injectable Clock, under the Supervisor's checkpoint /
+  restart discipline, polling ``/healthz``, failing fast on any audit
+  finding, and writing the evidence bundle even on success.
+"""
+
+from .harness import (
+    ConnectorSoakTarget,
+    SoakConfig,
+    SoakInvariantViolation,
+    SoakRunner,
+    run_soak,
+)
+from .invariants import (
+    check_conservation,
+    check_memory_ratchet,
+    check_ring_bounded,
+    check_watermark_monotone,
+    live_objects,
+    rss_bytes,
+)
+from .source import ChaosMix, SoakSource, SourceConfig
+
+__all__ = [
+    "SoakConfig", "SoakRunner", "SoakInvariantViolation", "run_soak",
+    "ConnectorSoakTarget", "ChaosMix", "SoakSource", "SourceConfig",
+    "check_conservation", "check_watermark_monotone",
+    "check_ring_bounded", "check_memory_ratchet",
+    "rss_bytes", "live_objects",
+]

@@ -1,0 +1,475 @@
+"""The soak harness: sustained offered load + invariant audits + evidence.
+
+``SoakRunner`` drives a windowing target at a configured offered rate
+for a configured duration on the **injectable Clock** — seconds of
+virtual time in CI (``ManualClock``: the smoke soak is deterministic and
+fast), hours of wall time on a real box (``SystemClock``) — under the
+PR 3 :class:`~scotty_tpu.resilience.supervisor.Supervisor`'s checkpoint
+/ restart / give-up discipline, with the seeded chaos mix of
+:mod:`.source` turned on or off per run.
+
+Every ``audit_every_s`` the runner proves, not assumes:
+
+* **tuple conservation** (exact): ``seen == delivered + shed + held +
+  dead_lettered (+ abandoned)`` — ``abandoned`` counts records a
+  crashed target generation had staged but not delivered; the
+  checkpoint rewind re-offers them, so it stays 0 in crash-free soaks
+  and the identity is the ISSUE 7 contract verbatim;
+* **watermark monotonicity**;
+* **ring boundedness** (occupancy and high-water vs depth × block_size);
+* **memory ratchet**: RSS + live-object readings must plateau — a
+  monotone ratchet past the grace window fails the soak with the trend
+  in the finding.
+
+``/healthz`` is polled on every audit when serving is enabled. Any
+invariant failure stops the soak (configurable), counts
+``soak_invariant_failures`` (gated by the default ``obs diff``), and
+dumps a flight-recorder postmortem. The artifact bundle —
+``soak_report.json`` with the audit history, counters, healthz history
+and findings, plus the flight snapshot — is written **even on
+success**: a clean soak's evidence is as load-bearing as a failed one's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from .. import obs as _obs
+from ..obs import flight as _flight
+from ..ingest import RingConfig, RingIngestor
+from ..resilience.chaos import ChaosError
+from ..resilience.clock import Clock, SystemClock, wall_time
+from .invariants import (
+    check_conservation,
+    check_memory_ratchet,
+    check_ring_bounded,
+    check_watermark_monotone,
+    live_objects,
+    rss_bytes,
+)
+from .source import ChaosMix, SoakSource, SourceConfig
+
+
+class SoakInvariantViolation(RuntimeError):
+    """An audit found a violated invariant; carries the findings."""
+
+    def __init__(self, findings: List[dict]):
+        super().__init__("; ".join(
+            f"{f['invariant']}: {f['detail']}" for f in findings))
+        self.findings = findings
+
+
+@dataclass(frozen=True)
+class SoakConfig:
+    """One soak's shape. Durations/rates are CLOCK units — a ManualClock
+    makes ``duration_s=3600`` a fast deterministic run; a SystemClock
+    makes it a real hour."""
+
+    duration_s: float = 60.0
+    offered_rate: float = 2000.0
+    chunk_records: int = 256
+    audit_every_s: float = 5.0
+    seed: int = 0
+    n_keys: int = 8
+    chaos: ChaosMix = field(default_factory=ChaosMix)
+    ring: RingConfig = field(default_factory=RingConfig)
+    window_ms: int = 1000
+    allowed_lateness: int = 5000
+    max_delay_ms: Optional[float] = 200.0     # accumulator flush deadline
+    slack_ms: int = 0
+    serve_healthz: bool = True
+    checkpoint_every_audits: int = 4          # 0 = no supervisor ckpts
+    max_restarts: int = 3
+    stop_on_failure: bool = True
+    # memory-ratchet knobs (slacks sized so a healthy CI run never
+    # false-positives; the leak-detection path is tested with tight
+    # slacks + an injected leak)
+    mem_grace_audits: int = 3
+    mem_ratchet_audits: int = 5
+    rss_slack_mb: float = 64.0
+    objects_slack: int = 100_000
+
+
+class ConnectorSoakTarget:
+    """Default target: a keyed connector operator behind the ingest ring
+    (the exact production edge ISSUE 7 hardens). Custom pipelines plug
+    in via ``SoakRunner(make_target=...)`` with the same face."""
+
+    def __init__(self, cfg: SoakConfig, obs, clock: Clock):
+        from ..connectors.base import (AscendingWatermarks,
+                                       KeyedScottyWindowOperator)
+        from ..core.aggregates import SumAggregation
+        from ..core.windows import TumblingWindow, WindowMeasure
+        from ..resilience.connectors import PoisonHandler
+        from ..shaper import ShaperConfig
+
+        self.obs = obs
+        self.clock = clock
+        self.op = KeyedScottyWindowOperator(
+            windows=[TumblingWindow(WindowMeasure.Time, cfg.window_ms)],
+            aggregations=[SumAggregation()],
+            allowed_lateness=cfg.allowed_lateness,
+            watermark_policy=AscendingWatermarks(), obs=obs)
+        if cfg.max_delay_ms is not None or cfg.slack_ms:
+            B = cfg.ring.block_size or 1024
+            self.op.attach_shaper(
+                ShaperConfig(slack_ms=cfg.slack_ms,
+                             max_delay_ms=cfg.max_delay_ms,
+                             batch_size=B), clock=clock)
+        # count, never retain: an hours-long soak must not grow memory
+        # proportional to its own output — the harness exists to prove
+        # the opposite (window emission totals live in the obs counters;
+        # exact shed counts in the ring's ``shed``)
+        self.windows_emitted = 0
+        self.poison = PoisonHandler(obs=obs)
+        self.ring = RingIngestor.for_sink(
+            cfg.ring,
+            lambda keys, vals, tss: self._emit(
+                self.op.process_block(keys, vals, tss)),
+            keyed=True, obs=obs, clock=clock)
+
+    def _emit(self, items) -> None:
+        self.windows_emitted += len(items)
+
+    def offer_chunk(self, recs) -> None:
+        for rec in recs:
+            try:
+                key, value, ts = rec
+                ts = int(ts)
+            except (TypeError, ValueError) as e:
+                self.poison.handle(rec, e)
+                continue
+            self.ring.offer_one(value, ts, key)
+
+    def poll(self) -> None:
+        self.ring.poll()
+        self._emit(self.op.poll_shaper())
+
+    def drain(self) -> None:
+        self.ring.drain()
+        self._emit(self.op.drain_shaper())
+
+    @property
+    def held(self) -> int:
+        # staged between the source and the operator: the RING only.
+        # Records in the operator's shaper accumulator already count as
+        # delivered input (the ring handed them over); their own
+        # exactness is the shaper differential suite's contract, their
+        # drain-to-zero at stream end is asserted via shaper_held, and
+        # counting them here too would double an audit's right-hand side
+        # the moment an idle tick moves a partial block along.
+        return self.ring.ring.occupancy
+
+    def audit_terms(self) -> dict:
+        return {"delivered": self.ring.ring.delivered,
+                "shed": self.ring.shed,
+                "held": self.held,
+                "dead_lettered": self.poison.count}
+
+    def watermark(self) -> Optional[int]:
+        return self.op.policy.current_watermark()
+
+    def check(self) -> None:
+        self.ring.check()
+
+    def save(self, path: str) -> None:
+        self.drain()               # staged records count as consumed
+        self.op.save(path)
+
+    def restore(self, path: str) -> None:
+        self.op.restore(path)
+
+
+class SoakRunner:
+    """Run one soak (module docstring). ``report_dir`` receives the
+    artifact bundle; ``make_target(cfg, obs, clock)`` overrides the
+    default connector target."""
+
+    def __init__(self, config: SoakConfig, clock: Optional[Clock] = None,
+                 obs=None, report_dir: Optional[str] = None,
+                 make_target: Optional[Callable] = None,
+                 audit_hook: Optional[Callable] = None):
+        self.config = config
+        self.clock = clock or SystemClock()
+        if obs is None:
+            obs = _obs.Observability(
+                flight=_obs.FlightRecorder(capacity=4096, clock=self.clock),
+                postmortem_dir=report_dir)
+        self.obs = obs
+        self.report_dir = report_dir
+        self.make_target = make_target or ConnectorSoakTarget
+        #: test seam: called after each audit with (runner, audit_row) —
+        #: the leak-injection tests grow state here
+        self.audit_hook = audit_hook
+        self.source = SoakSource(SourceConfig(
+            offered_rate=config.offered_rate,
+            chunk_records=config.chunk_records, n_keys=config.n_keys,
+            seed=config.seed, chaos=config.chaos))
+        self.supervisor = None
+        if report_dir is not None and config.checkpoint_every_audits:
+            from ..resilience.supervisor import Supervisor
+
+            self.supervisor = Supervisor(
+                os.path.join(report_dir, "checkpoints"), clock=self.clock,
+                obs=self.obs, max_restarts=config.max_restarts,
+                seed=config.seed)
+        # lifetime accounting across target generations (restarts)
+        self.seen = 0
+        self.abandoned = 0
+        self._base_terms = {"delivered": 0, "shed": 0, "dead_lettered": 0}
+        self._crashes_fired: set = set()
+        # audit state
+        self.audits: List[dict] = []
+        self.findings: List[dict] = []
+        self.wm_history: List[Optional[int]] = []
+        self.mem_history: List[dict] = []
+        self.healthz_history: List[dict] = []
+        self._server = None
+
+    # -- accounting --------------------------------------------------------
+    def _terms(self, target) -> dict:
+        cur = target.audit_terms()
+        return {
+            "seen": self.seen,
+            "delivered": self._base_terms["delivered"] + cur["delivered"],
+            "shed": self._base_terms["shed"] + cur["shed"],
+            "held": cur["held"],
+            "dead_lettered": (self._base_terms["dead_lettered"]
+                              + cur["dead_lettered"]),
+            "abandoned": self.abandoned,
+        }
+
+    def _retire_target(self, target) -> None:
+        """A generation crashed: bank its delivered/shed/dead totals and
+        count what it had staged but never delivered as ABANDONED (the
+        rewind re-offers those records, so end-to-end nothing is lost —
+        and the audit identity stays exact through the restart)."""
+        cur = target.audit_terms()
+        for k in self._base_terms:
+            self._base_terms[k] += cur[k]
+        self.abandoned += cur["held"]
+
+    # -- audits ------------------------------------------------------------
+    def _audit(self, target, idx: int) -> List[dict]:
+        cfg = self.config
+        target.poll()
+        target.check()
+        terms = self._terms(target)
+        self.wm_history.append(target.watermark())
+        self.mem_history.append({"rss": rss_bytes(),
+                                 "objects": live_objects()})
+        findings: List[dict] = []
+        findings += check_conservation(
+            terms["seen"],
+            terms["delivered"], terms["shed"], terms["held"],
+            terms["dead_lettered"] + terms["abandoned"])
+        findings += check_watermark_monotone(self.wm_history)
+        findings += check_ring_bounded(target.ring.ring.snapshot())
+        findings += check_memory_ratchet(
+            self.mem_history, cfg.mem_grace_audits,
+            cfg.mem_ratchet_audits, cfg.rss_slack_mb * 1e6,
+            cfg.objects_slack)
+        health = self._probe_healthz()
+        row = {"audit": idx, "clock_s": self.clock.now(), "terms": terms,
+               "watermark": self.wm_history[-1],
+               "ring": target.ring.ring.snapshot(),
+               "memory": self.mem_history[-1], "healthz": health,
+               "findings": findings}
+        self.audits.append(row)
+        self.obs.counter(_obs.SOAK_AUDITS).inc()
+        self.obs.flight_event(_flight.SOAK_AUDIT, "audit", float(idx))
+        if findings:
+            self.obs.counter(_obs.SOAK_INVARIANT_FAILURES).inc(
+                len(findings))
+            for f in findings:
+                self.obs.flight_event(_flight.SOAK_INVARIANT,
+                                      f["invariant"])
+            self.findings.extend(findings)
+        if self.audit_hook is not None:
+            self.audit_hook(self, row)
+        return findings
+
+    def _probe_healthz(self) -> Optional[dict]:
+        if self._server is None:
+            return None
+        import urllib.error
+        import urllib.request
+
+        url = f"http://127.0.0.1:{self._server.port}/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                body = json.loads(resp.read().decode())
+                code = resp.status
+        except urllib.error.HTTPError as e:     # 503 = unhealthy verdict
+            code = e.code
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:   # noqa: BLE001 — a non-JSON error page
+                body = {}       # is evidence too, never a soak killer
+        except Exception as e:      # noqa: BLE001 — evidence, not control
+            body, code = {"error": str(e)}, None
+        row = {"clock_s": self.clock.now(), "status": code,
+               "healthy": body.get("healthy")}
+        self.healthz_history.append(row)
+        return row
+
+    # -- the loop ----------------------------------------------------------
+    def run(self) -> dict:
+        cfg = self.config
+        target = self.make_target(cfg, self.obs, self.clock)
+        if cfg.serve_healthz:
+            self._server = self.obs.serve(port=0)
+        t0 = self.clock.now()
+        next_audit = cfg.audit_every_s
+        audit_idx = 0
+        last_ckpt_audit = 0
+        i = 0                       # chunk cursor (the source offset)
+        error: Optional[BaseException] = None
+        try:
+            while self.source.due_s(i) < cfg.duration_s:
+                due = self.source.due_s(i)
+                now = self.clock.now() - t0
+                if now < due:
+                    self.clock.sleep(due - now)
+                try:
+                    recs = self.source.chunk(i)
+                except ChaosError:
+                    self.obs.counter(
+                        _obs.RESILIENCE_SOURCE_RETRIES).inc()
+                    self.obs.flight_event("retry", "soak_source", float(i))
+                    continue        # transient: retry the same chunk
+                try:
+                    self.seen += len(recs)
+                    self.obs.counter(_obs.SOAK_RECORDS_SEEN).inc(
+                        len(recs))
+                    target.offer_chunk(recs)
+                    target.poll()
+                    if i in cfg.chaos.crash_at_chunks \
+                            and i not in self._crashes_fired:
+                        self._crashes_fired.add(i)
+                        raise ChaosError(
+                            f"injected consumer crash after chunk {i}")
+                except ChaosError as e:
+                    target, i = self._recover(target, e, i)
+                    continue
+                i += 1
+                while self.clock.now() - t0 >= next_audit:
+                    audit_idx += 1
+                    findings = self._audit(target, audit_idx)
+                    next_audit += cfg.audit_every_s
+                    if findings and cfg.stop_on_failure:
+                        raise SoakInvariantViolation(findings)
+                    if self.supervisor is not None \
+                            and cfg.checkpoint_every_audits \
+                            and audit_idx - last_ckpt_audit \
+                            >= cfg.checkpoint_every_audits:
+                        last_ckpt_audit = audit_idx
+                        self.supervisor.commit_checkpoint(
+                            audit_idx,
+                            lambda d: target.save(d),  # noqa: B023
+                            offset=i)
+            target.drain()
+            audit_idx += 1
+            findings = self._audit(target, audit_idx)
+            if findings and cfg.stop_on_failure:
+                raise SoakInvariantViolation(findings)
+        except BaseException as e:          # noqa: BLE001 — evidence path
+            error = e
+            self.obs.record_failure(e, kind="soak_invariant"
+                                    if isinstance(e, SoakInvariantViolation)
+                                    else "crash")
+            if not isinstance(e, SoakInvariantViolation):
+                raise
+        finally:
+            if self._server is not None:
+                self._server.close()
+                self._server = None
+            # ONE report document: the on-disk evidence bundle must be
+            # byte-identical to what the caller receives/embeds
+            final = self.report(error)
+            self._write_artifacts(final)
+        return final
+
+    def _recover(self, target, exc, i: int):
+        """Supervised restart: bank the crashed generation's accounting,
+        back off (restart counters + postmortem + give-up), rebuild,
+        restore the last checkpoint and rewind the source cursor to its
+        offset."""
+        self._retire_target(target)
+        if self.supervisor is None:
+            raise exc
+        self.supervisor.handle_failure(exc)     # SupervisorGaveUp raises
+        # restoring a checkpoint legitimately REWINDS the watermark to
+        # the committed offset — monotonicity is a per-generation
+        # invariant, so the audit baseline restarts here (the audit rows
+        # already written keep the pre-crash watermarks as evidence)
+        self.wm_history.clear()
+        fresh = self.make_target(self.config, self.obs, self.clock)
+        ckpt = self.supervisor.latest_checkpoint()
+        offset = 0
+        if ckpt is not None:
+            d, offset = ckpt
+            fresh.restore(d)
+            self.obs.flight_event("restore", os.path.basename(d),
+                                  float(offset))
+        return fresh, offset
+
+    # -- artifacts ---------------------------------------------------------
+    def report(self, error: Optional[BaseException] = None) -> dict:
+        return {
+            "schema": "scotty_tpu.soak_report/1",
+            "created_t": wall_time(),
+            "passed": error is None and not self.findings,
+            "error": None if error is None
+            else {"type": type(error).__name__, "message": str(error)},
+            "config": {
+                "duration_s": self.config.duration_s,
+                "offered_rate": self.config.offered_rate,
+                "chunk_records": self.config.chunk_records,
+                "audit_every_s": self.config.audit_every_s,
+                "seed": self.config.seed,
+                "ring": {"depth": self.config.ring.depth,
+                         "block_size": self.config.ring.block_size,
+                         "policy": self.config.ring.policy},
+                "chaos": {
+                    "late_storm_every": self.config.chaos.late_storm_every,
+                    "poison_pct": self.config.chaos.poison_pct,
+                    "flaky_every": self.config.chaos.flaky_every,
+                    "crash_at_chunks":
+                        list(self.config.chaos.crash_at_chunks)},
+            },
+            "seen": self.seen,
+            "audits": self.audits,
+            "findings": self.findings,
+            "healthz": self.healthz_history,
+            "counters": self.obs.snapshot(),
+        }
+
+    def _write_artifacts(self, report: dict) -> None:
+        """The evidence bundle, written EVEN ON SUCCESS (atomic tmp +
+        replace, the PR 3/4 discipline)."""
+        if self.report_dir is None:
+            return
+        os.makedirs(self.report_dir, exist_ok=True)
+        artifacts = {"soak_report.json": report}
+        if self.obs.flight is not None:
+            artifacts["flight.json"] = self.obs.flight.snapshot()
+        for name, doc in artifacts.items():
+            path = os.path.join(self.report_dir, name)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, default=float)
+            os.replace(tmp, path)
+
+
+def run_soak(config: SoakConfig, clock: Optional[Clock] = None,
+             obs=None, report_dir: Optional[str] = None,
+             make_target: Optional[Callable] = None) -> dict:
+    """One-call face: build a :class:`SoakRunner`, run it, return the
+    report dict (artifacts land in ``report_dir`` either way)."""
+    return SoakRunner(config, clock=clock, obs=obs,
+                      report_dir=report_dir,
+                      make_target=make_target).run()

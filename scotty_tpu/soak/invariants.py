@@ -1,0 +1,117 @@
+"""Soak invariant audits: what must hold at EVERY audit, exactly.
+
+Each audit function returns a list of finding dicts
+(``{"invariant": ..., "detail": ...}``) — empty means the invariant
+held. The harness runs them on the injectable clock's cadence and fails
+the soak on any finding (with a postmortem naming it), so an invariant
+violation can never ride out an hours-long run unnoticed.
+
+* :func:`check_conservation` — the tuple-conservation identity
+  ``seen == delivered + shed + held + dead_lettered``, EXACT (every term
+  is an integer maintained by construction; one missing tuple fails the
+  audit).
+* :func:`check_watermark_monotone` — the watermark history never goes
+  backward.
+* :func:`check_ring_bounded` — ring occupancy (and its high-water) never
+  exceeds the configured ``depth × block_size`` bound.
+* :func:`check_memory_ratchet` — RSS and live-object count must plateau:
+  a window of ``ratchet_audits`` consecutive strictly-increasing
+  readings past the grace window whose total growth exceeds the slack is
+  a leak signature, reported with the trend values.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+from typing import List, Optional
+
+
+def rss_bytes() -> int:
+    """Current resident set size (Linux ``/proc/self/statm``; falls back
+    to the ``ru_maxrss`` HIGH-WATER elsewhere — still a valid ratchet
+    signal, only less prompt to plateau)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        import resource
+        import sys
+
+        ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KILOBYTES on Linux but BYTES on macOS — an
+        # unconditional *1024 would inflate darwin readings 1024x and
+        # trip the ratchet's slack on benign growth
+        return ru if sys.platform == "darwin" else ru * 1024
+
+
+def live_objects() -> int:
+    """Collector-visible live objects after a full collection — the
+    Python-heap side of the ratchet (a container leak grows it even when
+    the allocator hides RSS growth behind freelists)."""
+    gc.collect()
+    return len(gc.get_objects())
+
+
+def check_conservation(seen: int, delivered: int, shed: int, held: int,
+                       dead_lettered: int) -> List[dict]:
+    rhs = delivered + shed + held + dead_lettered
+    if seen == rhs:
+        return []
+    return [{
+        "invariant": "tuple_conservation",
+        "detail": (f"seen={seen} != delivered={delivered} + shed={shed} "
+                   f"+ held={held} + dead_lettered={dead_lettered} "
+                   f"(= {rhs}; {seen - rhs:+d} tuples unaccounted)")}]
+
+
+def check_watermark_monotone(history: List[Optional[int]]) -> List[dict]:
+    prev = None
+    for i, wm in enumerate(history):
+        if wm is None:
+            continue
+        if prev is not None and wm < prev:
+            return [{
+                "invariant": "watermark_monotonicity",
+                "detail": (f"watermark went backward at audit {i}: "
+                           f"{prev} -> {wm}")}]
+        prev = wm
+    return []
+
+
+def check_ring_bounded(snapshot: dict) -> List[dict]:
+    bound = snapshot["depth"] * snapshot["block_size"]
+    findings = []
+    for key in ("occupancy", "highwater"):
+        if snapshot[key] > bound:
+            findings.append({
+                "invariant": "ring_bounded",
+                "detail": (f"ring {key}={snapshot[key]} exceeds the "
+                           f"configured bound depth*block_size={bound}")})
+    return findings
+
+
+def check_memory_ratchet(history: List[dict], grace_audits: int,
+                         ratchet_audits: int, rss_slack_bytes: float,
+                         objects_slack: int) -> List[dict]:
+    """``history`` rows are ``{"rss": bytes, "objects": n}`` per audit.
+    A leak signature = the last ``ratchet_audits`` readings (all past
+    the grace window) strictly increasing with total growth beyond the
+    slack. The returned finding names the trend so the postmortem is
+    directly actionable."""
+    if len(history) < grace_audits + ratchet_audits:
+        return []
+    window = history[-ratchet_audits:]
+    findings = []
+    for key, slack, unit in (("rss", rss_slack_bytes, "bytes"),
+                             ("objects", objects_slack, "objects")):
+        vals = [row[key] for row in window]
+        monotone = all(b > a for a, b in zip(vals, vals[1:]))
+        growth = vals[-1] - vals[0]
+        if monotone and growth > slack:
+            findings.append({
+                "invariant": "memory_ratchet",
+                "detail": (f"{key} ratcheted monotonically over the last "
+                           f"{ratchet_audits} audits: {vals} "
+                           f"(+{growth} {unit} > slack {slack})")})
+    return findings

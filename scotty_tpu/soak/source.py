@@ -1,0 +1,98 @@
+"""Seeded offered-load source for the soak harness.
+
+Everything is a pure function of ``(seed, chunk_index)`` — the chaos
+discipline of :mod:`scotty_tpu.resilience.chaos`: two soaks with the
+same seed offer byte-identical streams, and a restarted run can re-offer
+any chunk exactly (the supervised-recovery path rewinds to a checkpoint
+offset and replays).
+
+Records are keyed ``(key, value, ts)`` tuples: small-integer float32
+values (exact under any aggregation order), event time advancing at the
+offered rate. The chaos mix injects the failure classes the resilience
+layer claims to survive:
+
+* **late storms** — every Nth chunk's timestamps reach back up to
+  ``late_reach_ms`` behind the stream head (annex/shaper pressure);
+* **poison** — a seeded fraction of records are malformed (a 2-tuple /
+  a non-integral ts) and must take the dead-letter path;
+* **flaky** — fetching every Nth chunk raises
+  :class:`~scotty_tpu.resilience.chaos.ChaosError` ONCE (the transient
+  contract: a retry succeeds);
+* **crash** — the consumer-side one-shot crash hook (the supervised
+  restart path), fired by the harness after the named chunks land.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+import numpy as np
+
+from ..resilience.chaos import ChaosError, rng_of
+
+
+@dataclass(frozen=True)
+class ChaosMix:
+    """Seeded fault mix for a soak (all off by default — a clean soak)."""
+
+    late_storm_every: int = 0      # every Nth chunk is a late storm
+    late_reach_ms: int = 2000      # how far a storm reaches back
+    poison_pct: float = 0.0        # fraction of records made malformed
+    flaky_every: int = 0           # every Nth chunk fetch fails once
+    crash_at_chunks: Tuple[int, ...] = ()   # consumer crashes (one-shot)
+
+
+@dataclass(frozen=True)
+class SourceConfig:
+    offered_rate: float = 2000.0   # records per clock-second
+    chunk_records: int = 256
+    n_keys: int = 8
+    seed: int = 0
+    value_hi: int = 256
+    chaos: ChaosMix = field(default_factory=ChaosMix)
+
+
+class SoakSource:
+    """``chunk(i)`` → the i-th record chunk (pure in ``(seed, i)``, minus
+    the one-shot flaky set). ``due_s(i)`` → the clock second chunk i is
+    due at the offered rate."""
+
+    def __init__(self, config: SourceConfig):
+        self.config = config
+        self._flaky_fired: set = set()
+
+    def due_s(self, i: int) -> float:
+        c = self.config
+        return i * c.chunk_records / c.offered_rate
+
+    def chunk(self, i: int) -> List[Tuple]:
+        c = self.config
+        mix = c.chaos
+        if mix.flaky_every and i > 0 and i % mix.flaky_every == 0 \
+                and i not in self._flaky_fired:
+            self._flaky_fired.add(i)
+            raise ChaosError(f"injected transient source failure at "
+                             f"chunk {i}")
+        rng = rng_of(c.seed + 0x50AC + i)
+        n = c.chunk_records
+        base_ms = int(self.due_s(i) * 1000)
+        span_ms = max(1, int(n / c.offered_rate * 1000))
+        ts = base_ms + np.sort(rng.integers(0, span_ms, size=n))
+        if mix.late_storm_every and i > 0 \
+                and i % mix.late_storm_every == 0:
+            # the whole chunk reaches back behind the stream head
+            ts = np.maximum(ts - int(rng.integers(1, mix.late_reach_ms + 1)),
+                            0)
+        keys = rng.integers(0, c.n_keys, size=n)
+        vals = rng.integers(0, c.value_hi, size=n)
+        recs: List[Tuple] = [
+            (f"k{int(k)}", float(v), int(t))
+            for k, v, t in zip(keys, vals, ts)]
+        if mix.poison_pct > 0:
+            n_bad = max(1, int(n * mix.poison_pct))
+            for j in rng.choice(n, size=n_bad, replace=False):
+                k, v, t = recs[j]
+                # alternate malformations: wrong arity / non-integral ts
+                recs[j] = (k, v) if int(j) % 2 == 0 else (k, v, "not-a-ts")
+        return recs

@@ -1,0 +1,221 @@
+"""Cross-connector tuple-conservation property suite (ISSUE 7 satellite).
+
+For every run loop × chaos class × seed: drive the loop through the
+ingest ring with an Observability attached and assert the EXACT
+conservation identity over the contract counters —
+
+    seen == ingest_ring_delivered + ingest_ring_shed
+            + held(=0 after drain) + resilience_poison_records
+
+plus the internal consistency ``ingest_ring_offered == delivered + shed
+- (records never offered because they were poison)`` and the operator-
+side ``ingest_tuples == delivered``. One missing tuple anywhere fails
+the identity — this is the suite that turns "no silent drops" from a
+claim into a property.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from scotty_tpu.connectors.base import (
+    AscendingWatermarks,
+    KeyedScottyWindowOperator,
+)
+from scotty_tpu.connectors.iterable import run_global, run_keyed
+from scotty_tpu.core.aggregates import SumAggregation
+from scotty_tpu.core.windows import TumblingWindow, WindowMeasure
+from scotty_tpu.ingest import RingConfig
+from scotty_tpu.obs import Observability
+from scotty_tpu.resilience import chaos
+from scotty_tpu.resilience.connectors import retrying_source
+from scotty_tpu.resilience.clock import ManualClock
+
+Time = WindowMeasure.Time
+
+SEEDS = [0, 1]
+CHAOS = ["burst", "late_storm", "flaky", "poison"]
+
+
+def _records(kind: str, seed: int, n: int = 240, keyed: bool = True):
+    """A record stream of the given chaos class. Returns
+    ``(records, n_poison)`` — poison records are malformed on purpose."""
+    rng = chaos.rng_of(seed)
+    if kind == "burst":
+        # disorder bounded WITHIN allowed_lateness (4000) — the stream
+        # contract every loop already enforces; unrepairable records are
+        # the drop counters' business, not conservation's
+        base = np.arange(n) * 30
+        ts = np.maximum(base + rng.integers(-2000, 2000, n), 0)
+        vals = rng.integers(0, 100, n).astype(np.float32)
+    elif kind == "late_storm":
+        head_v, head_t = chaos.burst(seed, n // 2, 0, 8_000)
+        late_v, late_t = chaos.late_storm(seed + 1, n - n // 2,
+                                          now_ts=8_000,
+                                          max_lateness=4_000)
+        vals = np.concatenate([head_v, late_v])
+        ts = np.concatenate([head_t, late_t])
+    else:
+        vals, ts = chaos.burst(seed, n, 0, 8_000)
+    keys = rng.integers(0, 3, vals.size)
+    if keyed:
+        recs = [(f"k{int(k)}", float(v), int(t))
+                for k, v, t in zip(keys, vals, ts)]
+    else:
+        recs = [(float(v), int(t)) for v, t in zip(vals, ts)]
+    n_poison = 0
+    if kind == "poison":
+        idx = sorted(rng.choice(n, size=max(1, n // 20),
+                                replace=False).tolist())
+        for i in idx:
+            recs[i] = recs[i][:-1]       # wrong arity → dead-letter
+        n_poison = len(idx)
+    return recs, n_poison
+
+
+def _mk_keyed(obs):
+    return KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 1000)],
+        aggregations=[SumAggregation()], allowed_lateness=4000,
+        watermark_policy=AscendingWatermarks(), obs=obs)
+
+
+def _assert_identity(obs, n_seen: int, n_poison: int,
+                     expect_shed: int = 0):
+    snap = obs.registry.snapshot()
+    offered = int(snap.get("ingest_ring_offered", 0))
+    delivered = int(snap.get("ingest_ring_delivered", 0))
+    shed = int(snap.get("ingest_ring_shed", 0))
+    held = int(snap.get("ingest_ring_occupancy", 0))
+    dead = int(snap.get("resilience_poison_records", 0))
+    # the ISSUE 7 identity, exact: every record the loop pulled is
+    # delivered, shed, still held (0 after drain) or dead-lettered
+    assert n_seen == delivered + shed + held + dead, snap
+    assert held == 0                     # drained
+    assert dead == n_poison
+    # ring-internal consistency: accepted records are delivered or held
+    # (shed records never entered the ring — they were refused at the
+    # boundary and counted there)
+    assert offered == delivered + held
+    if expect_shed == 0:
+        assert shed == 0
+    else:
+        assert shed == expect_shed
+    # operator-side agreement: every delivered record was ingested
+    assert int(snap.get("ingest_tuples", 0)) == delivered
+    return snap
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("kind", CHAOS)
+def test_iterable_keyed_conservation(kind, seed):
+    recs, n_poison = _records(kind, seed)
+    obs = Observability()
+    op = _mk_keyed(obs)
+    src = iter(recs)
+    if kind == "flaky":
+        flaky = chaos.FlakySource(recs, fail_at={40, 111})
+        src = retrying_source(flaky, clock=ManualClock(), obs=obs)
+    list(run_keyed(src, op,
+                   ingest_ring=RingConfig(depth=4, block_size=16)))
+    _assert_identity(obs, len(recs), n_poison)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("kind", CHAOS)
+def test_iterable_global_conservation(kind, seed):
+    from scotty_tpu.connectors.base import GlobalScottyWindowOperator
+
+    recs, n_poison = _records(kind, seed, keyed=False)
+    obs = Observability()
+    op = GlobalScottyWindowOperator(
+        windows=[TumblingWindow(Time, 1000)],
+        aggregations=[SumAggregation()], allowed_lateness=4000,
+        watermark_policy=AscendingWatermarks(), obs=obs)
+    src = iter(recs)
+    if kind == "flaky":
+        flaky = chaos.FlakySource(recs, fail_at={25})
+        src = retrying_source(flaky, clock=ManualClock(), obs=obs)
+    # poison for the GLOBAL loop: a 1-tuple fails (v, ts) destructure
+    list(run_global(src, op,
+                    ingest_ring=RingConfig(depth=4, block_size=16)))
+    _assert_identity(obs, len(recs), n_poison)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("kind", ["burst", "late_storm", "flaky",
+                                  "poison"])
+def test_kafka_conservation(kind, seed):
+    from scotty_tpu.connectors.kafka import KafkaScottyWindowOperator
+
+    records = chaos.make_records(seed=seed, n=200, keys=3, period_ms=40)
+    n_poison = 0
+    if kind == "poison":
+        records, idx = chaos.corrupt_records(records, seed=seed + 5,
+                                             pct=0.05)
+        n_poison = len(idx)
+    elif kind == "late_storm":
+        # reorder timestamps: a late half behind the head
+        half = len(records) // 2
+        for r in records[half:]:
+            r.timestamp = max(0, r.timestamp - 3000)
+    obs = Observability()
+    op = _mk_keyed(obs)
+    src = records
+    if kind == "flaky":
+        flaky = chaos.FlakySource(records, fail_at={60})
+        src = retrying_source(flaky, clock=ManualClock(), obs=obs)
+    KafkaScottyWindowOperator(operator=op).run(
+        src, lambda item: None,
+        ingest_ring=RingConfig(depth=4, block_size=16))
+    _assert_identity(obs, len(records), n_poison)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("kind", ["burst", "late_storm"])
+def test_asyncio_conservation(kind, seed):
+    from scotty_tpu.connectors.asyncio_connector import run_keyed_async
+
+    recs, n_poison = _records(kind, seed)
+    obs = Observability()
+    op = _mk_keyed(obs)
+
+    async def source():
+        for r in recs:
+            yield r
+
+    asyncio.run(run_keyed_async(
+        source(), op, lambda item: None,
+        ingest_ring=RingConfig(depth=4, block_size=16)))
+    _assert_identity(obs, len(recs), n_poison)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_shed_path_conservation(seed):
+    """The shed arm of the identity: policy='shed' with manual pumping
+    sheds everything past ring capacity, and the identity must hold
+    with the exact shed count on the counters."""
+    recs, _ = _records("burst", seed)
+    obs = Observability()
+    op = _mk_keyed(obs)
+    shed_seen = []
+    list(run_keyed(iter(recs), op,
+                   ingest_ring=RingConfig(depth=2, block_size=8,
+                                          policy="shed", pump_at=0),
+                   shed_callback=lambda v, t, k: shed_seen.extend(t)))
+    snap = _assert_identity(obs, len(recs), 0,
+                            expect_shed=len(recs) - 16)
+    assert len(shed_seen) == int(snap["ingest_ring_shed"])
+
+
+def test_dead_letter_path_receives_the_poison_records():
+    recs, n_poison = _records("poison", 3)
+    obs = Observability()
+    op = _mk_keyed(obs)
+    letters = []
+    list(run_keyed(iter(recs), op,
+                   ingest_ring=RingConfig(depth=4, block_size=16),
+                   dead_letter=lambda r, e: letters.append(r)))
+    assert len(letters) == n_poison
+    _assert_identity(obs, len(recs), n_poison)

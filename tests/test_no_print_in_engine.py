@@ -1,8 +1,11 @@
 """Tier-1 lint: the engine core stays silent (ISSUE 1 satellite; extended
-to connectors/ and bench/ in ISSUE 2, serving/ in ISSUE 6), nothing
-sleeps on the wall clock outside the injectable-clock module (ISSUE 3
-satellite; serving/ is covered by the all-of-scotty_tpu sweep), and the
-obs layer never reads the wall clock directly (ISSUE 4 satellite).
+to connectors/ and bench/ in ISSUE 2, serving/ in ISSUE 6, ingest/ and
+soak/ in ISSUE 7), nothing sleeps on the wall clock outside the
+injectable-clock module (ISSUE 3 satellite; serving/ingest/soak are
+covered by the all-of-scotty_tpu sweep), and the obs/ingest/soak layers
+never read the wall clock directly (ISSUE 4 satellite, extended in
+ISSUE 7 — a soak that timed its audits on a bare ``time.time()`` could
+never run deterministically on a ManualClock).
 
 The reference's engine never logs — its only output was the benchmark-side
 throughput logger (SURVEY.md §5). The port preserves that discipline: all
@@ -26,7 +29,11 @@ import pathlib
 import scotty_tpu
 
 PKG_ROOT = pathlib.Path(scotty_tpu.__file__).parent
-SILENT_DIRS = ("engine", "core", "connectors", "bench", "serving")
+SILENT_DIRS = ("engine", "core", "connectors", "bench", "serving",
+               "ingest", "soak")
+#: packages whose wall-clock reads must route through resilience.clock
+#: (wall_time / the injectable Clock); time.perf_counter stays allowed
+WALLTIME_DIRS = ("obs", "ingest", "soak")
 #: the single module allowed to call time.sleep (SystemClock lives there)
 SLEEP_EXEMPT = PKG_ROOT / "resilience" / "clock.py"
 
@@ -102,8 +109,9 @@ def _walltime_calls(path: pathlib.Path):
 
 
 def test_no_bare_walltime_in_obs():
-    """ISSUE 4 satellite, mirroring the no-bare-sleep rule: flight
-    recorder / postmortem / export timestamps in ``scotty_tpu/obs/`` must
+    """ISSUE 4 satellite, mirroring the no-bare-sleep rule (extended over
+    ``ingest/`` and ``soak/`` in ISSUE 7): flight recorder / postmortem /
+    export timestamps — and every soak pace/audit/watchdog read — must
     come from the injectable clock (``resilience.clock.Clock`` for
     monotonic event time, ``resilience.clock.wall_time`` for export
     rows) — never a bare ``time.time()``/``time.monotonic()`` — so chaos
@@ -111,9 +119,10 @@ def test_no_bare_walltime_in_obs():
     bundle timelines stay deterministic. ``time.perf_counter`` (relative
     span durations) stays allowed."""
     offenders = []
-    for path in sorted((PKG_ROOT / "obs").rglob("*.py")):
-        offenders.extend(_walltime_calls(path))
+    for d in WALLTIME_DIRS:
+        for path in sorted((PKG_ROOT / d).rglob("*.py")):
+            offenders.extend(_walltime_calls(path))
     assert not offenders, (
-        "bare time.time()/time.monotonic() in scotty_tpu/obs/ — route "
-        "timestamps through scotty_tpu.resilience.clock (injectable "
-        "Clock / wall_time): " + ", ".join(offenders))
+        "bare time.time()/time.monotonic() in scotty_tpu/{obs,ingest,"
+        "soak}/ — route timestamps through scotty_tpu.resilience.clock "
+        "(injectable Clock / wall_time): " + ", ".join(offenders))
