@@ -188,6 +188,13 @@ class BenchmarkConfig:
     #: Soak cell offered load (records per second; --offered-rate
     #: overrides); 0 = the 50 000/s default
     offered_rate: float = 0.0
+    #: delivery guarantee for connector-backed cells (ISSUE 8; the
+    #: runner's --delivery flag overrides): "at_least_once" (the
+    #: benchmarked default — no ledger) or "exactly_once" (a
+    #: TransactionalSink sequences every emission and its epoch ledger
+    #: commits with each supervisor checkpoint; the cell records the
+    #: ledger's overhead alongside)
+    delivery: str = "at_least_once"
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -221,6 +228,7 @@ class BenchmarkConfig:
             ring_block_size=raw.get("ringBlockSize", 0),
             soak_seconds=raw.get("soakSeconds", 0.0),
             offered_rate=raw.get("offeredRate", 0.0),
+            delivery=raw.get("delivery", "at_least_once"),
         )
 
 

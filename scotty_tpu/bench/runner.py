@@ -937,6 +937,47 @@ def run_ingest_external_cell(cfg: BenchmarkConfig, window_spec: str,
     return res
 
 
+def measure_delivery_overhead(seed: int = 0, n_records: int = 3000,
+                              pairs: int = 9) -> float:
+    """Interleaved A/B of the exactly-once ledger on the iterable keyed
+    loop (ISSUE 8 acceptance: <= 2% median on CPU): per-pair bare-loop
+    vs TransactionalSink(exactly_once) wall time, returns the median
+    overhead in PERCENT (negative = within noise)."""
+    from ..connectors.base import (AscendingWatermarks,
+                                   KeyedScottyWindowOperator)
+    from ..connectors.iterable import run_keyed
+    from ..core.aggregates import SumAggregation
+    from ..core.windows import TumblingWindow, WindowMeasure
+    from ..delivery import EXACTLY_ONCE, TransactionalSink
+
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, 8, size=n_records)
+    vals = rng.standard_normal(n_records)
+    recs = [(f"k{keys[i]}", float(vals[i]), i * 10)
+            for i in range(n_records)]
+
+    def once(with_sink: bool) -> float:
+        op = KeyedScottyWindowOperator(
+            windows=[TumblingWindow(WindowMeasure.Time, 100)],
+            aggregations=[SumAggregation()],
+            watermark_policy=AscendingWatermarks())
+        sink = TransactionalSink(mode=EXACTLY_ONCE) if with_sink else None
+        t0 = time.perf_counter()
+        for _ in run_keyed(iter(recs), op, sink=sink):
+            pass
+        return time.perf_counter() - t0
+
+    once(False), once(True)                 # warm both paths
+    a_times, b_times = [], []
+    for _ in range(pairs):
+        a_times.append(once(False))
+        b_times.append(once(True))
+    a_times.sort()
+    b_times.sort()
+    return 100.0 * (b_times[len(b_times) // 2]
+                    / a_times[len(a_times) // 2] - 1.0)
+
+
 def run_soak_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                   obs: Optional[_obs.Observability] = None) -> BenchResult:
     """Soak cell (ISSUE 7): run the endurance harness at a configured
@@ -968,7 +1009,8 @@ def run_soak_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                        flaky_every=37),
         ring=RingConfig(depth=cfg.ring_depth or 8,
                         block_size=cfg.ring_block_size or 1024),
-        window_ms=window_ms, allowed_lateness=cfg.max_lateness)
+        window_ms=window_ms, allowed_lateness=cfg.max_lateness,
+        delivery=cfg.delivery)
     if obs is not None and obs.flight is None:
         obs.flight = _obs.FlightRecorder(capacity=4096)
     runner = SoakRunner(scfg, obs=obs)
@@ -995,6 +1037,14 @@ def run_soak_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     res.soak_healthz_unhealthy = sum(
         1 for h in report["healthz"] if h.get("status") != 200)
     res.soak_report = report
+    # delivery guarantee (ISSUE 8): the mode, the sink's ledger
+    # snapshot, and — in exactly_once mode — the measured interleaved
+    # A/B cost of the ledger on the iterable run loop
+    res.delivery_mode = cfg.delivery
+    if report.get("delivery") is not None:
+        res.delivery_snapshot = report["delivery"]
+        res.delivery_overhead_pct_median = \
+            measure_delivery_overhead(seed=cfg.seed)
     finalize_observability(res, obs, [], res.n_windows_emitted,
                            n_tuples=report["seen"])
     return res
@@ -1492,7 +1542,9 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                               "tpu_floor_note", "soak_passed",
                               "soak_seen", "soak_audits_n",
                               "soak_findings", "soak_last_terms",
-                              "soak_healthz_unhealthy", "soak_report"):
+                              "soak_healthz_unhealthy", "soak_report",
+                              "delivery_mode", "delivery_snapshot",
+                              "delivery_overhead_pct_median"):
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)
@@ -1590,6 +1642,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="R",
                     help="override every config's offeredRate (Soak "
                          "cell offered load, records/second)")
+    ap.add_argument("--delivery", default=None, metavar="MODE",
+                    choices=("at_least_once", "exactly_once"),
+                    help="override every config's delivery guarantee "
+                         "for connector-backed cells (scotty_tpu."
+                         "delivery, ISSUE 8): 'at_least_once' (the "
+                         "benchmarked default, no ledger) or "
+                         "'exactly_once' (epoch-ledger TransactionalSink "
+                         "with its measured A/B overhead recorded in "
+                         "the cell row)")
     args = ap.parse_args(argv)
 
     paths = args.configs
@@ -1607,6 +1668,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             cfg.soak_seconds = args.soak_seconds
         if args.offered_rate is not None:
             cfg.offered_rate = args.offered_rate
+        if args.delivery is not None:
+            cfg.delivery = args.delivery
         _stdout(f"== {cfg.name} ({path})")
         baseline_snap = None
         if args.gate:

@@ -61,6 +61,7 @@ async def run_keyed_async(
         idle_poll_s: Optional[float] = None,
         ingest_ring=None,
         shed_callback: Optional[Callable] = None,
+        sink=None,
 ) -> None:
     """Consume (key, value, ts) from an async iterator; call ``emit`` for
     every (key, AggregateWindow) result. ``emit`` may be sync or async.
@@ -95,7 +96,12 @@ async def run_keyed_async(
     fail on full, exact ``ingest_ring_*`` accounting, block-at-a-time
     vectorized replay; ``shed_callback(vals, ts, keys)`` sees records a
     'shed' policy dropped. Pair it with :func:`bounded_queue` so the
-    producer side is bounded too."""
+    producer side is bounded too.
+
+    ``sink`` (a :class:`scotty_tpu.delivery.TransactionalSink`, ISSUE 8)
+    gates every ``emit`` call through the exactly-once output boundary:
+    replayed duplicates after a supervised restore are suppressed
+    instead of delivered."""
     from .iterable import (_apply_control, _control_cursor, _counted,
                            _make_ring, _pop, _pop_counted,
                            _ring_polls_deadline)
@@ -118,6 +124,8 @@ async def run_keyed_async(
     n_seen = 0
 
     async def _emit(item) -> None:
+        if sink is not None and not sink.emit(item):
+            return                           # suppressed replay duplicate
         r = emit(item)
         if asyncio.iscoroutine(r) or isinstance(r, Awaitable):
             await r

@@ -391,10 +391,18 @@ class KeyedScottyWindowOperator:
             drained = self.drain_shaper()   # pops + REBINDS the list
             self._shaper_results.extend(drained)
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "keyed_connector.pkl"), "wb") as f:
-            pickle.dump({"host_ops": self._host_ops, "policy": self.policy,
-                         "allowed_lateness": self.allowed_lateness,
-                         "shaper_results": list(self._shaper_results)}, f)
+        # through fsio, like every other committed byte: the manifest
+        # records the INTENT digest, so a silent short write of the
+        # pickle can never be blessed at finalize (and the crash-point
+        # fuzzer enumerates this write's fault variants)
+        from ..utils import fsio
+
+        fsio.write_bytes(
+            os.path.join(path, "keyed_connector.pkl"),
+            pickle.dumps({"host_ops": self._host_ops,
+                          "policy": self.policy,
+                          "allowed_lateness": self.allowed_lateness,
+                          "shaper_results": list(self._shaper_results)}))
 
     def restore(self, path: str) -> None:
         """Restore a :meth:`save` snapshot into a freshly-configured

@@ -106,7 +106,8 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
               obs=None, dead_letter=None,
               poison_limit: int | None = None,
               shaper=None, control=None,
-              ingest_ring=None, shed_callback=None) -> Iterator[Tuple]:
+              ingest_ring=None, shed_callback=None,
+              sink=None) -> Iterator[Tuple]:
     """Drive a keyed operator from an iterable of (key, value, ts); yields
     (key, AggregateWindow) results as watermarks fire.
 
@@ -130,9 +131,23 @@ def run_keyed(source: Iterable[Tuple], operator: KeyedScottyWindowOperator,
     stages records through the bounded backpressure ring (module
     docstring); ``shed_callback(vals, ts, keys)`` sees records a 'shed'
     policy dropped.
+
+    ``sink`` (a :class:`scotty_tpu.delivery.TransactionalSink`, ISSUE 8)
+    is the exactly-once output boundary: every yielded result first
+    passes ``sink.emit`` — in ``exactly_once`` mode, replayed duplicates
+    after a supervised restore are suppressed instead of yielded.
     """
     from ..resilience.connectors import PoisonHandler
 
+    if sink is not None:
+        for item in run_keyed(source, operator, obs=obs,
+                              dead_letter=dead_letter,
+                              poison_limit=poison_limit, shaper=shaper,
+                              control=control, ingest_ring=ingest_ring,
+                              shed_callback=shed_callback):
+            if sink.emit(item):
+                yield item
+        return
     if shaper is not None:
         operator.attach_shaper(shaper)
     own_obs = obs if obs is not None and obs is not operator.obs else None
@@ -219,14 +234,25 @@ def run_global(source: Iterable[Tuple], operator: GlobalScottyWindowOperator,
                obs=None, dead_letter=None,
                poison_limit: int | None = None,
                shaper=None, control=None,
-               ingest_ring=None, shed_callback=None) -> Iterator:
+               ingest_ring=None, shed_callback=None,
+               sink=None) -> Iterator:
     """Drive a global operator from an iterable of (value, ts) — same
     poison-record contract as :func:`run_keyed`, same optional
     ``shaper`` front-end, same ``control`` register/cancel path, same
     ``ingest_ring`` bounded staging + :data:`IDLE_TICK` idle ticks
-    (``None`` remains a poison record here too)."""
+    (``None`` remains a poison record here too), same ``sink``
+    transactional output boundary (ISSUE 8)."""
     from ..resilience.connectors import PoisonHandler
 
+    if sink is not None:
+        for item in run_global(source, operator, obs=obs,
+                               dead_letter=dead_letter,
+                               poison_limit=poison_limit, shaper=shaper,
+                               control=control, ingest_ring=ingest_ring,
+                               shed_callback=shed_callback):
+            if sink.emit(item):
+                yield item
+        return
     if shaper is not None:
         operator.attach_shaper(shaper)
     own_obs = obs if obs is not None and obs is not operator.obs else None

@@ -136,7 +136,8 @@ class KafkaScottyWindowOperator:
             control=None,
             idle_poll_ms: Optional[int] = None,
             ingest_ring=None,
-            shed_callback: Optional[Callable] = None) -> int:
+            shed_callback: Optional[Callable] = None,
+            sink=None) -> int:
         """``consumer``: any iterable of Kafka-like records (KafkaConsumer
         instances are iterables of ConsumerRecord). Returns records
         consumed (poison records count — they were consumed, then
@@ -185,11 +186,22 @@ class KafkaScottyWindowOperator:
         block/shed/fail on full, exact ``ingest_ring_*`` accounting,
         block-at-a-time vectorized replay; ``shed_callback(vals, ts,
         keys)`` sees records a 'shed' policy dropped.
+
+        ``sink`` (a :class:`scotty_tpu.delivery.TransactionalSink`,
+        ISSUE 8) gates every ``on_result`` call through the exactly-once
+        output boundary: replayed duplicates after a supervised restore
+        are suppressed instead of delivered.
         """
         from ..resilience.connectors import PoisonHandler, watchdog_source
         from .iterable import (IDLE_TICK, _apply_control, _control_cursor,
                                _make_ring, _pop, _ring_polls_deadline)
 
+        if sink is not None:
+            downstream = on_result
+
+            def on_result(item, _down=downstream, _sink=sink):
+                if _sink.emit(item):
+                    _down(item)
         if shaper is not None:
             self.operator.attach_shaper(shaper, clock=clock)
         poison = PoisonHandler(dead_letter=dead_letter, limit=poison_limit,

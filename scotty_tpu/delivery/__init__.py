@@ -1,0 +1,25 @@
+"""Exactly-once delivery (ISSUE 8 tentpole): epoch ledger +
+transactional sinks + supervised connector loops.
+
+See :mod:`.sink` for the delivery contract, :mod:`.ledger` for the
+atomic checkpoint transaction, and :mod:`.runner` for the supervised
+run-loop face. ``at_least_once`` stays the default everywhere; pass a
+:class:`TransactionalSink` in ``exactly_once`` mode to the connector
+run loops / the Supervisor / the soak harness to arm suppression.
+"""
+
+from .ledger import LEDGER_NAME, EpochLedger
+from .runner import (
+    asyncio_segment,
+    iterable_segment,
+    kafka_segment,
+    run_supervised,
+)
+from .sink import AT_LEAST_ONCE, EXACTLY_ONCE, TransactionalSink
+
+__all__ = [
+    "AT_LEAST_ONCE", "EXACTLY_ONCE", "TransactionalSink",
+    "EpochLedger", "LEDGER_NAME",
+    "run_supervised", "iterable_segment", "kafka_segment",
+    "asyncio_segment",
+]

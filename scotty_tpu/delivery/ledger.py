@@ -1,0 +1,66 @@
+"""The epoch ledger: the sink-side half of a checkpoint transaction.
+
+Emissions between supervisor checkpoints form an **epoch**. The ledger
+is the tiny durable record — ``(epoch, committed_seq)`` — that rides
+*inside* the checkpoint bundle (``ledger.json``, written through
+:mod:`scotty_tpu.utils.fsio` so the bundle manifest covers it) and
+therefore commits **atomically with** the engine state and the source
+offset at the supervisor's single ``os.replace`` pointer flip: state,
+offset and delivered-seq can never tear apart. A restore that picks any
+lineage generation gets that generation's ledger with it, so the
+:class:`~scotty_tpu.delivery.sink.TransactionalSink` always rewinds its
+sequence numbering to exactly the head the restored state corresponds
+to.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+#: the ledger file inside a checkpoint bundle
+LEDGER_NAME = "ledger.json"
+LEDGER_SCHEMA = "scotty_tpu.delivery_ledger/1"
+
+
+@dataclass
+class EpochLedger:
+    """``epoch`` — committed checkpoints so far (the epoch emissions
+    after this checkpoint carry); ``committed_seq`` — the highest
+    emission sequence number covered by the checkpoint (-1 before the
+    first emission)."""
+
+    epoch: int = 0
+    committed_seq: int = -1
+
+    def save(self, dir_path: str) -> None:
+        """Write ``ledger.json`` into an open (pre-commit) checkpoint
+        directory via the fault-injectable fsio layer — one more file in
+        the bundle the manifest digests; the atomicity comes from the
+        bundle's own commit, not from this write."""
+        from ..utils import fsio
+
+        doc = {"schema": LEDGER_SCHEMA, "epoch": int(self.epoch),
+               "committed_seq": int(self.committed_seq)}
+        fsio.write_bytes(os.path.join(dir_path, LEDGER_NAME),
+                         json.dumps(doc).encode())
+
+    @staticmethod
+    def load(dir_path: str) -> Optional["EpochLedger"]:
+        """The ledger committed with a checkpoint, or None for bundles
+        from before the delivery layer (or non-sink runs) — the caller
+        then starts from genesis."""
+        path = os.path.join(dir_path, LEDGER_NAME)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            doc = json.load(f)
+        if not str(doc.get("schema", "")).startswith(
+                "scotty_tpu.delivery_ledger/"):
+            raise ValueError(
+                f"{path}: not a delivery ledger "
+                f"(schema={doc.get('schema')!r})")
+        return EpochLedger(epoch=int(doc["epoch"]),
+                           committed_seq=int(doc["committed_seq"]))

@@ -1,0 +1,162 @@
+"""Transactional sinks: the exactly-once output boundary.
+
+The reference Scotty inherits exactly-once from its host engines (Flink
+barrier snapshots + two-phase-commit sinks); scotty_tpu is its own
+engine, and before this layer a supervised restore replayed every
+emission since the last checkpoint straight into the sink — silent
+duplicates on every recovery. :class:`TransactionalSink` closes that
+gap with the epoch-ledger discipline:
+
+* every emission is sequence-numbered ``(epoch, seq)`` — ``seq`` is a
+  global monotonic counter, ``epoch`` the number of committed
+  checkpoints at emission time; both are pure functions of stream
+  position, so a deterministic replay regenerates identical tags;
+* the ledger head commits **atomically with** the supervisor checkpoint
+  (``ledger.json`` inside the bundle, one ``os.replace`` commit point —
+  see :mod:`.ledger`);
+* after a supervised restore the sink rewinds ``seq`` to the restored
+  ledger's head; replayed emissions with ``seq <= delivered`` are
+  suppressed exactly (counted ``delivery_duplicates_suppressed``,
+  flight-recorded), so the downstream consumer observes each window
+  result exactly once across any crash/restart sequence — including a
+  lineage fallback to an older checkpoint, which just replays (and
+  suppresses) more.
+
+``at_least_once`` stays the default fast path: no suppression, no
+bookkeeping beyond the counters, and nothing in the jitted engine is
+touched either way (the sink is a pure host-side boundary).
+
+The suppression horizon is the **in-process delivered high-water**: the
+sink object outlives supervised restarts (it belongs to the driver, not
+the crashed target generation). Across a full *process* restart the
+horizon degrades to the restored ledger's committed head — emissions
+delivered after the last checkpoint are then re-delivered, the honest
+at-least-once limit of any sink without a two-phase-commit downstream
+(document the contract, don't pretend past it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .. import obs as _obs
+from ..obs import flight as _flight
+from .ledger import EpochLedger
+
+#: delivery guarantee modes
+AT_LEAST_ONCE = "at_least_once"
+EXACTLY_ONCE = "exactly_once"
+_MODES = (AT_LEAST_ONCE, EXACTLY_ONCE)
+
+
+class TransactionalSink:
+    """Wrap a downstream consumer with (epoch, seq) sequencing and
+    replay suppression (module docstring).
+
+    ``deliver(item, epoch, seq)`` is the downstream consumer; when None,
+    :meth:`emit` just returns the deliver/suppress verdict and the
+    caller (a run loop) yields the item itself — both faces are used by
+    the connector run loops and the soak harness.
+    """
+
+    def __init__(self, deliver: Optional[Callable] = None,
+                 mode: str = AT_LEAST_ONCE, obs=None):
+        if mode not in _MODES:
+            raise ValueError(
+                f"delivery mode must be one of {_MODES}, got {mode!r}")
+        self.deliver = deliver
+        self.mode = mode
+        self.obs = obs
+        self.epoch = 0                 # committed checkpoints so far
+        self.next_seq = 0              # seq the next emission gets
+        self.delivered = -1            # high-water actually handed down
+        self.emitted = 0               # deliveries (post-suppression)
+        self.suppressed = 0            # exact duplicate count
+
+    # -- the emission path -------------------------------------------------
+    def emit(self, item) -> bool:
+        """Sequence one emission; returns True when it was (or should
+        be) delivered downstream, False when it was suppressed as a
+        replayed duplicate."""
+        seq = self.next_seq
+        self.next_seq = seq + 1
+        if self.mode == EXACTLY_ONCE and seq <= self.delivered:
+            self.suppressed += 1
+            if self.obs is not None:
+                self.obs.counter(
+                    _obs.DELIVERY_DUPLICATES_SUPPRESSED).inc()
+                self.obs.flight_event(_flight.DUPLICATE_SUPPRESSED,
+                                      "sink", float(seq))
+            return False
+        if self.obs is not None:
+            # the per-emission flight event IS an enumerable crash site.
+            # It MUST fire BEFORE the downstream handoff and before the
+            # delivered high-water advances: a crash here then models
+            # "died at the emission flush" and the replay re-emits this
+            # seq — the consumer still sees it exactly once. (Fired
+            # after the mark, a crash here would mark an item delivered
+            # that no consumer ever received, and the replay would
+            # suppress it — a silent loss the crash-point sweep caught.)
+            self.obs.flight_event(_flight.EMIT, "sink", float(seq))
+        if self.deliver is not None:
+            self.deliver(item, self.epoch, seq)
+        self.delivered = max(self.delivered, seq)
+        self.emitted += 1
+        if self.obs is not None:
+            self.obs.counter(_obs.DELIVERY_EMITTED).inc()
+        return True
+
+    def filter(self, items):
+        """List-face of :meth:`emit`: the subset of ``items`` to hand
+        downstream, in order. Crash caveat: a crash inside :meth:`emit`
+        discards the whole return value — under supervision use
+        :meth:`drain_into` (or per-item :meth:`emit`) so items already
+        sequenced reach the collector before the next one can crash."""
+        return [it for it in items if self.emit(it)]
+
+    def drain_into(self, items, collect: Callable) -> None:
+        """Crash-safe batch handoff: each item that passes :meth:`emit`
+        reaches ``collect`` BEFORE the next emission (whose flight
+        event is an enumerable crash site) can raise — so a mid-batch
+        crash replays only the items the collector never received."""
+        for it in items:
+            if self.emit(it):
+                collect(it)
+
+    # -- the checkpoint transaction ----------------------------------------
+    def save(self, dir_path: str) -> None:
+        """Write the ledger head into an open (pre-commit) checkpoint
+        bundle: ``committed_seq`` = everything emitted so far,
+        ``epoch`` = the epoch that begins when this checkpoint commits —
+        which is exactly the epoch a restore from this bundle resumes
+        in, keeping (epoch, seq) tags replay-stable."""
+        EpochLedger(epoch=self.epoch + 1,
+                    committed_seq=self.next_seq - 1).save(dir_path)
+
+    def on_commit(self, pos: int) -> None:
+        """The checkpoint's pointer flip succeeded: the epoch closes."""
+        self.epoch += 1
+        if self.obs is not None:
+            self.obs.counter(_obs.DELIVERY_EPOCHS_COMMITTED).inc()
+            self.obs.flight_event(_flight.EPOCH_COMMIT, "sink",
+                                  float(self.epoch))
+
+    def restore(self, ckpt_dir: Optional[str]) -> None:
+        """Rewind to a restored checkpoint's ledger (or to genesis when
+        the supervisor restarts with no checkpoint yet). The delivered
+        high-water is deliberately NOT rewound — it is the suppression
+        horizon that keeps the replay exactly-once."""
+        ledger = EpochLedger.load(ckpt_dir) if ckpt_dir is not None \
+            else None
+        if ledger is None:
+            self.epoch = 0
+            self.next_seq = 0
+        else:
+            self.epoch = ledger.epoch
+            self.next_seq = ledger.committed_seq + 1
+
+    # -- telemetry ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {"mode": self.mode, "epoch": self.epoch,
+                "next_seq": self.next_seq, "delivered": self.delivered,
+                "emitted": self.emitted, "suppressed": self.suppressed}

@@ -537,6 +537,15 @@ class CountStreamPipeline(FusedPipelineDriver):
                                         config=self.config)
             raise e
 
+    def lowered_results(self, interval_out) -> list:
+        """Fetch + lower one interval's window results on host — the
+        same face every other fused pipeline exposes, so the Supervisor
+        (and the ISSUE 8 crash-point sweep) can drive count pipelines
+        through ``run_pipeline`` like any other class."""
+        from .pipeline import lower_interval
+
+        return lower_interval(self.aggregations, interval_out)
+
     # -- test/replay face --------------------------------------------------
     def materialize_interval(self, i: int):
         """Regenerate interval ``i``'s tuples on host, in ARRIVAL order

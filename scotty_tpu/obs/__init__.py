@@ -162,6 +162,18 @@ SOAK_AUDITS = "soak_audits"
 SOAK_INVARIANT_FAILURES = "soak_invariant_failures"
 SOAK_RECORDS_SEEN = "soak_records_seen"
 
+# delivery contract (ISSUE 8 — scotty_tpu.delivery + supervisor lineage:
+# the exactly-once output layer. delivery_duplicates_suppressed and
+# ckpt_integrity_failures APPEARING gate the default ``obs diff`` — a
+# run that started replaying duplicates into its suppression horizon, or
+# whose checkpoints started failing digest verification, must be flagged
+# even when the defense absorbed it)
+DELIVERY_EMITTED = "delivery_emitted"
+DELIVERY_DUPLICATES_SUPPRESSED = "delivery_duplicates_suppressed"
+DELIVERY_EPOCHS_COMMITTED = "delivery_epochs_committed"
+CKPT_INTEGRITY_FAILURES = "ckpt_integrity_failures"
+CKPT_LINEAGE_FALLBACKS = "ckpt_lineage_fallbacks"
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -239,6 +251,17 @@ METRIC_HELP = {
     RESILIENCE_SOURCE_RETRIES: "retrying-source reconnect attempts",
     RESILIENCE_POISON_RECORDS: "records routed to dead-letter",
     RESILIENCE_STALL_EVENTS: "no-progress watchdog detections",
+    DELIVERY_EMITTED:
+        "sink emissions delivered downstream (post-suppression)",
+    DELIVERY_DUPLICATES_SUPPRESSED:
+        "replayed emissions suppressed by the exactly-once sink "
+        "(seq <= delivered high-water after a supervised restore)",
+    DELIVERY_EPOCHS_COMMITTED:
+        "delivery epochs closed by a checkpoint commit",
+    CKPT_INTEGRITY_FAILURES:
+        "checkpoint generations that failed digest verification",
+    CKPT_LINEAGE_FALLBACKS:
+        "restores that fell back to an older lineage generation",
     FLIGHT_DROPPED_EVENTS:
         "flight-recorder ring events lost to wraparound",
     HEALTH_CHECKS: "/healthz verdicts computed",
@@ -271,6 +294,12 @@ class Observability:
         self.flight = flight
         self.postmortem_dir = postmortem_dir
         self._flight_prev: dict = {}
+        #: crash-site seam (ISSUE 8): when set, called as
+        #: ``flight_hook(kind, name, value)`` BEFORE every flight event
+        #: records — each flight-event emit point is thereby an
+        #: enumerable crash site (the hook may raise). None in
+        #: production: the emission path pays one attribute check.
+        self.flight_hook = None
 
     # -- recording --------------------------------------------------------
     def span(self, name: str):
@@ -303,7 +332,11 @@ class Observability:
                      ) -> None:
         """Record one flight event (no-op without an attached recorder) —
         the single call every wiring site uses, so a bare ``Observability``
-        stays exactly as cheap as before."""
+        stays exactly as cheap as before. An installed ``flight_hook``
+        sees the event FIRST (and may raise — the crash-point fuzzer's
+        site enumeration rides exactly this seam)."""
+        if self.flight_hook is not None:
+            self.flight_hook(kind, name, value)
         if self.flight is not None:
             self.flight.record(kind, name, value)
 
@@ -436,6 +469,9 @@ __all__ = [
     "SERVING_CACHE_EVICTIONS", "SERVING_ACTIVE_QUERIES",
     "RESILIENCE_SHED_TUPLES", "RESILIENCE_GROW_EVENTS",
     "RESILIENCE_CHECKPOINTS", "RESILIENCE_RESTARTS",
+    "DELIVERY_EMITTED", "DELIVERY_DUPLICATES_SUPPRESSED",
+    "DELIVERY_EPOCHS_COMMITTED", "CKPT_INTEGRITY_FAILURES",
+    "CKPT_LINEAGE_FALLBACKS",
     "RESILIENCE_SOURCE_RETRIES", "RESILIENCE_POISON_RECORDS",
     "RESILIENCE_STALL_EVENTS", "RESILIENCE_CHECKPOINT_SPAN",
     "RESILIENCE_RESTORE_SPAN", "RESILIENCE_BACKOFF_SPAN",

@@ -90,6 +90,15 @@ DEFAULT_THRESHOLDS = {
         # are lazily created, so "default": 0 gates the appearing case.
         "serving_retraces": {"direction": "lower", "default": 0},
         "serving_rejected": {"direction": "lower", "default": 0},
+        # delivery / checkpoint-integrity contract (ISSUE 8): replayed
+        # duplicates reaching the suppression horizon, or checkpoint
+        # generations failing digest verification, appearing between two
+        # exports gate — the defense absorbing them is not the same as
+        # them not happening. Lazily created ("default": 0 gates the
+        # appearing case, like the resilience set).
+        "delivery_duplicates_suppressed": {"direction": "lower",
+                                           "default": 0},
+        "ckpt_integrity_failures": {"direction": "lower", "default": 0},
         # operations contract (ISSUE 4): flight-ring wraparound drops and
         # unhealthy /healthz verdicts appearing between two exports gate —
         # a run that silently lost its own black-box tail, or that an

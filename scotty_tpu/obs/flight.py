@@ -117,6 +117,21 @@ QUERY_CANCEL = "query_cancel"
 QUERY_REJECT = "query_reject"
 QUERY_EVICT = "query_evict"
 QUERY_REBUCKET = "query_rebucket"
+# exactly-once delivery + checkpoint-integrity events (ISSUE 8,
+# scotty_tpu.delivery + the supervisor lineage): a sink delivery (value =
+# seq — fired BEFORE the downstream handoff, so a fuzzer crash at this
+# site re-delivers on replay instead of silently losing the item), a
+# replayed duplicate suppressed
+# (value = seq), an epoch closing at a checkpoint commit (value = epoch),
+# a checkpoint generation failing integrity verification (name = dir), a
+# restore falling back to an older lineage generation, and a lineage GC
+# removing an aged-out generation
+EMIT = "emit"
+DUPLICATE_SUPPRESSED = "duplicate_suppressed"
+EPOCH_COMMIT = "epoch_commit"
+CKPT_CORRUPT = "ckpt_corrupt"
+LINEAGE_FALLBACK = "lineage_fallback"
+CKPT_GC = "ckpt_gc"
 
 
 class FlightRecorder:

@@ -1,0 +1,192 @@
+"""``python -m scotty_tpu.obs fsck <dir>`` — checkpoint integrity verifier.
+
+Walks a Supervisor checkpoint directory (or a single bundle) and
+verifies every generation against its digest manifest
+(:func:`scotty_tpu.utils.checkpoint.verify_checkpoint`) — the offline
+half of the restore-time integrity gate, for triaging a sick deployment
+without restoring anything:
+
+* per-generation verdict (``ok`` / the corrupt file+leaf+half the
+  integrity error names / ``no manifest`` for pre-integrity bundles);
+* the LATEST pointer's target and whether it verifies — the exact
+  generation a restart would restore, or the lineage fallback it would
+  settle on;
+* the delivery ledger head (``epoch``, ``committed_seq``) per
+  generation, so a duplicate-suppression question ("what seq was
+  committed when it crashed?") is answerable from disk;
+* stale ``*.tmp`` staging leftovers a crashed save stranded (the
+  Supervisor sweeps them on construction; fsck flags them for
+  deployments whose supervisor never came back up).
+
+Exit status: ``0`` — every generation verifies and nothing is stale;
+``1`` — findings, but at least one generation restores (it verifies, or
+it is a pre-integrity bundle with no manifest to check — the Supervisor
+accepts those, unverified): a supervised restart WOULD recover, via
+lineage fallback if needed; ``2`` — nothing restores (or the path holds
+no checkpoints at all): a restart starts from scratch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+def _gen_row(path: str, lineage_pos: int) -> dict:
+    """One generation's verdict + its sidecar heads."""
+    from ..delivery.ledger import EpochLedger
+    from ..utils.checkpoint import CheckpointIntegrityError, verify_checkpoint
+
+    row: dict = {"dir": os.path.basename(path),
+                 "lineage_pos": lineage_pos}
+    try:
+        verdict = verify_checkpoint(path, lineage_pos=lineage_pos)
+        row["ok"] = verdict["ok"]
+        if verdict["ok"] is None:           # pre-integrity bundle
+            row["note"] = verdict["reason"]
+        else:
+            row["files"] = verdict["files"]
+    except CheckpointIntegrityError as e:
+        row["ok"] = False
+        row["error"] = str(e)
+        row["file"] = e.file
+        row["leaf"] = e.leaf
+        row["half"] = e.half
+    try:
+        ledger = EpochLedger.load(path)
+        if ledger is not None:
+            row["ledger"] = {"epoch": ledger.epoch,
+                             "committed_seq": ledger.committed_seq}
+    except (ValueError, OSError, KeyError):
+        row["ledger"] = {"error": "unreadable"}
+    off = os.path.join(path, "offset.json")
+    if os.path.exists(off):
+        try:
+            with open(off) as f:
+                row["offset"] = int(json.load(f)["offset"])
+        except (ValueError, OSError, KeyError):
+            row["offset"] = None
+    return row
+
+
+def fsck_dir(path: str) -> dict:
+    """Verify ``path`` (a checkpoint root, or a single bundle when it
+    carries a manifest itself); returns the machine-readable report the
+    CLI renders. Never raises on corruption — corruption is the output."""
+    from ..utils.checkpoint import MANIFEST_NAME
+
+    report: dict = {"schema": "scotty_tpu.fsck/1", "path": path,
+                    "generations": [], "stale_tmps": [],
+                    "pointer": None, "pointer_verifies": None}
+    if not os.path.isdir(path):
+        report["error"] = f"{path} is not a directory"
+        report["ok"] = False
+        return report
+    if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+        # a single sealed bundle, not a checkpoint root
+        row = _gen_row(path, 0)
+        report["generations"] = [row]
+        report["ok"] = row["ok"] is True
+        report["newest_restorable"] = (row["dir"]
+                                       if row["ok"] is not False else None)
+        return report
+
+    from ..utils.checkpoint import list_generations
+
+    report["stale_tmps"] = sorted(
+        n for n in os.listdir(path) if ".tmp" in n)
+    # the Supervisor's exact generation scan, newest first
+    gens = [os.path.join(path, n) for n in list_generations(path)]
+
+    pointer_target: Optional[str] = None
+    ptr = os.path.join(path, "LATEST.json")
+    if os.path.exists(ptr):
+        try:
+            with open(ptr) as f:
+                pointer_target = json.load(f)["dir"]
+            report["pointer"] = pointer_target
+        except (OSError, ValueError, KeyError):
+            report["pointer"] = None
+            report["pointer_error"] = "LATEST.json is unreadable/torn"
+
+    for i, p in enumerate(gens):
+        row = _gen_row(p, i)
+        if pointer_target is not None \
+                and os.path.basename(p) == pointer_target:
+            report["pointer_verifies"] = row["ok"]
+            report["pointer_found"] = True
+        report["generations"].append(row)
+
+    verifying = [g for g in report["generations"] if g["ok"] is True]
+    report["newest_verifying"] = verifying[0]["dir"] if verifying else None
+    # what a restart would ACTUALLY use: the Supervisor's lineage walk
+    # skips only generations that fail verification — a pre-integrity
+    # bundle (ok=None, no manifest) restores, unverified
+    restorable = [g for g in report["generations"] if g["ok"] is not False]
+    report["newest_restorable"] = (restorable[0]["dir"] if restorable
+                                   else None)
+    report["ok"] = (bool(verifying)
+                    and all(g["ok"] is not False
+                            for g in report["generations"])
+                    and not report["stale_tmps"]
+                    and "pointer_error" not in report)
+    return report
+
+
+def render_fsck(report: dict) -> str:
+    lines = [f"fsck {report['path']}"]
+    if report.get("error"):
+        lines.append(f"  ERROR: {report['error']}")
+        return "\n".join(lines)
+    for g in report["generations"]:
+        if g["ok"] is True:
+            verdict = f"ok ({g.get('files', '?')} files)"
+        elif g["ok"] is None:
+            verdict = f"unverifiable — {g.get('note')}"
+        else:
+            verdict = f"CORRUPT — {g.get('error')}"
+        extra = []
+        if "offset" in g:
+            extra.append(f"offset={g['offset']}")
+        ledger = g.get("ledger")
+        if isinstance(ledger, dict) and "epoch" in ledger:
+            extra.append(f"ledger epoch={ledger['epoch']} "
+                         f"seq={ledger['committed_seq']}")
+        suffix = f"  [{', '.join(extra)}]" if extra else ""
+        lines.append(f"  {g['dir']:24s} {verdict}{suffix}")
+    if report.get("pointer") is not None:
+        if not report.get("pointer_found"):
+            ok = "missing"
+        else:
+            ok = {True: "verifies", False: "CORRUPT",
+                  None: "unverifiable — no manifest"}[
+                      report.get("pointer_verifies")]
+        lines.append(f"  LATEST -> {report['pointer']} ({ok})")
+    elif report.get("pointer_error"):
+        lines.append(f"  LATEST pointer: {report['pointer_error']}")
+    for name in report["stale_tmps"]:
+        lines.append(f"  stale tmp: {name} (crashed save leftover — the "
+                     "Supervisor sweeps these at startup)")
+    if report.get("newest_restorable"):
+        note = "" if report["newest_restorable"] \
+            == report.get("newest_verifying") \
+            else " (pre-integrity bundle — restores UNVERIFIED)"
+        lines.append("  restore would use: "
+                     f"{report['newest_restorable']}{note}")
+    elif report["generations"]:
+        lines.append("  NOTHING RESTORES — a restart starts from scratch")
+    else:
+        lines.append("  no checkpoint generations found")
+    lines.append("  verdict: " + ("clean" if report["ok"] else "FINDINGS"))
+    return "\n".join(lines)
+
+
+def fsck_main(path: str, as_json: bool = False, echo=print) -> int:
+    """CLI face (module docstring has the exit-status contract)."""
+    report = fsck_dir(path)
+    echo(json.dumps(report, indent=1, default=float) if as_json
+         else render_fsck(report))
+    if report["ok"]:
+        return 0
+    return 1 if report.get("newest_restorable") else 2

@@ -214,6 +214,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable analysis instead of the report")
     pp.add_argument("--timeline", action="store_true",
                     help="include the full event-by-event timeline")
+    fp = sub.add_parser(
+        "fsck", help="verify a checkpoint directory's integrity "
+                     "manifests: per-generation verdict naming the "
+                     "corrupt file/leaf, LATEST pointer health, "
+                     "delivery-ledger heads, stale tmp leftovers; "
+                     "exit 0 clean / 1 findings-but-recoverable / "
+                     "2 nothing restores")
+    fp.add_argument("dir", help="checkpoint root (Supervisor dir) or a "
+                                "single sealed bundle")
+    fp.add_argument("--json", action="store_true",
+                    help="machine-readable report instead of the table")
     args = ap.parse_args(argv)
     if args.cmd == "report":
         print(render(args.file, as_json=args.json))
@@ -228,4 +239,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return postmortem_main(args.bundle, as_json=args.json,
                                show_timeline=args.timeline)
+    if args.cmd == "fsck":
+        from .fsck import fsck_main
+
+        return fsck_main(args.dir, as_json=args.json)
     return 2                                            # pragma: no cover

@@ -17,6 +17,14 @@ against the host simulator oracle:
 * :class:`StallingSource` — a source that goes silent for a configured
   span on an injectable clock (watchdog fodder; no wall-clock waits
   under :class:`~scotty_tpu.resilience.clock.ManualClock`).
+* :class:`CrashPlan` / :class:`ArmedFault` / :func:`crash_point_sweep`
+  — the systematic crash-point fuzzer (ISSUE 8): enumerate EVERY
+  instrumented crash site of a run (each flight-event emit point —
+  ingest batches, watermarks, drains, emission flushes — plus every
+  ``write``/``fsync``/``replace`` inside checkpoint commit via the
+  :mod:`scotty_tpu.utils.fsio` shim, with torn/short/ENOSPC variants),
+  then crash a fresh run at each one and prove supervised recovery
+  yields sink output bit-identical to the uninterrupted oracle.
 
 Everything is a pure function of its seed: two runs with the same seed
 inject byte-identical faults, which is what lets the differential tests
@@ -25,10 +33,13 @@ compare a chaos run against an oracle replay exactly.
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, Optional, Sequence
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..utils import fsio
 from .clock import Clock, SystemClock
 
 
@@ -171,3 +182,240 @@ class StallingSource:
             if i in self.stall_at:
                 self.clock.sleep(self.stall_s)
             yield r
+
+
+# -- the crash-point fuzzer (ISSUE 8 tentpole part 3) -----------------------
+
+#: fault variants per fsio op. A ``write`` can crash before the op, tear
+#: (half the bytes then an error), short-write SILENTLY (half the bytes,
+#: normal return — caught only by the manifest's intent digest on a
+#: later restore, so the armed fault forces one by crashing at the next
+#: flight event), or hit ENOSPC. An ``fsync`` can crash before the call
+#: or fail with EIO; a ``replace`` — the atomic commit point itself —
+#: can only crash before the rename (os.replace is atomic: there is no
+#: "half a rename" to inject).
+FS_WRITE_FAULTS = ("crash", "torn", "short", "enospc")
+FS_FSYNC_FAULTS = ("crash", "eio")
+FS_REPLACE_FAULTS = ("crash",)
+
+
+@dataclass(frozen=True)
+class CrashSite:
+    """One enumerated crash site: ``domain`` is ``"flight"`` (an
+    instrumented flight-event emit point — ingest batch, watermark,
+    drain, emission flush, epoch commit...) or ``"fs"`` (a
+    write/fsync/replace inside checkpoint commit, via the fsio shim);
+    ``index`` is the site's global occurrence index within its domain
+    (deterministic runs make it stable between the enumerating oracle
+    and the armed run); ``kind``/``name`` label what happens there;
+    ``fault`` picks the variant enacted when the armed run arrives."""
+
+    domain: str
+    index: int
+    kind: str
+    name: str
+    fault: str = "crash"
+
+    def label(self) -> str:
+        return (f"{self.domain}[{self.index}] {self.kind}:{self.name}"
+                f" fault={self.fault}")
+
+
+class CrashPlan:
+    """Enumerate every instrumented crash site of a deterministic run.
+
+    :meth:`record` installs recording hooks on the run's Observability
+    (``flight_hook`` — fires before each flight event records) and the
+    fsio fault seam, executes the uninterrupted run once, and returns
+    the full site list: one ``crash`` site per flight emit point, plus
+    one site per fsio op per applicable fault variant. The driver
+    (:func:`crash_point_sweep`) then replays a FRESH run per site with
+    an :class:`ArmedFault` installed.
+    """
+
+    def __init__(self, include_flight: bool = True,
+                 include_fs: bool = True,
+                 write_faults: Sequence[str] = FS_WRITE_FAULTS,
+                 fsync_faults: Sequence[str] = FS_FSYNC_FAULTS,
+                 replace_faults: Sequence[str] = FS_REPLACE_FAULTS):
+        self.include_flight = include_flight
+        self.include_fs = include_fs
+        self.write_faults = tuple(write_faults)
+        self.fsync_faults = tuple(fsync_faults)
+        self.replace_faults = tuple(replace_faults)
+
+    def record(self, obs, run: Callable[[], object]) -> List[CrashSite]:
+        """Run the uninterrupted oracle with recording hooks installed;
+        returns the enumerated sites (the run's return value is
+        discarded — enumerate on a throwaway environment, or capture
+        the oracle output in the ``run`` closure)."""
+        flights: List[tuple] = []
+        fs_ops: List[tuple] = []
+
+        def flight_hook(kind, name, value):
+            flights.append((str(kind), str(name)))
+
+        def fs_hook(op, path):
+            fs_ops.append((str(op), os.path.basename(str(path))))
+            return None
+
+        prev_flight = getattr(obs, "flight_hook", None)
+        obs.flight_hook = flight_hook
+        prev_fs = fsio.set_fault_hook(fs_hook)
+        try:
+            run()
+        finally:
+            obs.flight_hook = prev_flight
+            fsio.set_fault_hook(prev_fs)
+        sites: List[CrashSite] = []
+        if self.include_flight:
+            sites.extend(CrashSite("flight", i, kind, name)
+                         for i, (kind, name) in enumerate(flights))
+        if self.include_fs:
+            faults_of = {"write": self.write_faults,
+                         "fsync": self.fsync_faults,
+                         "replace": self.replace_faults}
+            for j, (op, name) in enumerate(fs_ops):
+                for fault in faults_of.get(op, ("crash",)):
+                    sites.append(CrashSite("fs", j, op, name, fault))
+        return sites
+
+
+class ArmedFault:
+    """One-shot fault armed at a single :class:`CrashSite`, installed as
+    a context manager around the fuzzed run::
+
+        with ArmedFault(site, obs):
+            delivered = run()
+
+    Flight sites raise :class:`ChaosError` at the matching occurrence
+    (before the event records — the crash hits exactly at the emit
+    point). Fs sites crash before the op, or return the fsio fault
+    action (torn/short/enospc; any action at an fsync site is EIO). A
+    SILENT fault (``short``) additionally arms a follow-up crash at the
+    next flight event, so a supervised recovery is forced THROUGH the
+    corrupt committed bundle — the lineage-fallback path, exercised
+    systematically. One-shot: after firing (``fired`` records where),
+    the replayed recovery passes the same site untouched.
+    """
+
+    def __init__(self, site: CrashSite, obs, exc: type = ChaosError):
+        self.site = site
+        self.obs = obs
+        self.exc = exc
+        self.fired: Optional[str] = None
+        self._n_flight = 0
+        self._n_fs = 0
+        self._crash_next_flight = False
+        self._prev_flight = None
+        self._prev_fs = None
+
+    # -- the hooks ---------------------------------------------------------
+    def _flight_hook(self, kind, name, value) -> None:
+        i = self._n_flight
+        self._n_flight += 1
+        if self._crash_next_flight:
+            self._crash_next_flight = False
+            raise self.exc(
+                f"armed follow-up crash (after silent fault at "
+                f"{self.site.label()}) at flight[{i}] {kind}:{name}")
+        if (self.fired is None and self.site.domain == "flight"
+                and i == self.site.index):
+            self.fired = f"flight[{i}] {kind}:{name}"
+            raise self.exc(f"armed crash at {self.fired}")
+
+    def _fs_hook(self, op, path) -> Optional[str]:
+        j = self._n_fs
+        self._n_fs += 1
+        if (self.fired is None and self.site.domain == "fs"
+                and j == self.site.index):
+            self.fired = f"fs[{j}] {op}:{os.path.basename(str(path))} " \
+                         f"fault={self.site.fault}"
+            if self.site.fault == "crash":
+                raise self.exc(f"armed crash before {self.fired}")
+            if self.site.fault == "short":
+                # the silent half-write: commit completes, corruption
+                # waits — force a recovery through it at the next
+                # flight event (the lineage-fallback read path)
+                self._crash_next_flight = True
+                return fsio.SHORT
+            if self.site.fault == "eio":
+                return fsio.TORN   # any action at an fsync site = EIO
+            return self.site.fault             # torn | enospc
+        return None
+
+    # -- install/uninstall -------------------------------------------------
+    def __enter__(self) -> "ArmedFault":
+        self._prev_flight = getattr(self.obs, "flight_hook", None)
+        self.obs.flight_hook = self._flight_hook
+        self._prev_fs = fsio.set_fault_hook(self._fs_hook)
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.obs.flight_hook = self._prev_flight
+        fsio.set_fault_hook(self._prev_fs)
+
+
+@dataclass
+class SweepReport:
+    """What :func:`crash_point_sweep` proved: ``sites`` enumerated,
+    ``ran`` armed runs executed (sampling may skip some), ``fired`` how
+    many actually reached their site, and ``failures`` — one row per
+    site whose recovered output was NOT bit-identical to the oracle (or
+    whose run died outright). An empty ``failures`` IS the exactly-once
+    claim, site by site."""
+
+    sites: int = 0
+    ran: int = 0
+    fired: int = 0
+    oracle_len: int = 0
+    failures: List[dict] = field(default_factory=list)
+
+
+def crash_point_sweep(make_env: Callable[[], tuple],
+                      sample_every: int = 1,
+                      plan: Optional[CrashPlan] = None) -> SweepReport:
+    """The systematic crash-point driver (ISSUE 8 tentpole part 3).
+
+    ``make_env()`` builds ONE fresh isolated run environment and returns
+    ``(obs, run)``: the Observability every component records through,
+    and ``run()`` executing the full supervised run, returning the
+    delivered sink output (a list — the downstream consumer's exact
+    view). The driver runs one uninterrupted environment to capture the
+    oracle output AND enumerate sites, then for every ``sample_every``-th
+    site arms a one-shot fault in a fresh environment, runs it to
+    completion under the supervisor, and requires the delivered output
+    be **bit-identical** to the oracle's — zero duplicates, zero losses,
+    at every enumerated crash site. The caller asserts
+    ``report.failures == []``.
+    """
+    plan = plan or CrashPlan()
+    oracle_box: List = []
+    obs, run = make_env()
+    sites = plan.record(obs, lambda: oracle_box.extend(run()))
+    oracle = list(oracle_box)
+    report = SweepReport(sites=len(sites), oracle_len=len(oracle))
+    for k, site in enumerate(sites):
+        if sample_every > 1 and k % sample_every:
+            continue
+        report.ran += 1
+        obs, run = make_env()
+        armed = ArmedFault(site, obs)
+        try:
+            with armed:
+                delivered = run()
+        except Exception as e:   # noqa: BLE001 — a dead run is a finding
+            report.failures.append({
+                "site": site.label(), "error": f"{type(e).__name__}: {e}"})
+            continue
+        finally:
+            if armed.fired is not None:
+                report.fired += 1
+        if list(delivered) != oracle:
+            dup = len(delivered) - len(set(map(repr, delivered)))
+            report.failures.append({
+                "site": site.label(),
+                "error": (f"delivered output diverged from oracle: "
+                          f"{len(delivered)} vs {len(oracle)} items"
+                          + (f", {dup} duplicate(s)" if dup > 0 else ""))})
+    return report

@@ -21,17 +21,37 @@ Recovery events are exported through the existing Observability layer:
 ``resilience_checkpoints`` / ``resilience_restarts`` counters and
 ``resilience_checkpoint`` / ``resilience_restore`` /
 ``resilience_backoff`` spans.
+
+Integrity + lineage (ISSUE 8): every commit writes into a ``ckpt-<pos>
+.tmp`` staging directory through the fault-injectable
+:mod:`scotty_tpu.utils.fsio` layer, seals it with a digest manifest
+(:func:`~scotty_tpu.utils.checkpoint.finalize_checkpoint`), and lands it
+whole with one atomic directory rename — the commit point; the LATEST
+pointer is a derived convenience. The last ``keep_checkpoints``
+generations form a **lineage**: restores take the newest generation that
+*verifies*, falling back past corrupt/torn ones (counted
+``ckpt_integrity_failures`` / ``ckpt_lineage_fallbacks``,
+flight-recorded, postmortem-bundled) instead of dying opaquely on one
+flipped bit; older generations are GC'd so an hours-long soak's
+checkpoint dir stays bounded by the retention policy, and stale ``.tmp``
+leftovers from crashed saves are swept on construction and after every
+commit. An attached :class:`~scotty_tpu.delivery.sink.TransactionalSink`
+(``supervisor.sink``) commits its epoch ledger INSIDE the same bundle —
+state, source offset and delivered-seq can never tear apart.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Callable, Optional, Sequence
+import shutil
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import flight as _fl
+from ..utils import fsio
 from .clock import Clock, SystemClock
 from .policy import backoff_delay
 
@@ -58,7 +78,8 @@ class Supervisor:
     def __init__(self, checkpoint_dir: str, clock: Optional[Clock] = None,
                  obs=None, checkpoint_every: int = 4, max_restarts: int = 3,
                  backoff_base_s: float = 0.05, backoff_max_s: float = 2.0,
-                 jitter: float = 0.5, seed: int = 0):
+                 jitter: float = 0.5, seed: int = 0,
+                 keep_checkpoints: int = 3):
         self.dir = checkpoint_dir
         self.clock = clock or SystemClock()
         self.obs = obs
@@ -67,9 +88,20 @@ class Supervisor:
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self.jitter = float(jitter)
+        #: lineage retention (ISSUE 8): how many committed generations
+        #: survive GC — the fallback depth when the newest is corrupt,
+        #: and the disk bound the soak's checkpoint-dir ratchet audits
+        self.keep_checkpoints = max(1, int(keep_checkpoints))
+        #: optional :class:`~scotty_tpu.delivery.sink.TransactionalSink`
+        #: whose epoch ledger commits inside every checkpoint bundle
+        self.sink = None
         self._rng = np.random.default_rng(seed)
         self.restarts = 0          # consecutive failed recoveries
         self.total_restarts = 0    # lifetime (telemetry mirror)
+        # startup hygiene (ISSUE 8 satellite): a crash mid-save strands
+        # ckpt-*.tmp staging dirs / pointer tmps that used to accumulate
+        # forever — sweep them before the first commit can trip on one
+        self._sweep_tmps()
 
     # -- shared plumbing ---------------------------------------------------
     def _count(self, name: str, n: int = 1) -> None:
@@ -132,14 +164,17 @@ class Supervisor:
             self.clock.sleep(delay)
 
     # -- atomic checkpoint commit ------------------------------------------
-    # Each checkpoint writes into its own ``ckpt-<pos>`` subdirectory
-    # (state + config sidecar + offset), and only then an atomic
-    # ``os.replace`` flips the LATEST pointer. A crash mid-write leaves
-    # the pointer at the previous fully-committed checkpoint, so a
+    # Each checkpoint stages into ``ckpt-<pos>.tmp`` (state + config
+    # sidecar + offset + the sink's delivery ledger, every byte through
+    # the fault-injectable fsio layer), is sealed with a digest manifest,
+    # and lands whole via ONE atomic directory rename — the commit point.
+    # A crash anywhere mid-write leaves only a ``.tmp`` to sweep; a
     # restart can never pair new state with a stale offset (silent
-    # double-ingestion) or grown-shape state with a stale config (an
-    # unrecoverable restore loop) — the sidecars commit WITH the state
-    # or not at all.
+    # double-ingestion), grown-shape state with a stale config (an
+    # unrecoverable restore loop), or engine state with a stale
+    # delivered-seq (sink duplicates) — the sidecars commit WITH the
+    # state or not at all. The LATEST pointer is a derived convenience
+    # (ordering is recoverable from the ``ckpt-<pos>`` names alone).
 
     _POINTER = "LATEST.json"
 
@@ -147,25 +182,140 @@ class Supervisor:
         ptr = os.path.join(self.dir, self._POINTER)
         if not os.path.exists(ptr):
             return None
-        with open(ptr) as f:
-            return os.path.join(self.dir, json.load(f)["dir"])
+        try:
+            with open(ptr) as f:
+                return os.path.join(self.dir, json.load(f)["dir"])
+        except (OSError, ValueError, KeyError):
+            # a torn pointer is not fatal: the lineage walk recovers
+            # ordering from the generation names themselves
+            return None
 
-    def _new_ckpt_dir(self, pos: int) -> str:
-        path = os.path.join(self.dir, f"ckpt-{pos}")
-        os.makedirs(path, exist_ok=True)
-        return path
+    def _sweep_tmps(self) -> None:
+        """Remove stale ``*.tmp`` staging dirs/files a crashed save left
+        behind (construction + after every commit) — they are dead
+        weight ``fsck`` would otherwise flag forever."""
+        if not os.path.isdir(self.dir):
+            return
+        for name in os.listdir(self.dir):
+            if ".tmp" not in name:
+                continue
+            p = os.path.join(self.dir, name)
+            if os.path.isdir(p):
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
-    def _commit_ckpt(self, path: str) -> None:
-        prev = self._current_ckpt()
+    def _lineage(self) -> List[str]:
+        """Committed generations newest-first by POSITION. The LATEST
+        pointer is a derived convenience, not the commit point — the
+        bundle rename is (see ``_commit``), so a crash between the
+        rename and the pointer flip leaves the pointer one generation
+        stale; ordering by name recovers the truly newest commit (whose
+        ledger closes the emissions the stale pointer would replay as
+        duplicates)."""
+        from ..utils.checkpoint import list_generations
+
+        return [os.path.join(self.dir, n)
+                for n in list_generations(self.dir)]
+
+    def _verified_ckpt(self) -> Optional[str]:
+        """The newest generation that VERIFIES — the lineage-fallback
+        read path. Corrupt/torn generations count
+        ``ckpt_integrity_failures`` (flight ``ckpt_corrupt``,
+        postmortem-bundled with the leaf-naming error); settling on an
+        older one counts ``ckpt_lineage_fallbacks``. None when nothing
+        verifies (first start, or every generation corrupt — the caller
+        then starts from scratch / gives up per its own contract)."""
+        from ..utils.checkpoint import (CheckpointIntegrityError,
+                                        verify_checkpoint)
+
+        cur = self._current_ckpt()
+        cur_pos = -1
+        if cur is not None:
+            try:
+                cur_pos = int(os.path.basename(cur).split("-", 1)[1])
+            except (IndexError, ValueError):
+                pass
+        for i, p in enumerate(self._lineage()):
+            try:
+                verdict = verify_checkpoint(p, lineage_pos=i)
+            except CheckpointIntegrityError as e:
+                self._count(_obs.CKPT_INTEGRITY_FAILURES)
+                self._flight(_fl.CKPT_CORRUPT, os.path.basename(p), i)
+                self._postmortem(e)
+                continue
+            if verdict["ok"] is None and cur_pos >= 0:
+                try:
+                    pos = int(os.path.basename(p).split("-", 1)[1])
+                except (IndexError, ValueError):
+                    pos = -1
+                if pos > cur_pos:
+                    # UNVERIFIABLE (no manifest) and newer than the
+                    # committed pointer: a real commit seals its
+                    # manifest before the rename, so this is foreign
+                    # garbage, not a stale-pointer commit — distrust it
+                    self._flight(_fl.CKPT_CORRUPT, os.path.basename(p), i)
+                    continue
+            if i > 0:
+                self._count(_obs.CKPT_LINEAGE_FALLBACKS)
+                self._flight(_fl.LINEAGE_FALLBACK, os.path.basename(p), i)
+            return p
+        return None
+
+    def _gc_lineage(self) -> None:
+        """Retire generations beyond ``keep_checkpoints`` (newest-first
+        survivorship) — the retention policy that bounds checkpoint-dir
+        disk across an hours-long soak."""
+        for p in self._lineage()[self.keep_checkpoints:]:
+            shutil.rmtree(p, ignore_errors=True)
+            self._flight(_fl.CKPT_GC, os.path.basename(p))
+
+    def _commit(self, pos: int, save_fn: Callable[[str], None],
+                offset: Optional[int] = None, config=None,
+                flight_name: str = "offset") -> None:
+        """The one commit path every mode uses (see the section comment
+        for the atomicity story). ``flight_name`` keeps the per-mode
+        flight vocabulary: pipeline-mode checkpoints progress by
+        "interval", everything else by "offset"."""
+        from ..utils.checkpoint import finalize_checkpoint
+
+        with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
+            final = os.path.join(self.dir, f"ckpt-{pos}")
+            tmp = final + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)   # a crashed earlier try
+            os.makedirs(tmp, exist_ok=True)
+            save_fn(tmp)
+            if config is not None:
+                self._save_config_sidecar(tmp, config)
+            if offset is not None:
+                fsio.write_bytes(os.path.join(tmp, "offset.json"),
+                                 json.dumps({"offset": int(offset)})
+                                 .encode())
+            if self.sink is not None:
+                self.sink.save(tmp)
+            finalize_checkpoint(tmp)
+            if os.path.isdir(final):     # re-commit at the same position
+                shutil.rmtree(final)     # after a post-commit crash
+            fsio.replace(tmp, final)     # THE atomic commit point
+            self._flip_pointer(final)
+        self._count(_obs.RESILIENCE_CHECKPOINTS)
+        self._flight("checkpoint", flight_name,
+                     pos if offset is None else offset)
+        if self.sink is not None:
+            self.sink.on_commit(pos)
+        self._gc_lineage()
+        self._sweep_tmps()
+        self.restarts = 0                # progress made
+
+    def _flip_pointer(self, path: str) -> None:
         ptr = os.path.join(self.dir, self._POINTER)
         tmp = ptr + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"dir": os.path.basename(path)}, f)
-        os.replace(tmp, ptr)                  # the atomic commit point
-        if prev and os.path.abspath(prev) != os.path.abspath(path):
-            import shutil
-
-            shutil.rmtree(prev, ignore_errors=True)
+        fsio.write_bytes(tmp, json.dumps(
+            {"dir": os.path.basename(path)}).encode())
+        fsio.replace(tmp, ptr)
 
     def _save_config_sidecar(self, path: str, config) -> None:
         """The engine config rides inside the checkpoint directory: the
@@ -174,8 +324,8 @@ class Supervisor:
         the restore leaf-shape check rejects the snapshot."""
         import dataclasses
 
-        with open(os.path.join(path, "config.json"), "w") as f:
-            json.dump(dataclasses.asdict(config), f)
+        fsio.write_bytes(os.path.join(path, "config.json"),
+                         json.dumps(dataclasses.asdict(config)).encode())
 
     def _load_config_sidecar(self, ckpt: Optional[str]):
         if ckpt is None:
@@ -195,24 +345,18 @@ class Supervisor:
         (the soak harness drives one): ``save_fn(dir)`` writes the
         target's state into a fresh ``ckpt-<pos>`` directory; the offset
         sidecar and the ``os.replace`` pointer flip follow exactly the
-        run_pipeline/run_operator discipline, and committing resets the
-        consecutive-restart budget (progress was made)."""
-        with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
-            d = self._new_ckpt_dir(pos)
-            save_fn(d)
-            if offset is not None:
-                with open(os.path.join(d, "offset.json"), "w") as f:
-                    json.dump({"offset": int(offset)}, f)
-            self._commit_ckpt(d)
-        self._count(_obs.RESILIENCE_CHECKPOINTS)
-        self._flight("checkpoint", "offset",
-                     pos if offset is None else offset)
-        self.restarts = 0
+        run_pipeline/run_operator discipline — extended per ISSUE 8 with
+        the manifest seal, the sink's ledger, lineage GC and the tmp
+        sweep — and committing resets the consecutive-restart budget
+        (progress was made)."""
+        self._commit(pos, save_fn, offset=offset)
 
     def latest_checkpoint(self):
-        """``(dir, offset)`` of the last committed checkpoint (offset 0
-        without a sidecar), or ``None`` before the first commit."""
-        ckpt = self._current_ckpt()
+        """``(dir, offset)`` of the newest committed checkpoint that
+        VERIFIES (offset 0 without a sidecar) — corrupt generations are
+        skipped via the lineage fallback — or ``None`` when none
+        exists/verifies."""
+        ckpt = self._verified_ckpt()
         if ckpt is None:
             return None
         offset = 0
@@ -262,14 +406,9 @@ class Supervisor:
                         # GROW occupancy anchor in one round trip)
                         p = p.enforce_overflow_policy(
                             factory=factory, obs=self.obs)
-                        with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
-                            d = self._new_ckpt_dir(i)
-                            save_pipeline(p, d)
-                            self._save_config_sidecar(d, p.config)
-                            self._commit_ckpt(d)
-                        self._count(_obs.RESILIENCE_CHECKPOINTS)
-                        self._flight("checkpoint", "interval", i)
-                        self.restarts = 0          # progress made
+                        self._commit(
+                            i, lambda d, _p=p: save_pipeline(_p, d),
+                            config=p.config, flight_name="interval")
                 return [results[k] for k in range(n_intervals)]
             except Exception as e:            # noqa: BLE001 — supervised edge
                 self._backoff(e)
@@ -278,13 +417,14 @@ class Supervisor:
     def _pipeline_start(self, factory: Callable):
         from ..utils.checkpoint import restore_pipeline
 
-        ckpt = self._current_ckpt()
+        ckpt = self._verified_ckpt()
         p = factory(config=self._load_config_sidecar(ckpt))
         if self.obs is not None and hasattr(p, "set_observability"):
             p.set_observability(self.obs)
         if ckpt is not None:
             with self._span(_obs.RESILIENCE_RESTORE_SPAN):
-                restore_pipeline(p, ckpt)
+                # already verified by the lineage walk just above
+                restore_pipeline(p, ckpt, verify=False)
             self._flight("restore", os.path.basename(ckpt))
         return p
 
@@ -332,18 +472,11 @@ class Supervisor:
                     if (idx % self.checkpoint_every == 0
                             or idx == len(events)) and op._built:
                         op.check_overflow()
-                        with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
-                            d = self._new_ckpt_dir(idx)
-                            save_engine_operator(op, d)
-                            self._save_config_sidecar(d, op.config)
-                            with open(os.path.join(d, "offset.json"),
-                                      "w") as f:
-                                json.dump({"offset": idx}, f)
-                            self._commit_ckpt(d)
-                        self._count(_obs.RESILIENCE_CHECKPOINTS)
-                        self._flight("checkpoint", "offset", idx)
+                        self._commit(
+                            idx,
+                            lambda d, _op=op: save_engine_operator(_op, d),
+                            offset=idx, config=op.config)
                         offset = idx
-                        self.restarts = 0          # progress made
                 return [results[k] for k in sorted(results)]
             except Exception as e:            # noqa: BLE001 — supervised edge
                 self._backoff(e)
@@ -352,12 +485,13 @@ class Supervisor:
     def _operator_start(self, make_operator: Callable):
         from ..utils.checkpoint import restore_engine_operator
 
-        ckpt = self._current_ckpt()
+        ckpt = self._verified_ckpt()
         op = make_operator(config=self._load_config_sidecar(ckpt))
         offset = 0
         if ckpt is not None:
             with self._span(_obs.RESILIENCE_RESTORE_SPAN):
-                restore_engine_operator(op, ckpt)
+                # already verified by the lineage walk just above
+                restore_engine_operator(op, ckpt, verify=False)
             with open(os.path.join(ckpt, "offset.json")) as f:
                 offset = int(json.load(f)["offset"])
             self._flight("restore", os.path.basename(ckpt), offset)

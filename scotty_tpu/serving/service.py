@@ -431,12 +431,13 @@ class QueryService:
                 "max_size": geom.max_size,
             },
         }
+        # through fsio (ISSUE 8): the bundle manifest records the intent
+        # digest, and the crash-point fuzzer enumerates these ops
+        from ..utils import fsio
+
         tmp = os.path.join(path, f"query_table.json.tmp.{os.getpid()}")
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(path, "query_table.json"))
+        fsio.write_bytes(tmp, json.dumps(doc, indent=1).encode())
+        fsio.replace(tmp, os.path.join(path, "query_table.json"))
 
     def restore(self, path: str) -> None:
         """Restore engine state + query table into this service (same

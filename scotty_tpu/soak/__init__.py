@@ -4,7 +4,8 @@ external load — no silent drops, no unbounded queues, no leaks.
 * :mod:`.source` — seeded offered-load record source with the chaos mix
   (late storms / poison / flaky fetches / one-shot consumer crashes).
 * :mod:`.invariants` — the audit functions: exact tuple conservation,
-  watermark monotonicity, ring boundedness, the memory ratchet.
+  watermark monotonicity, ring boundedness, the memory ratchet, the
+  sink-duplicate audit and the checkpoint-dir disk ratchet (ISSUE 8).
 * :mod:`.harness` — :class:`SoakRunner` / :func:`run_soak`: the paced
   loop on the injectable Clock, under the Supervisor's checkpoint /
   restart discipline, polling ``/healthz``, failing fast on any audit
@@ -20,8 +21,10 @@ from .harness import (
 )
 from .invariants import (
     check_conservation,
+    check_disk_bounded,
     check_memory_ratchet,
     check_ring_bounded,
+    check_sink_duplicates,
     check_watermark_monotone,
     live_objects,
     rss_bytes,
@@ -33,5 +36,6 @@ __all__ = [
     "ConnectorSoakTarget", "ChaosMix", "SoakSource", "SourceConfig",
     "check_conservation", "check_watermark_monotone",
     "check_ring_bounded", "check_memory_ratchet",
+    "check_sink_duplicates", "check_disk_bounded",
     "rss_bytes", "live_objects",
 ]

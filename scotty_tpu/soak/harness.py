@@ -19,7 +19,13 @@ Every ``audit_every_s`` the runner proves, not assumes:
 * **ring boundedness** (occupancy and high-water vs depth × block_size);
 * **memory ratchet**: RSS + live-object readings must plateau — a
   monotone ratchet past the grace window fails the soak with the trend
-  in the finding.
+  in the finding;
+* **sink duplicates** (ISSUE 8, with ``delivery="exactly_once"``): every
+  ``(epoch, seq)`` tag the transactional sink handed downstream was
+  observed at most once across all restarts;
+* **disk boundedness** (ISSUE 8): the checkpoint dir's committed
+  generations stay within the Supervisor's ``keep_checkpoints``
+  retention — the disk analogue of the RSS ratchet.
 
 ``/healthz`` is polled on every audit when serving is enabled. Any
 invariant failure stops the soak (configurable), counts
@@ -44,8 +50,10 @@ from ..resilience.chaos import ChaosError
 from ..resilience.clock import Clock, SystemClock, wall_time
 from .invariants import (
     check_conservation,
+    check_disk_bounded,
     check_memory_ratchet,
     check_ring_bounded,
+    check_sink_duplicates,
     check_watermark_monotone,
     live_objects,
     rss_bytes,
@@ -84,6 +92,13 @@ class SoakConfig:
     checkpoint_every_audits: int = 4          # 0 = no supervisor ckpts
     max_restarts: int = 3
     stop_on_failure: bool = True
+    # delivery guarantee (ISSUE 8): "exactly_once" arms a
+    # TransactionalSink around the target's emissions — its epoch
+    # ledger commits inside every supervisor checkpoint, replayed
+    # duplicates after a restart are suppressed, and the sink-duplicate
+    # audit proves no (epoch, seq) tag ever reached the consumer twice
+    delivery: str = "at_least_once"
+    keep_checkpoints: int = 3                 # supervisor lineage depth
     # memory-ratchet knobs (slacks sized so a healthy CI run never
     # false-positives; the leak-detection path is tested with tight
     # slacks + an injected leak)
@@ -124,6 +139,8 @@ class ConnectorSoakTarget:
         # the opposite (window emission totals live in the obs counters;
         # exact shed counts in the ring's ``shed``)
         self.windows_emitted = 0
+        #: optional TransactionalSink (ISSUE 8) every emission passes
+        self.sink = None
         self.poison = PoisonHandler(obs=obs)
         self.ring = RingIngestor.for_sink(
             cfg.ring,
@@ -131,8 +148,22 @@ class ConnectorSoakTarget:
                 self.op.process_block(keys, vals, tss)),
             keyed=True, obs=obs, clock=clock)
 
+    def attach_sink(self, sink) -> None:
+        """Arm the exactly-once output boundary: every emission passes
+        ``sink.emit`` before it counts as delivered downstream."""
+        self.sink = sink
+
     def _emit(self, items) -> None:
-        self.windows_emitted += len(items)
+        if self.sink is None:
+            self.windows_emitted += len(items)
+            return
+
+        def deliver(_item):
+            self.windows_emitted += 1
+
+        # per-item handoff (sink.drain_into): each delivered item counts
+        # before the next emission's flight event — a crash site — fires
+        self.sink.drain_into(items, deliver)
 
     def offer_chunk(self, recs) -> None:
         for rec in recs:
@@ -215,7 +246,29 @@ class SoakRunner:
             self.supervisor = Supervisor(
                 os.path.join(report_dir, "checkpoints"), clock=self.clock,
                 obs=self.obs, max_restarts=config.max_restarts,
-                seed=config.seed)
+                seed=config.seed,
+                keep_checkpoints=config.keep_checkpoints)
+        # exactly-once delivery (ISSUE 8): the sink outlives target
+        # generations (it belongs to the runner), its ledger commits
+        # inside every supervisor checkpoint, and every tag it hands
+        # downstream is recorded for the sink-duplicate audit
+        self.sink = None
+        self.sink_tags: dict = {}
+        if config.delivery not in ("at_least_once", "exactly_once"):
+            raise ValueError(
+                f"SoakConfig.delivery must be 'at_least_once' or "
+                f"'exactly_once', got {config.delivery!r}")
+        if config.delivery == "exactly_once":
+            from ..delivery import EXACTLY_ONCE, TransactionalSink
+
+            def _observe(item, epoch, seq):
+                tag = (epoch, seq)
+                self.sink_tags[tag] = self.sink_tags.get(tag, 0) + 1
+
+            self.sink = TransactionalSink(deliver=_observe,
+                                          mode=EXACTLY_ONCE, obs=self.obs)
+            if self.supervisor is not None:
+                self.supervisor.sink = self.sink
         # lifetime accounting across target generations (restarts)
         self.seen = 0
         self.abandoned = 0
@@ -272,12 +325,19 @@ class SoakRunner:
             self.mem_history, cfg.mem_grace_audits,
             cfg.mem_ratchet_audits, cfg.rss_slack_mb * 1e6,
             cfg.objects_slack)
+        if self.sink is not None:
+            findings += check_sink_duplicates(self.sink_tags)
+        if self.supervisor is not None:
+            findings += check_disk_bounded(self.supervisor.dir,
+                                           cfg.keep_checkpoints)
         health = self._probe_healthz()
         row = {"audit": idx, "clock_s": self.clock.now(), "terms": terms,
                "watermark": self.wm_history[-1],
                "ring": target.ring.ring.snapshot(),
                "memory": self.mem_history[-1], "healthz": health,
                "findings": findings}
+        if self.sink is not None:
+            row["delivery"] = self.sink.snapshot()
         self.audits.append(row)
         self.obs.counter(_obs.SOAK_AUDITS).inc()
         self.obs.flight_event(_flight.SOAK_AUDIT, "audit", float(idx))
@@ -320,6 +380,8 @@ class SoakRunner:
     def run(self) -> dict:
         cfg = self.config
         target = self.make_target(cfg, self.obs, self.clock)
+        if self.sink is not None and hasattr(target, "attach_sink"):
+            target.attach_sink(self.sink)
         if cfg.serve_healthz:
             self._server = self.obs.serve(port=0)
         t0 = self.clock.now()
@@ -408,13 +470,22 @@ class SoakRunner:
         # already written keep the pre-crash watermarks as evidence)
         self.wm_history.clear()
         fresh = self.make_target(self.config, self.obs, self.clock)
+        if self.sink is not None and hasattr(fresh, "attach_sink"):
+            fresh.attach_sink(self.sink)
         ckpt = self.supervisor.latest_checkpoint()
         offset = 0
         if ckpt is not None:
             d, offset = ckpt
             fresh.restore(d)
+            if self.sink is not None:
+                # rewind (epoch, seq) numbering to the restored ledger;
+                # the delivered high-water stays — it is the suppression
+                # horizon that keeps the replay exactly-once
+                self.sink.restore(d)
             self.obs.flight_event("restore", os.path.basename(d),
                                   float(offset))
+        elif self.sink is not None:
+            self.sink.restore(None)
         return fresh, offset
 
     # -- artifacts ---------------------------------------------------------
@@ -431,6 +502,8 @@ class SoakRunner:
                 "chunk_records": self.config.chunk_records,
                 "audit_every_s": self.config.audit_every_s,
                 "seed": self.config.seed,
+                "delivery": self.config.delivery,
+                "keep_checkpoints": self.config.keep_checkpoints,
                 "ring": {"depth": self.config.ring.depth,
                          "block_size": self.config.ring.block_size,
                          "policy": self.config.ring.policy},
@@ -446,6 +519,11 @@ class SoakRunner:
             "findings": self.findings,
             "healthz": self.healthz_history,
             "counters": self.obs.snapshot(),
+            "delivery": None if self.sink is None else {
+                **self.sink.snapshot(),
+                "tags_observed": len(self.sink_tags),
+                "tags_duplicated": sum(
+                    1 for c in self.sink_tags.values() if c > 1)},
         }
 
     def _write_artifacts(self, report: dict) -> None:

@@ -18,13 +18,21 @@ violation can never ride out an hours-long run unnoticed.
   a window of ``ratchet_audits`` consecutive strictly-increasing
   readings past the grace window whose total growth exceeds the slack is
   a leak signature, reported with the trend values.
+* :func:`check_sink_duplicates` (ISSUE 8) — every ``(epoch, seq)`` tag
+  the exactly-once sink delivered downstream was observed AT MOST once
+  across all restarts; a re-delivered tag is a duplicate the suppression
+  horizon failed to catch, named exactly.
+* :func:`check_disk_bounded` (ISSUE 8) — the checkpoint directory holds
+  no more generations than the Supervisor's retention policy
+  (``keep_checkpoints``) allows: an hours-long soak must not grow disk
+  the way PR 7's ratchet forbids growing RSS.
 """
 
 from __future__ import annotations
 
 import gc
 import os
-from typing import List, Optional
+from typing import List, Mapping, Optional, Tuple
 
 
 def rss_bytes() -> int:
@@ -89,6 +97,46 @@ def check_ring_bounded(snapshot: dict) -> List[dict]:
                 "detail": (f"ring {key}={snapshot[key]} exceeds the "
                            f"configured bound depth*block_size={bound}")})
     return findings
+
+
+def check_sink_duplicates(tag_counts: Mapping[Tuple[int, int], int]
+                          ) -> List[dict]:
+    """``tag_counts`` maps each ``(epoch, seq)`` tag the sink handed
+    downstream to how many times it was observed. Any tag observed more
+    than once is a duplicate that reached the consumer — the exact
+    failure the exactly-once ledger exists to prevent; the finding names
+    the worst offenders so the postmortem can be lined up against the
+    flight ring's ``emit``/``duplicate_suppressed`` events."""
+    dupes = {t: c for t, c in tag_counts.items() if c > 1}
+    if not dupes:
+        return []
+    worst = sorted(dupes.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    return [{
+        "invariant": "sink_duplicates",
+        "detail": (f"{len(dupes)} (epoch, seq) tag(s) delivered more "
+                   f"than once — worst: "
+                   + ", ".join(f"{t} x{c}" for t, c in worst))}]
+
+
+def check_disk_bounded(ckpt_dir: str, keep_checkpoints: int) -> List[dict]:
+    """The checkpoint-dir disk ratchet: committed generations must stay
+    within the Supervisor's retention policy (GC bounds them after every
+    commit; more on disk than ``keep_checkpoints`` means GC stopped
+    working and an hours-long soak grows disk without bound). Stale
+    ``*.tmp`` staging dirs are NOT findings here — one may legitimately
+    exist between a crashed save and the next commit's sweep; fsck
+    flags the long-lived ones."""
+    from ..utils.checkpoint import list_generations
+
+    # oldest-first (the Supervisor's scan, reversed) for the evidence
+    gens = list(reversed(list_generations(ckpt_dir)))
+    if len(gens) <= keep_checkpoints:
+        return []
+    return [{
+        "invariant": "disk_bounded",
+        "detail": (f"{len(gens)} checkpoint generations on disk exceed "
+                   f"the retention policy keep_checkpoints="
+                   f"{keep_checkpoints}: {gens}")}]
 
 
 def check_memory_ratchet(history: List[dict], grace_audits: int,

@@ -6,16 +6,252 @@ the pluggable StateFactory (state/.../StateFactory.java:5-12). The TPU build
 exceeds that cheaply (SURVEY.md §5): the engine's entire operator state is a
 pytree of device arrays + a handful of host scalars, so a snapshot is one
 orbax (or numpy-npz fallback) write.
+
+Integrity (ISSUE 8): every byte a bundle commits flows through
+:mod:`scotty_tpu.utils.fsio` (fault-injectable, intent-digest-recording),
+``meta.json`` carries per-leaf sha256 digests, and
+:func:`finalize_checkpoint` seals the bundle with a ``MANIFEST.json`` of
+per-file digests + one whole-bundle digest. :func:`verify_checkpoint`
+re-derives everything on restore and raises
+:class:`CheckpointIntegrityError` naming the corrupt file, the corrupt
+LEAF inside a state file when it can be isolated, and whether the bundle
+or the manifest is the corrupt half — instead of the garbage restore or
+opaque shape error a bit-flipped snapshot used to produce. The restore
+entry points verify automatically whenever a manifest is present
+(pre-integrity bundles restore as before).
 """
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import pickle
-from typing import Any
+from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from . import fsio
+
+#: the integrity manifest inside a committed bundle
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_SCHEMA = "scotty_tpu.ckpt_manifest/1"
+
+
+class CheckpointIntegrityError(ValueError):
+    """A checkpoint bundle failed digest verification. The message names
+    the corrupt file, the corrupt leaf when it can be isolated, which
+    half (bundle vs manifest) failed, and the lineage position tried —
+    everything a 3 a.m. triage needs. Fields mirror the message for
+    programmatic handling (the Supervisor's lineage fallback reads
+    them)."""
+
+    def __init__(self, path: str, detail: str, *, file: Optional[str] = None,
+                 leaf: Optional[str] = None, half: str = "bundle",
+                 lineage_pos: Optional[int] = None):
+        self.path = path
+        self.file = file
+        self.leaf = leaf
+        self.half = half               # "bundle" | "manifest"
+        self.lineage_pos = lineage_pos
+        where = f" [lineage position {lineage_pos}: " \
+                f"{os.path.basename(path)}]" if lineage_pos is not None \
+                else f" [{os.path.basename(path)}]"
+        super().__init__(
+            f"checkpoint integrity: {detail} "
+            f"(the {half} is the corrupt half){where}")
+
+
+def _write_json(path: str, obj: dict) -> None:
+    """Bundle JSON writer: fsio-routed so the fault hook sees it and the
+    intent digest lands in the manifest."""
+    fsio.write_bytes(path, json.dumps(obj).encode())
+
+
+def _write_npz(path: str, leaves: List) -> List[str]:
+    """Bundle npz writer (fsio-routed via an in-memory zip); returns the
+    per-LEAF sha256 digests the caller records in ``meta.json`` — the
+    seam that lets verification name WHICH leaf a corruption hit."""
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    fsio.write_bytes(path, buf.getvalue())
+    return [fsio.digest_bytes(np.ascontiguousarray(a).tobytes())
+            for a in arrays.values()]
+
+
+def finalize_checkpoint(path: str) -> dict:
+    """Seal a bundle directory with its integrity manifest: one sha256
+    per file (the INTENT digest when the file was written through fsio —
+    a silent short write can therefore never be blessed — else the disk
+    bytes), plus a whole-bundle digest binding the file set. Called by
+    the Supervisor at commit time, after every sidecar has landed."""
+    files: Dict[str, dict] = {}
+    for root, _dirs, names in os.walk(path):
+        for name in sorted(names):
+            if name == MANIFEST_NAME:
+                continue
+            fpath = os.path.join(root, name)
+            rel = os.path.relpath(fpath, path)
+            digest = fsio.recorded_digest(fpath)
+            # "bytes" is the INTENT length like the digest is the intent
+            # digest: against a silent short write, the on-disk size
+            # would erase the very size-mismatch clue verify reports
+            nbytes = fsio.recorded_nbytes(fpath)
+            if digest is None:
+                with open(fpath, "rb") as f:
+                    data = f.read()
+                digest = fsio.digest_bytes(data)
+                nbytes = len(data)
+            files[rel] = {"sha256": digest, "bytes": nbytes}
+    bundle = fsio.digest_bytes("\n".join(
+        f"{name}:{entry['sha256']}" for name, entry in
+        sorted(files.items())).encode())
+    manifest = {"schema": MANIFEST_SCHEMA, "files": files,
+                "bundle": bundle}
+    _write_json(os.path.join(path, MANIFEST_NAME), manifest)
+    fsio.prune_missing()            # crashed earlier commits' leftovers
+    return manifest
+
+
+def _name_corrupt_leaf(path: str, state_file: str) -> Optional[str]:
+    """Isolate WHICH leaf of a corrupt state file diverged, using the
+    per-leaf digests ``meta.json`` recorded at save time. Reads each
+    ``leaf_<i>.npy`` payload STRAIGHT out of the zip archive (bypassing
+    the CRC gate — a flipped payload byte would otherwise raise before
+    any digest could be compared; np.savez stores uncompressed, so the
+    raw member bytes ARE the npy). None when the file is too torn to
+    open — then the file-level finding stands alone."""
+    import struct
+    import zipfile
+
+    meta_path = os.path.join(path, "meta.json")
+    try:
+        with open(meta_path) as f:
+            expected = json.load(f).get("leaf_sha256")
+        if not expected:
+            return None
+        fpath = os.path.join(path, state_file)
+        zf = zipfile.ZipFile(fpath)
+        with open(fpath, "rb") as f:
+            for i, want in enumerate(expected):
+                key = f"leaf_{i}"
+                try:
+                    info = zf.getinfo(key + ".npy")
+                except KeyError:
+                    return f"{key} (missing from the archive)"
+                # payload offset comes from the LOCAL header (name/extra
+                # lengths there may differ from the central directory's)
+                f.seek(info.header_offset + 26)
+                nlen, elen = struct.unpack("<HH", f.read(4))
+                f.seek(info.header_offset + 30 + nlen + elen)
+                payload = f.read(info.compress_size)
+                try:
+                    arr = np.lib.format.read_array(io.BytesIO(payload),
+                                                   allow_pickle=False)
+                    got = fsio.digest_bytes(
+                        np.ascontiguousarray(arr).tobytes())
+                except Exception:   # noqa: BLE001 — header torn too
+                    return f"{key} (torn npy payload)"
+                if got != want:
+                    return key
+    except Exception:   # noqa: BLE001 — torn beyond leaf isolation
+        return None
+    return None
+
+
+def verify_checkpoint(path: str, lineage_pos: Optional[int] = None) -> dict:
+    """Verify a bundle against its manifest. Returns a report dict
+    (``{"ok": True, "files": n}``; ``ok=None`` with a reason for
+    pre-integrity bundles without a manifest). Raises
+    :class:`CheckpointIntegrityError` naming the corrupt file/leaf and
+    half on the first verification failure."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isdir(path):
+        raise CheckpointIntegrityError(
+            path, f"bundle directory {path} does not exist",
+            lineage_pos=lineage_pos)
+    if not os.path.exists(mpath):
+        return {"ok": None,
+                "reason": "no manifest (pre-integrity bundle); "
+                          "file digests cannot be checked"}
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if manifest.get("schema") != MANIFEST_SCHEMA:
+            raise ValueError(f"schema={manifest.get('schema')!r}")
+        files = manifest["files"]
+        recorded_bundle = manifest["bundle"]
+    except Exception as e:
+        raise CheckpointIntegrityError(
+            path, f"{MANIFEST_NAME} is unreadable/torn ({e})",
+            file=MANIFEST_NAME, half="manifest",
+            lineage_pos=lineage_pos) from e
+    bundle = fsio.digest_bytes("\n".join(
+        f"{name}:{entry['sha256']}" for name, entry in
+        sorted(files.items())).encode())
+    if bundle != recorded_bundle:
+        raise CheckpointIntegrityError(
+            path, "whole-bundle digest mismatch — the manifest's file "
+            "table was altered after sealing", file=MANIFEST_NAME,
+            half="manifest", lineage_pos=lineage_pos)
+    for name, entry in sorted(files.items()):
+        fpath = os.path.join(path, name)
+        if not os.path.exists(fpath):
+            raise CheckpointIntegrityError(
+                path, f"{name} is missing from the bundle", file=name,
+                lineage_pos=lineage_pos)
+        with open(fpath, "rb") as f:
+            got = fsio.digest_bytes(f.read())
+        if got == entry["sha256"]:
+            continue
+        size = os.path.getsize(fpath)
+        detail = f"{name} failed digest verification " \
+                 f"({size} bytes on disk, {entry['bytes']} committed)"
+        leaf = None
+        if name.endswith(".npz"):
+            leaf = _name_corrupt_leaf(path, name)
+            if leaf is not None:
+                detail = f"{name} {leaf} failed digest verification"
+            elif size < entry["bytes"]:
+                detail = f"{name} is torn/short " \
+                         f"({size}/{entry['bytes']} bytes)"
+        raise CheckpointIntegrityError(path, detail, file=name,
+                                       leaf=leaf,
+                                       lineage_pos=lineage_pos)
+    return {"ok": True, "files": len(files)}
+
+
+def _verify_before_restore(path: str) -> None:
+    """Restore-side integrity gate: sealed bundles verify before a
+    single leaf is trusted; pre-integrity bundles pass through (their
+    only guards remain the shape/treedef checks)."""
+    if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+        verify_checkpoint(path)
+
+
+def list_generations(root: str) -> List[str]:
+    """Committed ``ckpt-<pos>`` bundle dir NAMES under ``root``,
+    newest-first by position — the one generation scan the Supervisor's
+    lineage walk, ``obs fsck`` and the soak disk ratchet all share, so a
+    bundle-naming change can never make them disagree about what is on
+    disk. Staging leftovers (any name containing ``.tmp``) and plain
+    files are excluded."""
+    if not os.path.isdir(root):
+        return []
+    gens = []
+    for name in os.listdir(root):
+        if not name.startswith("ckpt-") or ".tmp" in name:
+            continue
+        if not os.path.isdir(os.path.join(root, name)):
+            continue
+        try:
+            pos = int(name.split("-", 1)[1])
+        except ValueError:
+            pos = -1
+        gens.append((pos, name))
+    gens.sort(key=lambda t: t[0], reverse=True)
+    return [name for _, name in gens]
 
 
 def _device_copy(tree):
@@ -110,26 +346,29 @@ def save_engine_operator(op, path: str) -> None:
     if not op._built:
         raise ValueError("operator not built yet; nothing to checkpoint")
     leaves = jax.tree.flatten(_full_state(op))[0]
-    np.savez(os.path.join(path, "state.npz"),
-             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    leaf_digests = _write_npz(os.path.join(path, "state.npz"), leaves)
     meta = {
         "last_watermark": op._last_watermark,
         "max_lateness": op.max_lateness,
         "max_fixed_window_size": op.max_fixed_window_size,
         "n_leaves": len(leaves),
+        "leaf_sha256": leaf_digests,
         **_host_clocks(op),
     }
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f)
+    _write_json(os.path.join(path, "meta.json"), meta)
 
 
-def restore_engine_operator(op, path: str) -> None:
+def restore_engine_operator(op, path: str, verify: bool = True) -> None:
     """Restore a snapshot into a freshly-configured TpuWindowOperator (same
-    windows/aggregations/config as at save time)."""
+    windows/aggregations/config as at save time). ``verify=False`` skips
+    the manifest gate for callers that already verified this bundle
+    (the Supervisor's lineage walk) — never for direct restores."""
     import jax
 
     if not op._built:
         op._build()
+    if verify:
+        _verify_before_restore(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     data = np.load(os.path.join(path, "state.npz"))
@@ -170,11 +409,12 @@ def save_engine_operator_orbax(op, path: str) -> None:
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(os.path.join(os.path.abspath(path), "orbax"),
                _full_state(op), force=True)
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({"last_watermark": op._last_watermark,
-                   "max_lateness": op.max_lateness,
-                   "max_fixed_window_size": op.max_fixed_window_size,
-                   "orbax": True, **_host_clocks(op)}, f)
+    fsio.write_bytes(
+        os.path.join(path, "meta.json"),
+        json.dumps({"last_watermark": op._last_watermark,
+                    "max_lateness": op.max_lateness,
+                    "max_fixed_window_size": op.max_fixed_window_size,
+                    "orbax": True, **_host_clocks(op)}).encode())
 
 
 def restore_engine_operator_orbax(op, path: str) -> None:
@@ -200,11 +440,13 @@ def save_host_operator(op, path: str) -> None:
     contexts, clocks) pickles — the StateFactory seam keeps it in plain
     Python containers (state/.../memory/*)."""
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "host_operator.pkl"), "wb") as f:
-        pickle.dump(op, f)
+    fsio.write_bytes(os.path.join(path, "host_operator.pkl"),
+                     pickle.dumps(op))
 
 
-def restore_host_operator(path: str):
+def restore_host_operator(path: str, verify: bool = True):
+    if verify:
+        _verify_before_restore(path)
     with open(os.path.join(path, "host_operator.pkl"), "rb") as f:
         return pickle.load(f)
 
@@ -228,26 +470,28 @@ def save_keyed_operator(op, path: str) -> None:
         raise ValueError("flush pending rounds (process a watermark) "
                          "before checkpointing")
     leaves = jax.tree.flatten(op._state)[0]
-    np.savez(os.path.join(path, "keyed_state.npz"),
-             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({
-            "kind": "keyed", "n_keys": op.n_keys,
-            "last_watermark": op._last_watermark,
-            "max_lateness": op.max_lateness,
-            "max_fixed_window_size": op.max_fixed_window_size,
-            "host_met": op._host_met,
-            "n_leaves": len(leaves),
-        }, f)
+    leaf_digests = _write_npz(os.path.join(path, "keyed_state.npz"),
+                              leaves)
+    _write_json(os.path.join(path, "meta.json"), {
+        "kind": "keyed", "n_keys": op.n_keys,
+        "last_watermark": op._last_watermark,
+        "max_lateness": op.max_lateness,
+        "max_fixed_window_size": op.max_fixed_window_size,
+        "host_met": op._host_met,
+        "n_leaves": len(leaves),
+        "leaf_sha256": leaf_digests,
+    })
 
 
-def restore_keyed_operator(op, path: str) -> None:
+def restore_keyed_operator(op, path: str, verify: bool = True) -> None:
     """Restore into a freshly-configured KeyedTpuWindowOperator (same
     windows/aggregations/config/n_keys as at save time)."""
     import jax
 
     if not op._built:
         op._build()
+    if verify:
+        _verify_before_restore(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     if meta.get("kind") != "keyed" or meta["n_keys"] != op.n_keys:
@@ -304,23 +548,27 @@ def save_pipeline(p, path: str) -> None:
         raise ValueError(
             f"{type(p).__name__} keeps no state under .state/.sess_states "
             "— this pipeline class is not checkpointable via save_pipeline")
-    np.savez(os.path.join(path, "pipeline_state.npz"),
-             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({
-            "kind": "pipeline", "cls": type(p).__name__,
-            "interval": int(p._interval), "seed": int(p.seed),
-            "root": np.asarray(p._root).tolist(),
-            "n_leaves": len(leaves),
-        }, f)
+    leaf_digests = _write_npz(os.path.join(path, "pipeline_state.npz"),
+                              leaves)
+    _write_json(os.path.join(path, "meta.json"), {
+        "kind": "pipeline", "cls": type(p).__name__,
+        "interval": int(p._interval), "seed": int(p.seed),
+        "root": np.asarray(p._root).tolist(),
+        "n_leaves": len(leaves),
+        "leaf_sha256": leaf_digests,
+    })
 
 
-def restore_pipeline(p, path: str) -> None:
+def restore_pipeline(p, path: str, verify: bool = True) -> None:
     """Restore into a freshly-CONSTRUCTED pipeline of the same class and
-    constructor arguments (windows/aggs/throughput/seed/...)."""
+    constructor arguments (windows/aggs/throughput/seed/...).
+    ``verify=False`` skips the manifest gate for callers that already
+    verified this bundle (the Supervisor's lineage walk)."""
     import jax
     import jax.numpy as jnp
 
+    if verify:
+        _verify_before_restore(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     if meta.get("kind") != "pipeline" or meta["cls"] != type(p).__name__:

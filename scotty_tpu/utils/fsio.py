@@ -1,0 +1,177 @@
+"""Fault-injectable file I/O for the checkpoint/ledger commit paths.
+
+Every byte a checkpoint bundle commits to disk flows through this module
+so that (a) the **intent digest** — the sha256 of the bytes the caller
+*meant* to write — is recorded as the write happens (a silent short
+write can therefore never produce a manifest that blesses the corrupt
+file: the manifest records what should be on disk, not what landed) and
+(b) the crash-point fuzzer (:class:`scotty_tpu.resilience.chaos.
+CrashPlan`) can interpose on every ``write``/``fsync``/``replace``
+*inside* checkpoint commit — torn writes, short writes, ENOSPC, or a
+plain crash-before-the-op — without monkeypatching the interpreter.
+
+The hook seam is one module-level callable::
+
+    hook(op: str, path: str) -> Optional[str]
+
+``op`` is ``"write"`` / ``"fsync"`` / ``"replace"``. The hook may raise
+(a crash at the site, before the operation touches disk) or return a
+fault action this module enacts:
+
+==========  ==============================================================
+``torn``    write roughly half the bytes, flush, then raise
+            :class:`InjectedFsFault` — the classic torn write
+``short``   write roughly half the bytes and RETURN NORMALLY — the silent
+            short write nobody notices until a later restore
+``enospc``  write half, then raise ``OSError(ENOSPC)`` — disk full
+==========  ==============================================================
+
+Production runs never set a hook; the only cost is one sha256 per
+committed file (checkpoint commits are rare and MB-sized).
+"""
+
+from __future__ import annotations
+
+import errno
+import hashlib
+import os
+from typing import Callable, Dict, Optional
+
+#: fault actions a hook may return (module docstring)
+TORN = "torn"
+SHORT = "short"
+ENOSPC = "enospc"
+
+
+class InjectedFsFault(OSError):
+    """The torn-write crash signal: raised mid-write after partial bytes
+    landed, so tests and supervisors can tell an injected torn write
+    from a real I/O error."""
+
+
+_hook: Optional[Callable[[str, str], Optional[str]]] = None
+
+#: intent ``(sha256, nbytes)`` of files written through
+#: :func:`write_bytes`, keyed by absolute path — what :func:`scotty_tpu.
+#: utils.checkpoint.finalize_checkpoint` folds into the bundle manifest.
+#: Both halves are the INTENT (the bytes the caller meant to write), so
+#: a faulted short write can neither bless its digest nor erase the
+#: size-mismatch clue. Boundedness: rewrites of the same path re-key
+#: their entry, :func:`replace` follows an entry to its destination, and
+#: finalize calls :func:`prune_missing` to drop entries whose files a
+#: crashed commit deleted — the registry stays bounded by the distinct
+#: live paths of committed files.
+_intent_digests: Dict[str, tuple] = {}
+
+
+def set_fault_hook(hook: Optional[Callable[[str, str], Optional[str]]]
+                   ) -> Optional[Callable]:
+    """Install (or clear, with None) the fault hook; returns the previous
+    one so chaos harnesses can nest/restore."""
+    global _hook
+    prev = _hook
+    _hook = hook
+    return prev
+
+
+def _consult(op: str, path: str) -> Optional[str]:
+    return _hook(op, path) if _hook is not None else None
+
+
+def digest_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def recorded_digest(path: str) -> Optional[str]:
+    """The intent digest of ``path`` if it was written through this
+    module and not yet consumed by a finalize."""
+    entry = _intent_digests.get(os.path.abspath(path))
+    return entry[0] if entry is not None else None
+
+
+def recorded_nbytes(path: str) -> Optional[int]:
+    """The intent LENGTH of ``path`` (``len`` of the bytes the caller
+    meant to write — never the post-fault on-disk size)."""
+    entry = _intent_digests.get(os.path.abspath(path))
+    return entry[1] if entry is not None else None
+
+
+def write_bytes(path: str, data: bytes, fsync: bool = True) -> str:
+    """Write ``data`` to ``path`` (subject to the fault hook), record and
+    return the INTENT digest — the sha256 of ``data`` itself, never of
+    what a faulted write left behind."""
+    action = _consult("write", path)
+    digest = digest_bytes(data)
+    _intent_digests[os.path.abspath(path)] = (digest, len(data))
+    if action in (TORN, SHORT, ENOSPC):
+        part = data[: max(0, len(data) // 2)]
+        with open(path, "wb") as f:
+            f.write(part)
+            f.flush()
+        if action == TORN:
+            raise InjectedFsFault(
+                f"injected torn write: {path} got {len(part)}/{len(data)} "
+                "bytes")
+        if action == ENOSPC:
+            raise OSError(errno.ENOSPC, "injected ENOSPC (disk full)",
+                          path)
+        return digest                        # SHORT: silent corruption
+    with open(path, "wb") as f:
+        f.write(data)
+        if fsync:
+            f.flush()
+            fsync_file(f)
+    return digest
+
+
+def fsync_file(fobj) -> None:
+    """fsync an open file object (subject to the fault hook)."""
+    action = _consult("fsync", getattr(fobj, "name", "<file>"))
+    if action is not None:
+        # any returned action at an fsync site means "the fsync failed":
+        # model it as the I/O error fsync actually raises on a dying disk
+        raise OSError(errno.EIO, "injected fsync failure",
+                      getattr(fobj, "name", "<file>"))
+    os.fsync(fobj.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a DIRECTORY — what makes a rename (and the
+    entries inside a just-renamed bundle dir) durable across power loss,
+    not just process death. Platforms that refuse ``open(dir)`` lose
+    only the power-loss guarantee, never the commit itself."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def replace(src: str, dst: str) -> None:
+    """``os.replace`` (subject to the fault hook) — the atomic commit
+    point of every checkpoint/pointer flip. The renamed entries and the
+    rename itself are made durable with directory fsyncs (power loss
+    after this returns cannot un-commit). Follows the intent digest
+    from ``src`` to ``dst`` so a finalize after the rename still finds
+    it."""
+    _consult("replace", dst)                 # hook may raise = crash
+    if os.path.isdir(src):
+        fsync_dir(src)                       # bundle entries, pre-rename
+    os.replace(src, dst)
+    fsync_dir(os.path.dirname(os.path.abspath(dst)))
+    d = _intent_digests.pop(os.path.abspath(src), None)
+    if d is not None:
+        _intent_digests[os.path.abspath(dst)] = d
+
+
+def prune_missing() -> None:
+    """Drop intent-digest entries whose files no longer exist (crashed
+    commits leave a few behind; finalize calls this to keep the registry
+    bounded)."""
+    for p in [p for p in _intent_digests if not os.path.exists(p)]:
+        _intent_digests.pop(p, None)

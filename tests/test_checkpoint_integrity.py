@@ -1,0 +1,438 @@
+"""Checkpoint integrity + lineage (ISSUE 8): digest manifests sealed at
+commit, restore-time verification naming the corrupt file/LEAF and which
+half (bundle vs manifest) failed, torn/short/ENOSPC faults injected
+through the fsio shim, the Supervisor's lineage fallback past corrupt
+generations, retention GC, the startup tmp sweep, and the
+``python -m scotty_tpu.obs fsck`` verifier CLI."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.connectors.base import (AscendingWatermarks,
+                                        KeyedScottyWindowOperator)
+from scotty_tpu.delivery import EXACTLY_ONCE, TransactionalSink, run_supervised
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.operator import TpuWindowOperator
+from scotty_tpu.obs import FlightRecorder, Observability
+from scotty_tpu.resilience import ManualClock, Supervisor
+from scotty_tpu.utils import fsio
+from scotty_tpu.utils.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointIntegrityError,
+    finalize_checkpoint,
+    restore_engine_operator,
+    save_engine_operator,
+    verify_checkpoint,
+)
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=256, batch_size=16, annex_capacity=16,
+                   min_trigger_pad=32)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_fault_hook():
+    yield
+    fsio.set_fault_hook(None)
+
+
+def built_operator():
+    op = TpuWindowOperator(config=CFG)
+    op.add_window_assigner(TumblingWindow(Time, 100))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(100)
+    op.process_elements(np.arange(16, dtype=np.float32),
+                        np.arange(16, dtype=np.int64) * 10)
+    return op
+
+
+def sealed_bundle(tmp_path, name="b"):
+    d = os.path.join(str(tmp_path), name)
+    os.makedirs(d, exist_ok=True)
+    save_engine_operator(built_operator(), d)
+    finalize_checkpoint(d)
+    return d
+
+
+def _flip_bytes(path, offset=12, junk=b"\xde\xad\xbe\xef"):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        f.write(junk)
+
+
+# -- verification ------------------------------------------------------------
+
+def test_sealed_bundle_verifies(tmp_path):
+    d = sealed_bundle(tmp_path)
+    report = verify_checkpoint(d)
+    assert report["ok"] is True and report["files"] >= 2
+
+
+def test_pre_integrity_bundle_is_unverifiable_not_fatal(tmp_path):
+    d = sealed_bundle(tmp_path)
+    os.remove(os.path.join(d, MANIFEST_NAME))
+    report = verify_checkpoint(d)
+    assert report["ok"] is None
+    assert "no manifest" in report["reason"]
+    # ...and restores exactly as before the integrity layer existed
+    restore_engine_operator(built_operator(), d)
+
+
+def test_corrupt_leaf_named_in_error(tmp_path):
+    """A bit-flip inside state.npz names the FILE, the corrupt LEAF, the
+    half, and the lineage position — not a generic shape error."""
+    d = sealed_bundle(tmp_path)
+    # flip bytes inside the npz member payload region
+    _flip_bytes(os.path.join(d, "state.npz"), offset=200)
+    with pytest.raises(CheckpointIntegrityError) as ei:
+        verify_checkpoint(d, lineage_pos=2)
+    msg = str(ei.value)
+    assert "state.npz" in msg
+    assert "leaf_" in msg                       # the corrupt leaf isolated
+    assert "bundle is the corrupt half" in msg
+    assert "lineage position 2" in msg
+    assert ei.value.file == "state.npz"
+    assert ei.value.leaf is not None
+    # the restore path hits the same gate
+    with pytest.raises(CheckpointIntegrityError, match="state.npz"):
+        restore_engine_operator(built_operator(), d)
+
+
+def test_truncated_state_reports_torn_short(tmp_path):
+    d = sealed_bundle(tmp_path)
+    p = os.path.join(d, "state.npz")
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(CheckpointIntegrityError,
+                       match=r"torn/short \(\d+/\d+ bytes\)"):
+        verify_checkpoint(d)
+
+
+def test_torn_manifest_blames_the_manifest_half(tmp_path):
+    d = sealed_bundle(tmp_path)
+    p = os.path.join(d, MANIFEST_NAME)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(CheckpointIntegrityError,
+                       match="manifest is the corrupt half") as ei:
+        verify_checkpoint(d)
+    assert ei.value.half == "manifest"
+    assert "unreadable/torn" in str(ei.value)
+
+
+def test_tampered_manifest_table_fails_bundle_digest(tmp_path):
+    d = sealed_bundle(tmp_path)
+    p = os.path.join(d, MANIFEST_NAME)
+    with open(p) as f:
+        m = json.load(f)
+    next(iter(m["files"].values()))["sha256"] = "0" * 64
+    with open(p, "w") as f:
+        json.dump(m, f)
+    with pytest.raises(CheckpointIntegrityError,
+                       match="file table was altered after sealing"):
+        verify_checkpoint(d)
+
+
+def test_missing_file_named(tmp_path):
+    d = sealed_bundle(tmp_path)
+    os.remove(os.path.join(d, "meta.json"))
+    with pytest.raises(CheckpointIntegrityError,
+                       match="meta.json is missing from the bundle"):
+        verify_checkpoint(d)
+
+
+def test_silent_short_write_cannot_be_blessed(tmp_path):
+    """The intent-digest property: a SHORT write through fsio leaves the
+    manifest recording what SHOULD be on disk, so the seal itself can
+    never bless the corrupt bytes."""
+    d = os.path.join(str(tmp_path), "b")
+    os.makedirs(d)
+
+    def short_once(op, path):
+        if op == "write" and path.endswith("state.npz"):
+            return fsio.SHORT
+        return None
+
+    fsio.set_fault_hook(short_once)
+    try:
+        save_engine_operator(built_operator(), d)
+    finally:
+        fsio.set_fault_hook(None)
+    finalize_checkpoint(d)
+    with pytest.raises(CheckpointIntegrityError, match="state.npz"):
+        verify_checkpoint(d)
+
+
+def test_enospc_during_save_propagates(tmp_path):
+    d = os.path.join(str(tmp_path), "b")
+    os.makedirs(d)
+    fsio.set_fault_hook(
+        lambda op, path: fsio.ENOSPC if op == "write" else None)
+    with pytest.raises(OSError, match="injected ENOSPC"):
+        save_engine_operator(built_operator(), d)
+
+
+# -- supervisor lineage ------------------------------------------------------
+
+def make_conn_op(obs=None):
+    return KeyedScottyWindowOperator(
+        windows=[TumblingWindow(Time, 100)],
+        aggregations=[SumAggregation()],
+        watermark_policy=AscendingWatermarks(), obs=obs)
+
+
+def committed_lineage(tmp_path, obs=None, n=100, every=25):
+    """A supervisor dir with several committed generations + a sink."""
+    sup = Supervisor(str(tmp_path), clock=ManualClock(), obs=obs,
+                     keep_checkpoints=3)
+    sink = TransactionalSink(mode=EXACTLY_ONCE, obs=obs)
+    records = [(f"k{i % 3}", float(i), i * 10) for i in range(n)]
+    out = run_supervised(records, make_conn_op, sup, sink=sink,
+                         checkpoint_every=every, final_watermark=10_000)
+    return sup, out
+
+
+def _gens(d):
+    return sorted((n for n in os.listdir(d) if n.startswith("ckpt-")
+                   and ".tmp" not in n),
+                  key=lambda n: int(n.split("-")[1]))
+
+
+def test_lineage_gc_bounds_generations(tmp_path):
+    sup, _ = committed_lineage(tmp_path)     # 4 commits, keep 3
+    assert len(_gens(str(tmp_path))) == 3
+    snap = json.load(open(os.path.join(str(tmp_path), "LATEST.json")))
+    assert snap["dir"] == _gens(str(tmp_path))[-1]
+
+
+def test_corrupted_latest_falls_back_to_lineage(tmp_path):
+    obs = Observability(flight=FlightRecorder(capacity=256))
+    sup, _ = committed_lineage(tmp_path, obs=obs)
+    gens = _gens(str(tmp_path))
+    newest = os.path.join(str(tmp_path), gens[-1])
+    _flip_bytes(os.path.join(newest, "offset.json"), offset=2)
+    ckpt, offset = sup.latest_checkpoint()
+    assert os.path.basename(ckpt) == gens[-2]  # fell back one generation
+    assert offset == int(gens[-2].split("-")[1])
+    snap = obs.snapshot()
+    assert snap["ckpt_integrity_failures"] == 1
+    assert snap["ckpt_lineage_fallbacks"] == 1
+    kinds = [e["kind"] for e in obs.flight.snapshot()["events"]]
+    assert "ckpt_corrupt" in kinds and "lineage_fallback" in kinds
+    # the corrupt generation left a postmortem naming the evidence
+    assert any(n.startswith("postmortem-")
+               for n in os.listdir(str(tmp_path)))
+
+
+def test_all_generations_corrupt_restores_none(tmp_path):
+    sup, _ = committed_lineage(tmp_path)
+    for g in _gens(str(tmp_path)):
+        _flip_bytes(os.path.join(str(tmp_path), g, "offset.json"),
+                    offset=2)
+    assert sup.latest_checkpoint() is None
+
+
+def test_stale_pointer_restores_newest_committed_generation(tmp_path):
+    """A crash between the bundle rename (THE commit point) and the
+    pointer flip leaves LATEST one generation stale. Restores must take
+    the newest generation by POSITION: the stale pointer target's ledger
+    predates emissions the newest bundle already closed, so restoring it
+    re-delivers them to the consumer — exactly-once broken by the
+    supervisor's own bookkeeping."""
+    obs = Observability(flight=FlightRecorder(capacity=256))
+    sup, out1 = committed_lineage(tmp_path, obs=obs)
+    gens = _gens(str(tmp_path))
+    # rewind the pointer one generation, as the crash would leave it
+    with open(os.path.join(str(tmp_path), "LATEST.json"), "w") as f:
+        json.dump({"dir": gens[-2]}, f)
+
+    sup2 = Supervisor(str(tmp_path), clock=ManualClock(),
+                      keep_checkpoints=3)
+    ckpt, offset = sup2.latest_checkpoint()
+    assert os.path.basename(ckpt) == gens[-1]   # newest, not the pointer
+    assert offset == int(gens[-1].split("-")[1])
+
+    # cross-process restart: a FRESH sink restored from the newest
+    # ledger replays nothing — zero re-deliveries of pre-crash output
+    sink = TransactionalSink(mode=EXACTLY_ONCE)
+    records = [(f"k{i % 3}", float(i), i * 10) for i in range(100)]
+    out2 = run_supervised(records, make_conn_op, sup2, sink=sink,
+                          checkpoint_every=25, final_watermark=10_000)
+    assert out2 == []                            # all delivered pre-crash
+    assert sink.suppressed == 0                  # nothing even replayed
+
+
+def test_unverifiable_garbage_newer_than_pointer_distrusted(tmp_path):
+    """The inverse guard: a ``ckpt-<pos>`` dir NEWER than the committed
+    pointer but with no manifest cannot be a stale-pointer commit (a
+    real commit seals its manifest before the rename) — it is foreign
+    garbage and must not be restored."""
+    sup, _ = committed_lineage(tmp_path)
+    gens = _gens(str(tmp_path))
+    torn = os.path.join(str(tmp_path), "ckpt-99999")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "offset.json"), "w") as f:
+        f.write("{not json")
+
+    sup2 = Supervisor(str(tmp_path), clock=ManualClock(),
+                      keep_checkpoints=3)
+    ckpt, _ = sup2.latest_checkpoint()
+    assert os.path.basename(ckpt) == gens[-1]    # garbage skipped
+
+
+def test_supervised_run_recovers_through_corrupt_latest(tmp_path):
+    """End-to-end acceptance: corrupt the newest checkpoint, crash the
+    run, and the recovery restores the older verifying generation —
+    delivered output still bit-matches the uninterrupted oracle."""
+    from scotty_tpu.resilience.chaos import ChaosError
+
+    oracle_dir = os.path.join(str(tmp_path), "oracle")
+    sup = Supervisor(oracle_dir, clock=ManualClock())
+    records = [(f"k{i % 3}", float(i), i * 10) for i in range(100)]
+    oracle = run_supervised(records, make_conn_op, sup,
+                            sink=TransactionalSink(mode=EXACTLY_ONCE),
+                            checkpoint_every=25, final_watermark=10_000)
+
+    crash_dir = os.path.join(str(tmp_path), "crashy")
+    sup2 = Supervisor(crash_dir, clock=ManualClock(), max_restarts=4)
+    sink = TransactionalSink(mode=EXACTLY_ONCE)
+    state = {"armed": True}
+
+    class Source:
+        def __len__(self):
+            return len(records)
+
+        def __getitem__(self, sl):
+            def gen():
+                base = sl.start or 0
+                for i, r in enumerate(records[sl]):
+                    if state["armed"] and base + i == 60:
+                        state["armed"] = False
+                        # corrupt the newest committed generation, then
+                        # crash: recovery MUST verify, fall back, and
+                        # replay further
+                        gens = _gens(crash_dir)
+                        _flip_bytes(os.path.join(
+                            crash_dir, gens[-1], "ledger.json"), offset=2)
+                        raise ChaosError("crash with corrupt latest")
+                    yield r
+
+            return gen()
+
+    out = run_supervised(Source(), make_conn_op, sup2, sink=sink,
+                         checkpoint_every=25, final_watermark=10_000)
+    assert out == oracle
+    assert sink.suppressed > 0               # the deeper replay happened
+
+
+def test_stale_tmps_swept_on_construction_and_commit(tmp_path):
+    d = str(tmp_path)
+    os.makedirs(os.path.join(d, "ckpt-7.tmp"))
+    with open(os.path.join(d, "LATEST.json.tmp"), "w") as f:
+        f.write("{")
+    Supervisor(d, clock=ManualClock())       # the startup sweep
+    assert not [n for n in os.listdir(d) if ".tmp" in n]
+    # ...and a tmp stranded mid-run is swept by the next commit
+    sup = Supervisor(d, clock=ManualClock())
+    os.makedirs(os.path.join(d, "ckpt-9.tmp"))
+    sup.commit_checkpoint(
+        1, lambda p: fsio.write_bytes(os.path.join(p, "x.json"), b"{}"),
+        offset=1)
+    assert not [n for n in os.listdir(d) if ".tmp" in n]
+
+
+def test_torn_latest_pointer_recovers_from_names(tmp_path):
+    sup, _ = committed_lineage(tmp_path)
+    with open(os.path.join(str(tmp_path), "LATEST.json"), "w") as f:
+        f.write('{"di')                      # torn pointer
+    ckpt, offset = sup.latest_checkpoint()
+    assert os.path.basename(ckpt) == _gens(str(tmp_path))[-1]
+
+
+# -- fsck CLI ----------------------------------------------------------------
+
+def test_fsck_clean_dir_exits_zero(tmp_path, capsys):
+    from scotty_tpu.obs.fsck import fsck_main
+
+    committed_lineage(tmp_path)
+    assert fsck_main(str(tmp_path)) == 0
+    out = capsys.readouterr().out
+    assert "verdict: clean" in out
+    assert "ledger epoch=" in out            # ledger heads surfaced
+
+
+def test_fsck_flags_corruption_and_stale_tmp(tmp_path, capsys):
+    from scotty_tpu.obs.fsck import fsck_main
+
+    committed_lineage(tmp_path)
+    gens = _gens(str(tmp_path))
+    _flip_bytes(os.path.join(str(tmp_path), gens[-1], "offset.json"),
+                offset=2)
+    os.makedirs(os.path.join(str(tmp_path), "ckpt-99.tmp"))
+    rc = fsck_main(str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 1                           # findings, but recoverable
+    assert "CORRUPT" in out and "offset.json" in out
+    assert "stale tmp: ckpt-99.tmp" in out
+    assert f"restore would use: {gens[-2]}" in out
+
+
+def test_fsck_pre_integrity_bundles_are_recoverable(tmp_path, capsys):
+    """Pre-integrity bundles (no manifest) DO restore — the Supervisor
+    accepts them unverified — so fsck must exit 1 (recoverable), not 2,
+    and name the generation a restart would actually use."""
+    from scotty_tpu.obs.fsck import fsck_main
+
+    committed_lineage(tmp_path)
+    gens = _gens(str(tmp_path))
+    for g in gens:
+        os.remove(os.path.join(str(tmp_path), g, "MANIFEST.json"))
+    rc = fsck_main(str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert f"restore would use: {gens[-1]}" in out
+    assert "restores UNVERIFIED" in out
+    # and a supervised restart agrees
+    sup = Supervisor(str(tmp_path), clock=ManualClock())
+    ckpt, _ = sup.latest_checkpoint()
+    assert os.path.basename(ckpt) == gens[-1]
+
+
+def test_fsck_nothing_verifies_exits_two(tmp_path, capsys):
+    from scotty_tpu.obs.fsck import fsck_main
+
+    committed_lineage(tmp_path)
+    for g in _gens(str(tmp_path)):
+        shutil.rmtree(os.path.join(str(tmp_path), g))
+    rc = fsck_main(str(tmp_path))
+    assert rc == 2
+    assert "no checkpoint generations found" in capsys.readouterr().out
+
+
+def test_fsck_json_single_bundle(tmp_path, capsys):
+    from scotty_tpu.obs.fsck import fsck_main
+
+    d = sealed_bundle(tmp_path)
+    assert fsck_main(d, as_json=True) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True
+    assert doc["generations"][0]["ok"] is True
+
+
+def test_fsck_cli_entrypoint(tmp_path):
+    import subprocess
+    import sys
+
+    committed_lineage(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "scotty_tpu.obs", "fsck", str(tmp_path)],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "verdict: clean" in r.stdout

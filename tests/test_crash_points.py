@@ -1,0 +1,212 @@
+"""The systematic crash-point sweep (ISSUE 8 tentpole part 3): for EVERY
+enumerated crash site — each flight-event emit point (ingest batches,
+watermarks, drains, emission flushes, epoch commits) plus every
+write/fsync/replace *inside* checkpoint commit with torn/short/ENOSPC
+variants via the fsio shim — crash a fresh run there, recover under the
+Supervisor, and require the delivered sink output be **bit-identical**
+to the uninterrupted oracle: zero duplicates, zero losses, site by site.
+
+Full-site sweeps ride tier-1 for the iterable run loop and the aligned
+pipeline; the kafka/asyncio loops and the session/count pipelines run a
+sampled-site variant (every k-th site) — same oracle discipline, bounded
+wall time."""
+
+import os
+
+from scotty_tpu import obs as _obs
+from scotty_tpu import (HyperLogLogAggregation, SessionWindow,
+                        SlidingWindow, SumAggregation, TumblingWindow,
+                        WindowMeasure)
+from scotty_tpu.connectors.base import (AscendingWatermarks,
+                                        KeyedScottyWindowOperator)
+from scotty_tpu.delivery import (EXACTLY_ONCE, TransactionalSink,
+                                 asyncio_segment, kafka_segment,
+                                 run_supervised)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.resilience import ManualClock, Supervisor
+from scotty_tpu.resilience.chaos import (CrashPlan, CrashSite,
+                                         crash_point_sweep, make_records)
+
+Time, Count = WindowMeasure.Time, WindowMeasure.Count
+CFG = EngineConfig(capacity=1 << 12, batch_size=256, annex_capacity=256,
+                   min_trigger_pad=32)
+
+
+def _fresh_dir(tmp_path, counter=[0]):
+    counter[0] += 1
+    d = os.path.join(str(tmp_path), f"env{counter[0]}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _connector_env_factory(tmp_path, records, run_segment=None,
+                           checkpoint_every=16):
+    """make_env for the supervised connector loops: fresh obs +
+    supervisor + exactly-once sink per run, everything recording through
+    ONE Observability so site enumeration is complete."""
+
+    def make_env():
+        d = _fresh_dir(tmp_path)
+        obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=4096))
+
+        def make_op():
+            return KeyedScottyWindowOperator(
+                windows=[TumblingWindow(Time, 100)],
+                aggregations=[SumAggregation()],
+                watermark_policy=AscendingWatermarks(), obs=obs)
+
+        def run():
+            sup = Supervisor(os.path.join(d, "ck"), clock=ManualClock(),
+                             obs=obs, max_restarts=6, seed=11)
+            sink = TransactionalSink(mode=EXACTLY_ONCE, obs=obs)
+            return run_supervised(records, make_op, sup, sink=sink,
+                                  checkpoint_every=checkpoint_every,
+                                  run_segment=run_segment,
+                                  final_watermark=10_000)
+
+        return obs, run
+
+    return make_env
+
+
+def _assert_green(report, min_sites=1):
+    assert report.sites >= min_sites
+    assert report.fired == report.ran       # every armed site was reached
+    assert report.oracle_len > 0
+    assert report.failures == [], (
+        f"{len(report.failures)} of {report.ran} crash sites broke "
+        f"exactly-once delivery — first: {report.failures[0]}")
+
+
+# -- site enumeration sanity -------------------------------------------------
+
+def test_enumeration_covers_flight_and_fs_with_fault_variants(tmp_path):
+    records = [(f"k{i % 3}", float(i), i * 10) for i in range(48)]
+    make_env = _connector_env_factory(tmp_path, records)
+    obs, run = make_env()
+    sites = CrashPlan().record(obs, run)
+    assert len(sites) >= 40                  # the acceptance floor
+    domains = {s.domain for s in sites}
+    assert domains == {"flight", "fs"}
+    # mid-checkpoint-write sites, with every fault variant
+    fs = [s for s in sites if s.domain == "fs"]
+    assert {s.fault for s in fs if s.kind == "write"} \
+        == {"crash", "torn", "short", "enospc"}
+    assert {s.fault for s in fs if s.kind == "fsync"} == {"crash", "eio"}
+    assert any(s.kind == "replace" for s in fs)
+    names = {s.name for s in fs}
+    assert "MANIFEST.json" in names          # the seal itself is a site
+    assert "ledger.json" in names            # so is the delivery ledger
+    assert any(n.startswith("LATEST.json") for n in names)
+    # emission flushes and watermarks are flight sites
+    kinds = {s.kind for s in sites if s.domain == "flight"}
+    assert "emit" in kinds and "watermark" in kinds
+    assert isinstance(sites[0], CrashSite) and sites[0].label()
+
+
+# -- full-site sweeps (tier-1) -----------------------------------------------
+
+def test_iterable_loop_every_site_exactly_once(tmp_path):
+    """The headline sweep: every enumerated site on the supervised
+    iterable keyed loop, exactly-once sink armed — recovered output must
+    bit-match the uninterrupted oracle at ALL of them."""
+    records = [(f"k{i % 3}", float(i), i * 10) for i in range(48)]
+    report = crash_point_sweep(_connector_env_factory(tmp_path, records))
+    _assert_green(report, min_sites=40)
+
+
+def test_aligned_pipeline_every_site(tmp_path):
+    """Aligned fused pipeline under Supervisor.run_pipeline: the
+    'sink output' is the per-interval lowered result rows — positional,
+    so recovery must neither lose nor double an interval at any site
+    (including mid-checkpoint torn writes and ENOSPC)."""
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    def pipeline_factory(config=None):
+        return AlignedStreamPipeline(
+            [TumblingWindow(Time, 50)], [SumAggregation()],
+            config=config or CFG, throughput=20_000, wm_period_ms=100,
+            max_lateness=100, seed=5, gc_every=10 ** 9,
+            value_scale=1024.0)
+
+    def make_env():
+        d = _fresh_dir(tmp_path)
+        obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=2048))
+
+        def run():
+            sup = Supervisor(os.path.join(d, "ck"), clock=ManualClock(),
+                             obs=obs, checkpoint_every=2, max_restarts=6,
+                             seed=3)
+            return sup.run_pipeline(pipeline_factory, 4)
+
+        return obs, run
+
+    report = crash_point_sweep(make_env)
+    _assert_green(report, min_sites=40)
+
+
+# -- sampled-site sweeps -----------------------------------------------------
+
+def test_kafka_loop_sampled_sites(tmp_path):
+    records = make_records(seed=13, n=96, keys=3)
+    make_env = _connector_env_factory(tmp_path, records,
+                                      run_segment=kafka_segment(),
+                                      checkpoint_every=32)
+    report = crash_point_sweep(make_env, sample_every=7)
+    _assert_green(report)
+
+
+def test_asyncio_loop_sampled_sites(tmp_path):
+    records = [(f"k{i % 3}", float(i), i * 10) for i in range(96)]
+    make_env = _connector_env_factory(tmp_path, records,
+                                      run_segment=asyncio_segment(),
+                                      checkpoint_every=32)
+    report = crash_point_sweep(make_env, sample_every=7)
+    _assert_green(report)
+
+
+def _pipeline_env_factory(tmp_path, factory, n_intervals=4):
+    def make_env():
+        d = _fresh_dir(tmp_path)
+        obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=2048))
+
+        def run():
+            sup = Supervisor(os.path.join(d, "ck"), clock=ManualClock(),
+                             obs=obs, checkpoint_every=2, max_restarts=6,
+                             seed=3)
+            return sup.run_pipeline(factory, n_intervals)
+
+        return obs, run
+
+    return make_env
+
+
+def test_session_pipeline_sampled_sites(tmp_path):
+    from scotty_tpu.engine.session_pipeline import SessionStreamPipeline
+
+    def factory(config=None):
+        return SessionStreamPipeline(
+            [SessionWindow(Time, 300), SlidingWindow(Time, 500, 100)],
+            [HyperLogLogAggregation(6)], config=config or CFG,
+            throughput=20_000, wm_period_ms=100, max_lateness=100,
+            seed=2,
+            session_config={"count": 3, "minGapMs": 300, "maxGapMs": 700})
+
+    report = crash_point_sweep(
+        _pipeline_env_factory(tmp_path, factory), sample_every=9)
+    _assert_green(report)
+
+
+def test_count_pipeline_sampled_sites(tmp_path):
+    from scotty_tpu.engine.count_pipeline import CountStreamPipeline
+
+    def factory(config=None):
+        del config                           # count pipeline owns its config
+        return CountStreamPipeline(
+            [TumblingWindow(Count, 7), TumblingWindow(Time, 50)],
+            [SumAggregation()], throughput=2000, wm_period_ms=100,
+            max_lateness=100, seed=3, out_of_order_pct=0.3)
+
+    report = crash_point_sweep(
+        _pipeline_env_factory(tmp_path, factory), sample_every=9)
+    _assert_green(report)

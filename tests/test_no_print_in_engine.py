@@ -1,11 +1,13 @@
 """Tier-1 lint: the engine core stays silent (ISSUE 1 satellite; extended
 to connectors/ and bench/ in ISSUE 2, serving/ in ISSUE 6, ingest/ and
-soak/ in ISSUE 7), nothing sleeps on the wall clock outside the
-injectable-clock module (ISSUE 3 satellite; serving/ingest/soak are
-covered by the all-of-scotty_tpu sweep), and the obs/ingest/soak layers
-never read the wall clock directly (ISSUE 4 satellite, extended in
-ISSUE 7 — a soak that timed its audits on a bare ``time.time()`` could
-never run deterministically on a ManualClock).
+soak/ in ISSUE 7, delivery/ in ISSUE 8), nothing sleeps on the wall
+clock outside the injectable-clock module (ISSUE 3 satellite;
+serving/ingest/soak are covered by the all-of-scotty_tpu sweep), and the
+obs/ingest/soak/delivery layers never read the wall clock directly
+(ISSUE 4 satellite, extended in ISSUES 7/8 — a soak that timed its
+audits on a bare ``time.time()``, or a delivery ledger that stamped
+epochs off the wall clock, could never run deterministically on a
+ManualClock).
 
 The reference's engine never logs — its only output was the benchmark-side
 throughput logger (SURVEY.md §5). The port preserves that discipline: all
@@ -30,10 +32,10 @@ import scotty_tpu
 
 PKG_ROOT = pathlib.Path(scotty_tpu.__file__).parent
 SILENT_DIRS = ("engine", "core", "connectors", "bench", "serving",
-               "ingest", "soak")
+               "ingest", "soak", "delivery")
 #: packages whose wall-clock reads must route through resilience.clock
 #: (wall_time / the injectable Clock); time.perf_counter stays allowed
-WALLTIME_DIRS = ("obs", "ingest", "soak")
+WALLTIME_DIRS = ("obs", "ingest", "soak", "delivery")
 #: the single module allowed to call time.sleep (SystemClock lives there)
 SLEEP_EXEMPT = PKG_ROOT / "resilience" / "clock.py"
 

@@ -268,3 +268,123 @@ def test_realtime_soak_two_seconds():
         ring=RingConfig(depth=4, block_size=128))).run()
     assert report["passed"]
     assert report["seen"] == 10_240      # ceil over chunk granularity
+
+
+# -- exactly-once delivery + disk invariants (ISSUE 8) -----------------------
+
+
+def test_sink_duplicates_check():
+    from scotty_tpu.soak import check_sink_duplicates
+
+    assert check_sink_duplicates({(0, 0): 1, (0, 1): 1, (1, 2): 1}) == []
+    out = check_sink_duplicates({(0, 0): 1, (0, 1): 3, (1, 2): 2})
+    assert out and out[0]["invariant"] == "sink_duplicates"
+    assert "(0, 1) x3" in out[0]["detail"]      # worst offender named
+    assert "2 (epoch, seq) tag(s)" in out[0]["detail"]
+
+
+def test_disk_bounded_check(tmp_path):
+    from scotty_tpu.soak import check_disk_bounded
+
+    d = str(tmp_path)
+    for pos in (4, 8, 12):
+        os.makedirs(os.path.join(d, f"ckpt-{pos}"))
+    os.makedirs(os.path.join(d, "ckpt-2.tmp"))  # in-flight: never a finding
+    assert check_disk_bounded(d, 3) == []
+    os.makedirs(os.path.join(d, "ckpt-16"))
+    out = check_disk_bounded(d, 3)
+    assert out and out[0]["invariant"] == "disk_bounded"
+    assert "keep_checkpoints=3" in out[0]["detail"]
+    assert "ckpt-16" in out[0]["detail"]        # the evidence named
+
+
+def test_soak_rejects_unknown_delivery_mode():
+    with pytest.raises(ValueError, match="exactly_once"):
+        SoakRunner(_smoke_config(delivery="maybe_once"),
+                   clock=ManualClock())
+
+
+@pytest.mark.soak
+def test_smoke_soak_exactly_once_with_chaos_crashes(tmp_path):
+    """THE ISSUE 8 acceptance soak: exactly-once sink armed, chaos
+    consumer crashes mid-run, duplicate + disk invariants on — zero
+    invariant failures, real suppression (the crashes DID replay), no
+    (epoch, seq) tag delivered twice, checkpoint disk bounded by the
+    retention policy, evidence bundle written."""
+    d = str(tmp_path / "soak")
+    cfg = _smoke_config(
+        delivery="exactly_once", keep_checkpoints=3,
+        checkpoint_every_audits=2,
+        chaos=ChaosMix(late_storm_every=7, poison_pct=0.02,
+                       flaky_every=11, crash_at_chunks=(40, 200)))
+    runner = SoakRunner(cfg, clock=ManualClock(), report_dir=d)
+    report = runner.run()
+    assert report["passed"] and report["findings"] == []
+    assert runner.supervisor.total_restarts == 2      # both crashes hit
+    delivery = report["delivery"]
+    assert delivery["mode"] == "exactly_once"
+    assert delivery["suppressed"] > 0                 # replays happened
+    assert delivery["tags_duplicated"] == 0           # none reached twice
+    assert delivery["emitted"] == delivery["tags_observed"]
+    # the audits carried the delivery snapshot as evidence
+    assert any("delivery" in row for row in report["audits"])
+    # disk stayed within retention (the GC actually ran)
+    ckpt_dir = os.path.join(d, "checkpoints")
+    gens = [n for n in os.listdir(ckpt_dir)
+            if n.startswith("ckpt-") and ".tmp" not in n]
+    assert 0 < len(gens) <= cfg.keep_checkpoints
+    assert os.path.exists(os.path.join(d, "soak_report.json"))
+    assert os.path.exists(os.path.join(d, "flight.json"))
+
+
+def test_soak_sink_duplicate_audit_detects_injected_dupe(tmp_path):
+    """The detection path: a harness that can only pass is not evidence.
+    A duplicated (epoch, seq) tag injected mid-run must fail the soak at
+    the next audit, naming the tag."""
+    d = str(tmp_path / "soak")
+    runner = SoakRunner(
+        _smoke_config(delivery="exactly_once",
+                      checkpoint_every_audits=2),
+        clock=ManualClock(), report_dir=d)
+
+    def inject(r, row):
+        if row["audit"] == 3:
+            tag = next(iter(r.sink_tags))
+            r.sink_tags[tag] += 1            # the consumer saw it twice
+
+    runner.audit_hook = inject
+    report = runner.run()
+    assert not report["passed"]
+    f = [f for f in report["findings"]
+         if f["invariant"] == "sink_duplicates"][0]
+    assert "x2" in f["detail"]               # the tag and its count named
+    assert report["counters"]["soak_invariant_failures"] >= 1
+    # the on-disk evidence bundle carries the same verdict
+    on_disk = json.load(open(os.path.join(d, "soak_report.json")))
+    assert not on_disk["passed"]
+
+
+def test_soak_disk_ratchet_detects_gc_failure(tmp_path):
+    """Simulated GC failure (extra generations appearing on disk) must
+    fail the soak with the offending dirs named. The litter lands right
+    after an audit, so the NEXT audit sees it before any commit's GC
+    could clean it up — exactly how a real GC regression would present."""
+    d = str(tmp_path / "soak")
+    runner = SoakRunner(
+        _smoke_config(delivery="exactly_once", keep_checkpoints=2,
+                      checkpoint_every_audits=4),
+        clock=ManualClock(), report_dir=d)
+
+    def litter(r, row):
+        if row["audit"] == 5:
+            for pos in (9001, 9002, 9003):
+                os.makedirs(os.path.join(r.supervisor.dir,
+                                         f"ckpt-{pos}"), exist_ok=True)
+
+    runner.audit_hook = litter
+    report = runner.run()
+    assert not report["passed"]
+    f = [f for f in report["findings"]
+         if f["invariant"] == "disk_bounded"][0]
+    assert "ckpt-9001" in f["detail"]        # the evidence named
+    assert "keep_checkpoints=2" in f["detail"]
