@@ -1,0 +1,48 @@
+"""Invariant linter: the codebase's hard-won rules as enforced checks.
+
+Every review pass before this package existed re-caught the same
+statically-detectable bug classes by hand: numpy-backed leaves fed to
+donating kernels (the ISSUE 2 checkpoint-restore segfault), committed
+bytes written around :mod:`scotty_tpu.utils.fsio` (ISSUE 8 found three
+such paths by hand), string-literal flight-event kinds (the ISSUE 6
+review finding), host syncs creeping into jitted paths, and silent
+``except``-swallows in the ingest/delivery layers — and every PR
+re-verified "aligned-step HLO hash byte-identical" manually.  This
+package turns those review rituals into tooling, the way LLVM-class
+projects gate merges on clang-tidy-style custom checks:
+
+* :mod:`.core` — the framework: one AST parse per file, a rule
+  registry, per-rule inline suppressions
+  (``# scotty: allow(<rule>) — <reason>``; a reasonless suppression is
+  itself a finding), and a baseline file for grandfathered findings.
+* :mod:`.rules` — the rule set encoding the invariants the repo
+  already bleeds for (``python -m scotty_tpu.analysis check --list``
+  prints the catalog; docs/API.md "Static analysis" maps each rule to
+  the incident that motivated it).
+* :mod:`.hlo` — the canonical aligned/session/count step lowerings and
+  their sha256 pins (``pin-hlo``), ending the manual per-PR
+  "verified byte-identical" ritual: accidental jitted-path drift is a
+  red test, deliberate drift is one ``pin-hlo --update`` with the diff
+  in review.
+* :mod:`.cli` — ``python -m scotty_tpu.analysis check [--rule ...]
+  [--format text|json] [--write-baseline]``; nonzero exit on new
+  findings, so it runs unchanged in CI and inside tier-1
+  (tests/test_analysis.py).
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Project,
+    Rule,
+    RULES,
+    default_root,
+    load_baseline,
+    run_check,
+    write_baseline,
+)
+from . import rules as _rules  # noqa: F401, E402  (populates RULES)
+
+__all__ = [
+    "Finding", "Project", "Rule", "RULES", "default_root",
+    "load_baseline", "run_check", "write_baseline",
+]

@@ -1,0 +1,184 @@
+"""``python -m scotty_tpu.analysis`` — the invariant linter CLI.
+
+Subcommands::
+
+    check   [--rule R]... [--format text|json] [--baseline FILE]
+            [--write-baseline] [--root DIR] [--list]
+        Run the rule set over scotty_tpu/ + tests/ + bench.py.
+        Exit 0: no new findings (suppressed/baselined are reported but
+        don't fail). Exit 1: new findings. ``--write-baseline``
+        grandfathers the current findings into the baseline file and
+        exits 0 — reviewed like any other committed file.
+
+    pin-hlo [--update] [--pins FILE] [--step NAME]...
+        Verify the canonical aligned/session/count step lowerings
+        against tests/hlo_pins.json (exit 1 on drift); ``--update``
+        refreshes the pins — the hash diff rides the commit.
+
+All output flows through an overridable echo sink (the package's own
+no-print rule covers this module too).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+from ..utils import stdout_echo
+from . import rules as _rules  # noqa: F401  (populates the registry)
+from .core import (
+    Project, RULES, SUPPRESSION_FORMAT, default_root, load_baseline,
+    run_check, write_baseline,
+)
+
+#: default baseline location, repo-root-relative (committed; empty on a
+#: clean tree — the mechanism exists for grandfathering future rules)
+BASELINE_PATH = "analysis_baseline.json"
+
+
+def check_main(rule_names=None, fmt: str = "text", root=None,
+               baseline_path=None, write_baseline_flag: bool = False,
+               list_rules: bool = False, echo=None) -> int:
+    if echo is None:
+        echo = stdout_echo
+    if list_rules:
+        for name in sorted(RULES):
+            echo(f"{name}: {RULES[name].doc}")
+        return 0
+    root = root or default_root()
+    if rule_names:
+        unknown = [r for r in rule_names if r not in RULES]
+        if unknown:
+            echo(f"unknown rule(s): {', '.join(unknown)} "
+                 f"(known: {', '.join(sorted(RULES))})")
+            return 2
+        selected = [RULES[r] for r in rule_names]
+    else:
+        selected = list(RULES.values())
+    bl_path = baseline_path or (root / BASELINE_PATH)
+    baseline = load_baseline(bl_path)
+    project = Project(root)
+    new, suppressed, baselined = run_check(
+        project, selected, baseline=baseline)
+    if write_baseline_flag:
+        # a partial run (--rule X) must not drop OTHER rules' existing
+        # entries — including suppression-format ones, which a partial
+        # run can only re-derive for the SELECTED rules' allows. Only a
+        # full run regenerates them all, so only a full run may rewrite
+        # them (stale entries left by a partial run are inert).
+        checked = {r.name for r in selected}
+        if checked == set(RULES):
+            checked.add(SUPPRESSION_FORMAT)
+        keep = [k for k in baseline if k[0] not in checked]
+        write_baseline(bl_path, new + baselined, keep_keys=keep)
+        echo(f"baseline written: {bl_path} ({len(new)} new + "
+             f"{len(baselined)} existing grandfathered)")
+        return 0
+    if fmt == "json":
+        echo(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "suppressed": len(suppressed),
+            "baselined": len(baselined),
+        }, indent=1))
+    else:
+        for f in new:
+            echo(f.render())
+        echo(f"{len(new)} new finding(s), {len(suppressed)} suppressed, "
+             f"{len(baselined)} baselined "
+             f"({len(project.sources)} files, "
+             f"{len(selected)} rule(s))")
+    return 1 if new else 0
+
+
+def pin_hlo_main(update: bool = False, pins_file=None, steps=None,
+                 echo=None) -> int:
+    if echo is None:
+        echo = stdout_echo
+    from . import hlo
+
+    names = list(steps or hlo.CANONICAL_STEPS)
+    unknown = [n for n in names if n not in hlo.CANONICAL_STEPS]
+    if unknown:
+        echo(f"unknown step(s): {', '.join(unknown)} "
+             f"(known: {', '.join(hlo.CANONICAL_STEPS)})")
+        return 2
+    # pins load BEFORE the (slow) lowerings: a missing file in verify
+    # mode and a CORRUPT file in either mode fail fast — silently
+    # resetting a corrupt file would discard the other steps' lineage
+    # hashes on a --step subset update, so ValueError propagates
+    path = pins_file or hlo.pins_path()
+    try:
+        pins = hlo.load_pins(path)
+    except OSError:
+        if not update:
+            echo(f"no pins file at {path} — run pin-hlo --update first")
+            return 2
+        pins = {}           # no pins yet: a fresh file is the point
+    current = {n: hlo.step_hash(n) for n in names}
+    if update:
+        pins.update(current)
+        hlo.write_pins(pins, path)
+        for n in names:
+            echo(f"{n}: {current[n]}")
+        echo(f"pins written: {path}")
+        return 0
+    drift = 0
+    for n in names:
+        want = pins.get(n)
+        status = "OK" if current[n] == want else "DRIFT"
+        if current[n] != want:
+            drift += 1
+        echo(f"{n}: {status} {current[n]}"
+             + ("" if current[n] == want else f" (pinned {want})"))
+    if drift:
+        echo(f"{drift} step lowering(s) drifted — deliberate? "
+             "pin-hlo --update and let review see the hash diff")
+    return 1 if drift else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m scotty_tpu.analysis",
+        description="invariant linter + HLO pinning "
+                    "(scotty_tpu.analysis)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    cp = sub.add_parser(
+        "check", help="run the rule set; nonzero exit on new findings")
+    cp.add_argument("--rule", action="append", metavar="NAME",
+                    help="run only this rule (repeatable)")
+    cp.add_argument("--format", choices=("text", "json"), default="text")
+    cp.add_argument("--root", default=None,
+                    help="project root (default: the repo holding "
+                         "scotty_tpu)")
+    cp.add_argument("--baseline", default=None, metavar="FILE",
+                    help=f"baseline file (default <root>/{BASELINE_PATH})")
+    cp.add_argument("--write-baseline", action="store_true",
+                    help="grandfather the current findings and exit 0")
+    cp.add_argument("--list", action="store_true",
+                    help="print the rule catalog and exit")
+    hp = sub.add_parser(
+        "pin-hlo", help="verify (or --update) the canonical step "
+                        "lowerings against tests/hlo_pins.json")
+    hp.add_argument("--update", action="store_true")
+    hp.add_argument("--pins", default=None, metavar="FILE")
+    hp.add_argument("--step", action="append", metavar="NAME",
+                    help="pin only this step config (repeatable)")
+    args = ap.parse_args(argv)
+    if args.cmd == "check":
+        import pathlib
+
+        return check_main(
+            rule_names=args.rule, fmt=args.format,
+            root=pathlib.Path(args.root) if args.root else None,
+            baseline_path=args.baseline,
+            write_baseline_flag=args.write_baseline,
+            list_rules=args.list)
+    if args.cmd == "pin-hlo":
+        return pin_hlo_main(update=args.update, pins_file=args.pins,
+                            steps=args.step)
+    return 2
+
+
+if __name__ == "__main__":      # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,331 @@
+"""Framework for the invariant linter: parsed sources, rule registry,
+inline suppressions, and the grandfathering baseline.
+
+Design constraints that shaped this module:
+
+* **One parse per file.** Rules never call ``ast.parse`` themselves —
+  a :class:`SourceFile` carries the tree, the raw lines, and a
+  prebuilt flat node list (``walk``) shared by every rule, so the
+  whole-tree check stays O(files), not O(files × rules).
+* **Suppressions carry reasons.** ``# scotty: allow(<rule>) —
+  <reason>`` on the offending line (or the line directly above)
+  silences that rule there; an allow comment with no reason is
+  reported as a :data:`SUPPRESSION_FORMAT` finding — the acceptance
+  bar is "zero findings left unexplained", so the explanation is part
+  of the syntax.
+* **Baselines grandfather, never bless.** A baseline entry matches on
+  ``(rule, path, snippet)`` — the stripped source line, not the line
+  number — so unrelated edits above a grandfathered finding don't
+  resurrect it, while touching the offending line itself does.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: pseudo-rule emitted by the framework itself for malformed/reasonless
+#: suppression comments (cannot be suppressed)
+SUPPRESSION_FORMAT = "suppression-format"
+
+#: ``# scotty: allow(rule-a, rule-b) — reason`` (also accepts ``--`` and
+#: ``:`` as the reason separator so plain-ASCII editors work)
+_ALLOW_RE = re.compile(
+    r"#\s*scotty:\s*allow\(([^)]*)\)\s*(?:—|--|:)?\s*(.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str          # project-root-relative, '/'-separated
+    line: int          # 1-based
+    message: str
+    snippet: str = ""  # stripped source line (baseline fingerprint)
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: stable across pure line-number drift."""
+        return (self.rule, self.path, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "snippet": self.snippet}
+
+
+@dataclass
+class SourceFile:
+    """One parsed Python source: path, text, lines, AST, flat node list."""
+
+    rel: str                       # project-root-relative path
+    text: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+    walk: List[ast.AST] = field(default_factory=list)
+    _allows: Optional[Dict] = field(default=None, repr=False)
+
+    @classmethod
+    def parse(cls, root: pathlib.Path, rel: str) -> "SourceFile":
+        text = (root / rel).read_text()
+        tree = ast.parse(text, filename=rel)
+        return cls(rel=rel, text=text, tree=tree,
+                   lines=text.splitlines(), walk=list(ast.walk(tree)))
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # -- suppressions ------------------------------------------------------
+    def allows(self) -> Dict[int, Tuple[Tuple[str, ...], str, int]]:
+        """Map of line → (rules, reason, comment_line) for every
+        ``# scotty: allow(...)`` comment. A suppression covers its own
+        line (trailing-comment form) and the first CODE line after it —
+        continuation comment lines in between extend the reason, so a
+        multi-line explanation still reaches the statement below it.
+        Computed once per file (pure function of the source) — findings
+        share the cached map."""
+        if self._allows is not None:
+            return self._allows
+        out: Dict[int, Tuple[Tuple[str, ...], str, int]] = {}
+        for i, raw in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(raw)
+            if not m:
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+            reason = m.group(2).strip()
+            entry = (rules, reason, i)
+            out[i] = entry
+            j = i + 1
+            while j <= len(self.lines) \
+                    and self.lines[j - 1].lstrip().startswith("#"):
+                j += 1
+            out.setdefault(j, entry)
+        self._allows = out
+        return out
+
+
+class Project:
+    """A set of parsed sources under one root, plus non-Python documents
+    rules may want (docs/README for the coherence checks)."""
+
+    #: directories never walked (seeded violations live in the corpus!)
+    SKIP_DIRS = ("__pycache__", "analysis_corpus")
+
+    def __init__(self, root, rel_paths: Optional[Sequence[str]] = None,
+                 doc_paths: Optional[Sequence[str]] = None):
+        self.root = pathlib.Path(root)
+        if rel_paths is None:
+            rel_paths = self.discover(self.root)
+        self.sources: Dict[str, SourceFile] = {}
+        self.errors: List[Finding] = []
+        for rel in rel_paths:
+            try:
+                self.sources[rel] = SourceFile.parse(self.root, rel)
+            except SyntaxError as e:
+                self.errors.append(Finding(
+                    rule="parse-error", path=rel, line=e.lineno or 0,
+                    message=f"syntax error: {e.msg}"))
+        if doc_paths is None:
+            doc_paths = [p for p in ("docs/API.md", "README.md")
+                         if (self.root / p).is_file()]
+        self.docs: Dict[str, str] = {
+            p: (self.root / p).read_text() for p in doc_paths}
+
+    @classmethod
+    def discover(cls, root: pathlib.Path) -> List[str]:
+        """The WALKED tree: ``scotty_tpu/`` + ``tests/`` + the root
+        ``bench.py`` shim — every file is parsed (syntax errors flag
+        regardless of rule scopes), then each rule restricts itself via
+        ``include``/``exclude``. The corpus of seeded violations under
+        ``tests/analysis_corpus/`` is excluded by construction."""
+        rels: List[str] = []
+        for top in ("scotty_tpu", "tests"):
+            base = root / top
+            if not base.is_dir():
+                continue
+            for path in sorted(base.rglob("*.py")):
+                rel = path.relative_to(root).as_posix()
+                if any(f"/{d}/" in f"/{rel}" or rel.startswith(f"{d}/")
+                       for d in cls.SKIP_DIRS):
+                    continue
+                rels.append(rel)
+        if (root / "bench.py").is_file():
+            rels.append("bench.py")
+        return rels
+
+
+class Rule:
+    """Base class: subclass, set the class attrs, implement ``check``
+    (per-file) and/or ``check_project`` (whole-project), then decorate
+    with :func:`register`.
+
+    ``include``/``exclude`` are '/'-separated path prefixes relative to
+    the project root; a file is in scope when it starts with an include
+    prefix and no exclude prefix. Scope extension is therefore a
+    one-line config change on the rule class.
+    """
+
+    name: str = ""
+    #: one-line summary for ``check --list`` and the docs catalog
+    doc: str = ""
+    include: Tuple[str, ...] = ("scotty_tpu",)
+    exclude: Tuple[str, ...] = ()
+
+    @staticmethod
+    def _matches(rel: str, prefix: str) -> bool:
+        return rel == prefix or rel.startswith(prefix.rstrip("/") + "/")
+
+    def in_scope(self, rel: str) -> bool:
+        if not any(self._matches(rel, p) for p in self.include):
+            return False
+        return not any(self._matches(rel, p) for p in self.exclude)
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+    # -- helpers shared by rules ------------------------------------------
+    @staticmethod
+    def finding(rule_name: str, src: SourceFile, node,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(rule=rule_name, path=src.rel, line=line,
+                       message=message, snippet=src.line_at(line))
+
+
+#: the registry: rule name → instance (import scotty_tpu.analysis.rules
+#: to populate)
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate + register a rule."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if inst.name in RULES:
+        raise ValueError(f"duplicate rule name {inst.name!r}")
+    RULES[inst.name] = inst
+    return cls
+
+
+def default_root() -> pathlib.Path:
+    """The repo root: the directory holding the ``scotty_tpu`` package."""
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_SCHEMA = "scotty_tpu.analysis_baseline/1"
+
+
+def load_baseline(path) -> set:
+    """Grandfathered finding keys; a missing file is an empty baseline."""
+    p = pathlib.Path(path)
+    if not p.is_file():
+        return set()
+    doc = json.loads(p.read_text())
+    if not str(doc.get("schema", "")).startswith(
+            "scotty_tpu.analysis_baseline/"):
+        raise ValueError(
+            f"{path}: not an analysis baseline "
+            f"(schema={doc.get('schema')!r})")
+    return {(f["rule"], f["path"], f["snippet"])
+            for f in doc.get("findings", [])}
+
+
+def write_baseline(path, findings: Sequence[Finding],
+                   keep_keys: Iterable[Tuple[str, str, str]] = ()
+                   ) -> None:
+    """Write the baseline from ``findings`` plus ``keep_keys`` — raw
+    ``(rule, path, snippet)`` entries to retain verbatim (a partial
+    ``check --rule X --write-baseline`` passes the other rules'
+    existing entries here so it cannot drop them)."""
+    keys = {f.key() for f in findings} | set(map(tuple, keep_keys))
+    doc = {
+        "schema": BASELINE_SCHEMA,
+        "findings": [
+            {"rule": r, "path": p, "snippet": s}
+            for r, p, s in sorted(keys)],
+    }
+    pathlib.Path(path).write_text(json.dumps(doc, indent=1) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# The check driver
+# ---------------------------------------------------------------------------
+
+
+def run_check(project: Project,
+              rules: Optional[Sequence[Rule]] = None,
+              baseline: Optional[set] = None,
+              respect_scope: bool = True,
+              ) -> Tuple[List[Finding], List[Finding], List[Finding]]:
+    """Run ``rules`` (default: all registered) over ``project``.
+
+    Returns ``(new, suppressed, baselined)``: findings not explained by
+    a suppression or the baseline; findings silenced by a reasoned
+    inline allow; findings grandfathered by the baseline. Reasonless or
+    unparseable-rule-list allow comments surface in ``new`` as
+    :data:`SUPPRESSION_FORMAT` findings. ``respect_scope=False`` runs
+    every rule on every file (the corpus tests use this — corpus files
+    live outside the rules' production scopes).
+    """
+    if rules is None:
+        rules = list(RULES.values())
+    baseline = baseline or set()
+    raw: List[Finding] = list(project.errors)
+    for src in project.sources.values():
+        for rule in rules:
+            if respect_scope and not rule.in_scope(src.rel):
+                continue
+            raw.extend(rule.check(src))
+    for rule in rules:
+        raw.extend(rule.check_project(project))
+
+    # pass 1: apply suppressions; reasonless allow comments generate
+    # SUPPRESSION_FORMAT findings that join the pool BEFORE the baseline
+    # filter (so --write-baseline grandfathers them too and its "next
+    # check exits 0" contract holds)
+    pool: List[Finding] = []
+    suppressed: List[Finding] = []
+    format_findings: Dict[Tuple[str, int], Finding] = {}
+    for f in raw:
+        src = project.sources.get(f.path)
+        allows = src.allows() if src is not None else {}
+        entry = allows.get(f.line)
+        if entry is not None and f.rule in entry[0]:
+            rules_listed, reason, comment_line = entry
+            if reason:
+                suppressed.append(f)
+                continue
+            format_findings.setdefault((f.path, comment_line), Finding(
+                rule=SUPPRESSION_FORMAT, path=f.path, line=comment_line,
+                message="suppression without a reason: write "
+                        "'# scotty: allow(%s) — <why this is deliberate>'"
+                        % ", ".join(rules_listed),
+                snippet=src.line_at(comment_line) if src else ""))
+            # fall through: the underlying finding still counts
+        pool.append(f)
+    pool.extend(format_findings.values())
+
+    # pass 2: baseline filter
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in pool:
+        (baselined if f.key() in baseline else new).append(f)
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return new, suppressed, baselined

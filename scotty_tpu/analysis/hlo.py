@@ -1,0 +1,129 @@
+"""HLO-hash pinning: the canonical fused-step lowerings as sha256 pins.
+
+Every PR since ISSUE 1 closed with a manual ritual: rebuild the
+canonical aligned step, hash ``lowered.as_text()``, eyeball it against
+the previous PR's recorded value ("aligned-step HLO hash
+byte-identical, sha256 19fd4d91…"). This module makes the ritual a
+red/green test: the three canonical step configs (aligned / session /
+count — the fused classes whose jitted HLO is the performance
+contract) lower here, tests/hlo_pins.json records their hashes, and
+``python -m scotty_tpu.analysis pin-hlo`` verifies or (``--update``)
+refreshes them. Accidental jitted-path drift fails tier-1
+(tests/test_hlo_pinning.py); deliberate drift is one ``--update`` with
+the hash diff visible in review.
+
+The canonical configs are deliberately tiny (seconds to trace on CPU)
+and FROZEN: changing a config is indistinguishable from changing the
+engine, so treat these builders as part of the pin. The aligned
+builder reproduces the exact construction every PR since ISSUE 8
+hashed by hand, so the recorded pin carries the lineage forward
+unchanged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+from typing import Dict, Optional, Sequence
+
+from .core import default_root
+
+#: pins file checked by tests/test_hlo_pinning.py (tier-1)
+DEFAULT_PINS_PATH = "tests/hlo_pins.json"
+PINS_SCHEMA = "scotty_tpu.hlo_pins/1"
+
+
+def _aligned_lowered(window_ms: int = 50):
+    """The lineage config: byte-identical to the hand-run hash of
+    ISSUEs 1–8 (sha256 19fd4d91… recorded at ISSUE 8)."""
+    import numpy as np
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    p = AlignedStreamPipeline(
+        [TumblingWindow(WindowMeasure.Time, window_ms)],
+        [SumAggregation()],
+        config=EngineConfig(capacity=1 << 12, batch_size=256,
+                            annex_capacity=256, min_trigger_pad=32),
+        throughput=20_000, wm_period_ms=100, max_lateness=100, seed=5,
+        gc_every=10 ** 9, value_scale=1024.0)
+    p.reset()
+    return p._step.lower(p.state, p.dm, p._interval_key(0), np.int64(0))
+
+
+def _session_lowered():
+    import numpy as np
+
+    from scotty_tpu import SessionWindow, SumAggregation, WindowMeasure
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.session_pipeline import SessionStreamPipeline
+
+    p = SessionStreamPipeline(
+        [SessionWindow(WindowMeasure.Time, 1000)], [SumAggregation()],
+        config=EngineConfig(capacity=1 << 12, annex_capacity=8,
+                            min_trigger_pad=32),
+        throughput=4000, wm_period_ms=1000, max_lateness=1000, seed=7,
+        session_config={"count": 6, "minGapMs": 1500, "maxGapMs": 4000})
+    p.reset()
+    return p._step.lower(p.state, p.sess_states, p.dm,
+                         p._interval_key(0), np.int64(0), np.bool_(True))
+
+
+def _count_lowered():
+    import numpy as np
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.engine.count_pipeline import CountStreamPipeline
+
+    p = CountStreamPipeline(
+        [TumblingWindow(WindowMeasure.Count, 7)], [SumAggregation()],
+        throughput=2000, wm_period_ms=100, max_lateness=100, seed=0,
+        out_of_order_pct=0.2)
+    p.reset()
+    return p._step.lower(p.state, p.dm, p._interval_key(0), np.int64(0))
+
+
+#: the pinned step configs; insertion order is the report order
+CANONICAL_STEPS = {
+    "aligned": _aligned_lowered,
+    "session": _session_lowered,
+    "count": _count_lowered,
+}
+
+
+def lowered_hash(lowered) -> str:
+    """sha256 of ``lowered.as_text()`` — the exact hand-run recipe."""
+    return hashlib.sha256(lowered.as_text().encode()).hexdigest()
+
+
+def step_hash(name: str, **kwargs) -> str:
+    """Hash one canonical step config (kwargs reach the builder — the
+    mutation test passes ``window_ms=100`` to prove a changed config
+    fails the pin)."""
+    return lowered_hash(CANONICAL_STEPS[name](**kwargs))
+
+
+def compute_pins(names: Optional[Sequence[str]] = None) -> Dict[str, str]:
+    return {n: step_hash(n) for n in (names or CANONICAL_STEPS)}
+
+
+def pins_path(root=None) -> pathlib.Path:
+    return pathlib.Path(root or default_root()) / DEFAULT_PINS_PATH
+
+
+def load_pins(path=None) -> Dict[str, str]:
+    p = pathlib.Path(path or pins_path())
+    doc = json.loads(p.read_text())
+    if not str(doc.get("schema", "")).startswith("scotty_tpu.hlo_pins/"):
+        raise ValueError(f"{p}: not an hlo-pins file "
+                         f"(schema={doc.get('schema')!r})")
+    return doc["pins"]
+
+
+def write_pins(pins: Dict[str, str], path=None) -> None:
+    p = pathlib.Path(path or pins_path())
+    p.write_text(json.dumps(
+        {"schema": PINS_SCHEMA, "pins": pins}, indent=1) + "\n")

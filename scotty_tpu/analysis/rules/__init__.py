@@ -1,0 +1,14 @@
+"""The rule set. Importing this package populates
+:data:`scotty_tpu.analysis.core.RULES`; each module groups one invariant
+family and names the incident that motivated it (docs/API.md "Static
+analysis" carries the full catalog)."""
+
+from . import (  # noqa: F401
+    coherence,
+    donation,
+    flightkind,
+    fsio_rule,
+    hostsync,
+    hygiene,
+    silentdrop,
+)

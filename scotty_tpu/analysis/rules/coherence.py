@@ -1,0 +1,151 @@
+"""``metric-coherence`` — every metric name the gates and docs promise
+must resolve to a name the code can actually create.
+
+Three surfaces reference counters by name: the ``obs diff``
+DEFAULT_THRESHOLDS gate (a typo'd key silently gates NOTHING — the
+regression it was meant to catch sails through), the ``/metrics``
+endpoint documentation, and the docs/API.md + README metric tables.
+The registry itself is stringly-typed and lazily created, so nothing
+at runtime ever cross-checks these — this rule does it statically.
+
+The name universe is built from the package sources: every
+metric-shaped string literal (exact names like
+``"resilience_shed_tuples"``) plus the literal prefixes of dynamic
+f-string names (``f"device_late_age_ms_le_{e}"`` contributes
+``device_late_age_ms_le_``). Checked against it:
+
+* every key of the ``metrics`` dict inside ``DEFAULT_THRESHOLDS``
+  (parsed from obs/diff.py's AST, never imported);
+* every metric-family token in the docs
+  (``(device|resilience|shaper|serving|ingest_ring|soak|delivery|
+  ckpt|flight|health|delivery)_…`` — the prefixed families are where
+  doc drift happens; placeholder spellings like
+  ``serving_tenant_active_<tenant>`` resolve via the f-string
+  prefixes).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Set, Tuple
+
+from ..core import Finding, Project, Rule, register
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]{3,}$")
+_TOKEN_RE = re.compile(r"[a-z][a-z0-9_]{3,}")
+_DOC_METRIC_RE = re.compile(
+    r"\b((?:device|resilience|shaper|serving|ingest_ring|soak|delivery"
+    r"|ckpt|flight|health)_[a-z0-9_]+)")
+
+
+def _universe(project: Project) -> Tuple[Set[str], Set[str]]:
+    """(exact names, dynamic prefixes) from every package source."""
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for src in project.sources.values():
+        if not src.rel.startswith("scotty_tpu/"):
+            continue
+        if src.rel.endswith("/diff.py"):
+            # the thresholds module must not anchor its OWN keys —
+            # a typo'd gate key would resolve against itself and the
+            # check would be vacuous
+            continue
+        for node in src.walk:
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                if _NAME_RE.match(node.value):
+                    exact.add(node.value)
+                else:
+                    # names embedded in larger literals ("soak_report.
+                    # json", format strings) still anchor doc tokens
+                    exact.update(_TOKEN_RE.findall(node.value))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                # docs also reference API identifiers that happen to
+                # match the metric families (flight_sync, ckpt_dir):
+                # any defined name/arg/attribute anchors a doc token
+                exact.add(node.name)
+            elif isinstance(node, ast.Attribute):
+                exact.add(node.attr)
+            elif isinstance(node, ast.Name):
+                exact.add(node.id)
+            elif isinstance(node, ast.arg):
+                exact.add(node.arg)
+            elif isinstance(node, ast.keyword) and node.arg:
+                exact.add(node.arg)
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                head = node.values[0]
+                if isinstance(head, ast.Constant) \
+                        and isinstance(head.value, str) \
+                        and _TOKEN_RE.match(head.value):
+                    prefixes.add(head.value)
+    return exact, prefixes
+
+
+def _resolves(name: str, exact: Set[str], prefixes: Set[str]) -> bool:
+    if name in exact:
+        return True
+    return any(name.startswith(p) and len(name) > len(p)
+               for p in prefixes if len(p) >= 6)
+
+
+def _threshold_keys(project: Project):
+    """(key, lineno) pairs of DEFAULT_THRESHOLDS["metrics"] parsed from
+    obs/diff.py — AST only, so the check needs no imports."""
+    src = project.sources.get("scotty_tpu/obs/diff.py")
+    if src is None:
+        for rel, s in project.sources.items():
+            if rel.endswith("/diff.py") or rel == "diff.py":
+                src = s
+                break
+    if src is None:
+        return None, []
+    for node in src.walk:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "DEFAULT_THRESHOLDS"
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and k.value == "metrics" \
+                        and isinstance(v, ast.Dict):
+                    return src, [
+                        (mk.value, mk.lineno)
+                        for mk in v.keys
+                        if isinstance(mk, ast.Constant)
+                        and isinstance(mk.value, str)]
+    return src, []
+
+
+@register
+class MetricCoherence(Rule):
+    name = "metric-coherence"
+    doc = ("obs-diff threshold keys and docs metric references that "
+           "resolve to no counter the code creates — a typo'd gate "
+           "gates nothing")
+
+    def check_project(self, project: Project):
+        exact, prefixes = _universe(project)
+        if not exact:
+            return
+        src, keys = _threshold_keys(project)
+        for key, lineno in keys:
+            if not _resolves(key, exact, prefixes):
+                yield Finding(
+                    rule=self.name, path=src.rel, line=lineno,
+                    message=f"DEFAULT_THRESHOLDS gates {key!r} but no "
+                            "code creates a metric of that name — the "
+                            "gate silently never fires",
+                    snippet=src.line_at(lineno))
+        for doc_rel, text in project.docs.items():
+            for i, line in enumerate(text.splitlines(), start=1):
+                for m in _DOC_METRIC_RE.finditer(line):
+                    token = m.group(1)
+                    if not _resolves(token, exact, prefixes):
+                        yield Finding(
+                            rule=self.name, path=doc_rel, line=i,
+                            message=f"docs reference metric {token!r} "
+                                    "but no code creates it — doc "
+                                    "drift or a typo",
+                            snippet=line.strip())

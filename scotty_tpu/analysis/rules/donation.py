@@ -1,0 +1,299 @@
+"""``donation-safety`` — the ISSUE 2 checkpoint-restore bug class,
+statically.
+
+The engine's fused steps donate their carried state
+(``jax.jit(step, donate_argnums=...)``): XLA recycles the input buffer
+for the output, so (a) the donated argument is DEAD after the call —
+reading it again observes recycled memory — and (b) a numpy-backed
+(CPU zero-copy) leaf fed to a donating kernel lets XLA recycle host
+memory that live result handles still alias. Class (b) is exactly the
+ISSUE 2 incident: checkpoint restores fed ``np.load``-backed leaves to
+donating kernels and produced garbled resumed window bounds in one test
+and a segfault mid-step in another; the fix (``utils/checkpoint.py
+_device_copy``) materializes XLA-owned copies first.
+
+Per module, the rule:
+
+1. collects donating bindings — ``<name> = jax.jit(fn,
+   donate_argnums=<literal>)`` assigned to a plain name or a
+   ``self.<attr>`` (conditional expressions contribute the union of
+   their branches' donated positions);
+2. at every call of a collected binding, resolves the donated
+   positional arguments that are plain names or ``self.<attr>`` chains
+   and flags
+   **use-after-donation** — a later read of that name in the same
+   function body before it is reassigned — and
+   **host-backed-leaf** — an argument whose nearest preceding
+   assignment in the function is a bare numpy constructor
+   (``np.zeros/array/asarray/full/arange/copy/load``) or
+   ``jax.device_get``, i.e. host memory handed to a donating kernel
+   (route it through ``jax.device_put`` / checkpoint ``_device_copy``
+   first).
+
+The analysis is intraprocedural and name-based by design: it catches
+the review-visible shape of both incidents without a dataflow engine,
+and the differential tests remain the dynamic backstop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Rule, SourceFile, register
+
+_NP_CTORS = ("zeros", "ones", "empty", "full", "array", "asarray",
+             "arange", "copy", "load", "frombuffer")
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Donated argnums of a ``jax.jit`` call, or None if not one."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "jit"
+            and isinstance(f.value, ast.Name) and f.value.id == "jax"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = set()
+            for e in v.elts:
+                if isinstance(e, ast.Constant) \
+                        and isinstance(e.value, int):
+                    out.add(e.value)
+            return out
+    return None
+
+
+def _jit_bindings_in(value: ast.AST) -> Optional[Set[int]]:
+    """Donated positions contributed by an assignment's value —
+    handles the bare call and conditional-expression forms
+    (``jax.jit(...) if cond else jax.jit(...)``: union)."""
+    if isinstance(value, ast.Call):
+        return _donated_positions(value)
+    if isinstance(value, ast.IfExp):
+        a = _jit_bindings_in(value.body)
+        b = _jit_bindings_in(value.orelse)
+        if a is None and b is None:
+            return None
+        return (a or set()) | (b or set())
+    return None
+
+
+def _binding_name(target: ast.AST) -> Optional[str]:
+    """The registry key for an assignment target: ``"name"`` for a
+    plain Name, ``".attr"`` for ``self.<attr>`` (leading dot marks the
+    attribute namespace)."""
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute) \
+            and isinstance(target.value, ast.Name) \
+            and target.value.id == "self":
+        return "." + target.attr
+    return None
+
+
+def _ref_key(expr: ast.AST) -> Optional[str]:
+    """Same key space for a call-argument expression."""
+    return _binding_name(expr)
+
+
+def _reads(node: ast.AST, key: str) -> bool:
+    """Does ``node`` read (Load) the name/attr ``key`` anywhere?"""
+    for n in ast.walk(node):
+        if key.startswith("."):
+            if (isinstance(n, ast.Attribute) and n.attr == key[1:]
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    and isinstance(n.ctx, ast.Load)):
+                return True
+        else:
+            if (isinstance(n, ast.Name) and n.id == key
+                    and isinstance(n.ctx, ast.Load)):
+                return True
+    return False
+
+
+def _stores(stmt: ast.AST, key: str) -> bool:
+    """Does statement ``stmt`` assign ``key`` (including tuple targets
+    and ``for`` targets)?"""
+    for n in ast.walk(stmt):
+        if key.startswith("."):
+            if (isinstance(n, ast.Attribute) and n.attr == key[1:]
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    and isinstance(n.ctx, ast.Store)):
+                return True
+        else:
+            if (isinstance(n, ast.Name) and n.id == key
+                    and isinstance(n.ctx, ast.Store)):
+                return True
+    return False
+
+
+def _is_host_backed(value: ast.AST) -> bool:
+    """Is this assignment value a bare numpy constructor or a
+    ``jax.device_get`` — i.e. host memory?"""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id in ("np", "numpy") and f.attr in _NP_CTORS:
+            return True
+        if f.value.id == "jax" and f.attr == "device_get":
+            return True
+    return False
+
+
+def _inline_np_ctor(expr: ast.AST) -> bool:
+    """Argument IS a direct ``np.<ctor>(...)`` call."""
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    return (isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("np", "numpy") and f.attr in _NP_CTORS)
+
+
+def _child_blocks(stmt: ast.stmt) -> Iterable[List[ast.stmt]]:
+    """The statement blocks nested in a compound statement (nested
+    function/class definitions are separate scopes, not control flow)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    for name in ("body", "orelse", "finalbody"):
+        blk = getattr(stmt, name, None)
+        if blk:
+            yield blk
+    for handler in getattr(stmt, "handlers", []) or []:
+        yield handler.body
+
+
+def _stmts_with_successors(body: List[ast.stmt], inherited=()):
+    """Yield ``(stmt, successors)`` for every statement reachable from
+    ``body``, where ``successors`` is the ordered list of WHOLE
+    statements that can execute after it: the rest of its own block,
+    then its ancestors' followers. Sibling branches of the same
+    ``if``/``try`` are NOT each other's successors — that's the point
+    (a linear flattening flags branch A's donation against branch B's
+    read)."""
+    inherited = list(inherited)
+    for i, stmt in enumerate(body):
+        succ = body[i + 1:] + inherited
+        yield stmt, succ
+        for blk in _child_blocks(stmt):
+            yield from _stmts_with_successors(blk, succ)
+
+
+@register
+class DonationSafety(Rule):
+    name = "donation-safety"
+    doc = ("donated args read after a donating-kernel call, or "
+           "numpy/host-backed leaves fed to donating kernels — the "
+           "ISSUE 2 restore-segfault class")
+    include = ("scotty_tpu", "tests")
+
+    def check(self, src: SourceFile):
+        # pass 1: donating bindings in this module (name → positions)
+        donating: Dict[str, Set[int]] = {}
+        for node in src.walk:
+            if isinstance(node, ast.Assign):
+                pos = _jit_bindings_in(node.value)
+                if pos is None:
+                    continue
+                for t in node.targets:
+                    key = _binding_name(t)
+                    if key is not None:
+                        donating[key] = donating.get(key, set()) | pos
+        if not donating:
+            return
+
+        # pass 2: per function, examine calls of donating bindings.
+        # Only SIMPLE statements host examined calls (a donating call in
+        # an if/while header is not an idiom this codebase has) — a
+        # compound statement's calls are found when its inner simple
+        # statements are visited, so nothing is double-reported.
+        for fn in src.walk:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            preceding: List[ast.stmt] = []
+            for stmt, succ in _stmts_with_successors(fn.body):
+                if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign, ast.Expr,
+                                     ast.Return)):
+                    for call in ast.walk(stmt):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        key = _call_key(call)
+                        if key is None or key not in donating:
+                            continue
+                        yield from self._check_call(
+                            src, preceding, succ, stmt, call,
+                            donating[key])
+                preceding.append(stmt)
+
+    def _check_call(self, src, preceding, successors, stmt, call,
+                    positions):
+        for pos in sorted(positions):
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            if _inline_np_ctor(arg):
+                yield self.finding(
+                    self.name, src, call,
+                    f"numpy-backed leaf (arg {pos}) fed directly to a "
+                    "donating kernel — XLA will recycle host memory "
+                    "that live handles may alias; materialize via "
+                    "jax.device_put first")
+                continue
+            key = _ref_key(arg)
+            if key is None:
+                continue
+            # host-backed taint: the NEAREST preceding assignment wins
+            # (a later device_put/_device_copy rebind clears it)
+            taint = None
+            for prev in preceding:
+                if isinstance(prev, ast.Assign) \
+                        and any(_binding_name(t) == key
+                                for t in prev.targets):
+                    taint = prev if _is_host_backed(prev.value) \
+                        else None
+            if taint is not None:
+                yield self.finding(
+                    self.name, src, call,
+                    f"'{key.lstrip('.')}' (arg {pos}) is numpy/host-"
+                    f"backed (assigned at line {taint.lineno}) and "
+                    "flows into a donating kernel — the ISSUE 2 "
+                    "restore-segfault class; materialize an XLA-owned "
+                    "copy (jax.device_put / checkpoint._device_copy) "
+                    "first")
+            # use-after-donation: the same statement may reassign the
+            # arg (the carry idiom `self.state, res = self._step(
+            # self.state, ...)`); if it does, the donation is safe
+            if _stores(stmt, key):
+                continue
+            for later in successors:
+                if _stores(later, key) and not _reads(later, key):
+                    break
+                if _reads(later, key):
+                    yield self.finding(
+                        self.name, src, later,
+                        f"'{key.lstrip('.')}' read after being donated "
+                        f"to a kernel at line {call.lineno} — the "
+                        "buffer was recycled by XLA; rebind it from "
+                        "the call's result or drop the read")
+                    break
+
+
+def _call_key(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self":
+        return "." + f.attr
+    return None

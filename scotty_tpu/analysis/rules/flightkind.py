@@ -1,0 +1,77 @@
+"""``flight-kind`` — event kinds passed to flight recording are
+:mod:`scotty_tpu.obs.flight` constants, never string literals (the
+ISSUE 6 review finding).
+
+``obs postmortem`` classifies crash causes by matching on the kind
+vocabulary; a typo'd literal kind (``"overlow"``) records events the
+triage CLI silently fails to classify, and a literal that drifts from
+the constant's value splits one event family across two names. The
+ISSUE 6 review pass fixed the operator/connector sites by hand; this
+rule pins the invariant for every site.
+
+Flagged call shapes (the kind argument must not be a plain string
+constant — a Name/Attribute that resolves to the constant, or a
+variable, passes):
+
+* ``<obs>.flight_event(kind, name[, value])``
+* ``<obs>.record_failure(exc, kind=...)``
+* ``<...>.flight.record(kind, ...)`` (the raw recorder)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, SourceFile, register
+
+
+def _literal_kind(call: ast.Call):
+    """The offending string-literal kind argument, or None."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "flight_event":
+        kind = call.args[0] if call.args else None
+    elif f.attr == "record_failure":
+        kind = None
+        for kw in call.keywords:
+            if kw.arg == "kind":
+                kind = kw.value
+        if kind is None and len(call.args) >= 2:
+            kind = call.args[1]
+    elif f.attr == "record" and (
+            (isinstance(f.value, ast.Attribute)
+             and f.value.attr == "flight")
+            or (isinstance(f.value, ast.Name)
+                and f.value.id == "flight")):
+        kind = call.args[0] if call.args else None
+    else:
+        return None
+    if isinstance(kind, ast.Constant) and isinstance(kind.value, str):
+        return kind
+    return None
+
+
+@register
+class FlightKindRegistry(Rule):
+    name = "flight-kind"
+    doc = ("string-literal event kinds at flight-recording call sites — "
+           "use the obs.flight constants so postmortem classification "
+           "and the kind vocabulary cannot drift")
+    include = ("scotty_tpu",)
+    #: the vocabulary's defining module may spell its own constants
+    exclude = ("scotty_tpu/obs/flight.py",)
+
+    def check(self, src: SourceFile):
+        for node in src.walk:
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _literal_kind(node)
+            if kind is None:
+                continue
+            yield self.finding(
+                self.name, src, node,
+                f"string-literal flight-event kind {kind.value!r} — "
+                "use the scotty_tpu.obs.flight constant (obs "
+                "postmortem matches on this vocabulary; literals "
+                "drift)")

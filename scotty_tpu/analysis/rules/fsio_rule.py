@@ -1,0 +1,91 @@
+"""``fsio-discipline`` — committed bytes flow through
+:mod:`scotty_tpu.utils.fsio` (the ISSUE 8 bug class).
+
+ISSUE 8's review passes found three state-file paths by hand that wrote
+around the fault-injectable shim (keyed_connector.pkl, the orbax-path
+meta.json, serving's query_table.json): a silent short write of any of
+them was blessed into the checkpoint manifest by the disk-bytes
+fallback, and restore then crash-looped. The invariant: every byte a
+checkpoint/ledger/commit path puts on disk goes through
+``fsio.write_bytes``/``fsio.replace`` so (a) the intent digest lands in
+the manifest and (b) the crash-point fuzzer can interpose on the op.
+
+The rule flags the raw primitives — ``open(..., "w"/"a"/"x"/"+")``,
+``json.dump``/``pickle.dump`` (the file-object forms; ``dumps`` is
+fine), ``np.save*``, ``os.replace``/``os.rename``, ``shutil.move`` —
+everywhere in the package except ``bench/`` (bench results are reports,
+not committed state) and ``utils/fsio.py`` itself (the implementation).
+Telemetry exports and crash-path writers that deliberately bypass the
+shim carry inline suppressions stating why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, SourceFile, register
+
+_NP_WRITERS = ("save", "savez", "savez_compressed", "savetxt")
+_WRITE_MODES = ("w", "a", "x", "+")
+
+
+def _open_mode(node: ast.Call):
+    """The mode literal of an ``open(...)`` call, or None."""
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        return node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+@register
+class FsioDiscipline(Rule):
+    name = "fsio-discipline"
+    doc = ("raw file writes (open-for-write / json.dump / pickle.dump / "
+           "np.save* / os.replace) outside utils.fsio — committed bytes "
+           "must record intent digests and stay crash-fuzzable")
+    include = ("scotty_tpu",)
+    exclude = (
+        # bench results are reports, not committed state
+        "scotty_tpu/bench",
+        # the sanctioned implementation of the discipline itself
+        "scotty_tpu/utils/fsio.py",
+    )
+
+    def check(self, src: SourceFile):
+        for node in src.walk:
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            msg = None
+            if isinstance(f, ast.Name) and f.id == "open":
+                mode = _open_mode(node)
+                if mode and any(c in mode for c in _WRITE_MODES):
+                    msg = (f"open(..., {mode!r}) writes around "
+                           "utils.fsio — use fsio.write_bytes so the "
+                           "intent digest is recorded and the "
+                           "crash-point fuzzer can interpose")
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name):
+                recv, attr = f.value.id, f.attr
+                if recv in ("json", "pickle") and attr == "dump":
+                    msg = (f"{recv}.dump to a file object bypasses "
+                           "utils.fsio — serialize with "
+                           f"{recv}.dumps and commit via "
+                           "fsio.write_bytes")
+                elif recv in ("np", "numpy") and attr in _NP_WRITERS:
+                    msg = (f"np.{attr} writes around utils.fsio — "
+                           "serialize to a buffer and commit via "
+                           "fsio.write_bytes")
+                elif recv == "os" and attr in ("replace", "rename"):
+                    msg = (f"os.{attr} is a commit point — use "
+                           "fsio.replace so the flip is "
+                           "crash-fuzzable and durable (dir fsyncs)")
+                elif recv == "shutil" and attr == "move":
+                    msg = ("shutil.move is a commit point — use "
+                           "fsio.replace")
+            if msg:
+                yield self.finding(self.name, src, node, msg)

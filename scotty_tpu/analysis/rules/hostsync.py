@@ -1,0 +1,89 @@
+"""``host-sync`` — device round trips only at sanctioned drain points.
+
+The engine's performance story (PAPER.md, SURVEY §2) rests on fused
+steps that dispatch asynchronously with ZERO host syncs; a stray
+``device_get``/``block_until_ready``/``.item()`` in a hot path turns a
+66 G t/s pipeline into a per-interval round trip. Every legitimate sync
+in the jitted-path packages lives in a named drain-point function
+(``sync``, ``check_overflow``, the ``materialize_*`` replay faces, …)
+— this rule pins that set, so a new sync site is a red check the author
+must either move to a drain point or allowlist explicitly here (with
+review seeing the diff).
+
+The dynamic complement is ``jax.transfer_guard("disallow")`` wrapped
+around the differential tests' step invocations
+(tests/test_pipeline.py etc.) — the rule catches the sites statically,
+the guard proves the steps clean end-to-end.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Rule, SourceFile, register
+
+#: drain-point functions where a host round trip IS the contract:
+#: the documented sync/drain faces (FusedPipelineDriver.sync,
+#: check_overflow at every operator/pipeline), the host replay faces
+#: (materialize_*, lower_*), the fetch-on-demand telemetry faces, and
+#: the operator-internal refresh points that already ride a drain.
+#: Extending this set is a one-line change — reviewed as such.
+DRAIN_POINT_FUNCTIONS = frozenset({
+    "sync", "check_overflow",
+    "device_metrics", "device_stats",
+    "lower_interval_columns", "lower_results", "lowered_results",
+    "lowered_results_for_key",
+    "materialize_interval", "materialize_interval_late",
+    "_fetch_grid", "_fetch_sessions", "_pol_refresh", "_grow_capacity",
+    "measure_link", "process_watermark_arrays_combined",
+})
+
+_SYNC_ATTRS = ("device_get", "block_until_ready", "item")
+
+
+def _enclosing_function(src: SourceFile, node) -> Optional[str]:
+    """Name of the innermost function containing ``node`` (by line
+    span — the walk list carries no parent pointers)."""
+    best = None
+    best_span = None
+    for n in src.walk:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = n.end_lineno or n.lineno
+            if n.lineno <= node.lineno <= end:
+                span = end - n.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = n.name, span
+    return best
+
+
+@register
+class HostSyncBan(Rule):
+    name = "host-sync"
+    doc = ("jax.device_get / block_until_ready / .item() outside the "
+           "allowlisted drain-point functions in the jitted-path "
+           "packages — syncs belong at documented drain points only")
+    include = ("scotty_tpu/engine", "scotty_tpu/parallel",
+               "scotty_tpu/shaper", "scotty_tpu/serving",
+               "scotty_tpu/core")
+
+    def check(self, src: SourceFile):
+        for node in src.walk:
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _SYNC_ATTRS):
+                continue
+            if f.attr == "item" and (node.args or node.keywords):
+                continue        # dict.item-like APIs, not ndarray.item()
+            fn = _enclosing_function(src, node)
+            if fn in DRAIN_POINT_FUNCTIONS:
+                continue
+            yield self.finding(
+                self.name, src, node,
+                f"host sync ({f.attr}) outside a sanctioned drain point "
+                f"(enclosing function: {fn or '<module>'}) — move it to "
+                "a drain-point function or extend "
+                "analysis.rules.hostsync.DRAIN_POINT_FUNCTIONS in a "
+                "reviewed change")

@@ -1,0 +1,107 @@
+"""Engine-silence and clock-discipline rules — the three grown-by-
+accretion walkers from tests/test_no_print_in_engine.py (ISSUES 1/3/4/7
+satellites) as registry rules with one shared call matcher. Extending a
+scope is now a one-line change to the rule class instead of a
+copy-pasted directory list.
+
+* ``no-print`` — the reference's engine never logs (SURVEY.md §5); all
+  output flows through the obs registry / overridable echo sinks
+  (``scotty_tpu.utils.stdout_echo``), never a bare ``print(`` — bench
+  and CLI output in particular must stay capturable so the ``obs diff``
+  gate and tests can consume it. Scope: the ENTIRE package (the old
+  test listed eight directories; obs/bench CLIs already route through
+  echo sinks).
+* ``no-sleep`` — every wait goes through the injectable
+  :mod:`scotty_tpu.resilience.clock` (the one exempt module), so chaos
+  tests drive backoff/watchdog logic deterministically on a
+  ManualClock.
+* ``no-wall-clock`` — the obs/ingest/soak/delivery layers never read
+  ``time.time()``/``time.monotonic()`` directly: export timestamps and
+  soak pace/audit reads come from ``resilience.clock`` (``wall_time`` /
+  the injectable Clock) so bundle timelines stay deterministic.
+  ``time.perf_counter`` (relative span durations) stays allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, SourceFile, register
+
+
+def _calls(src: SourceFile, names=(), attrs=()):
+    """Shared matcher: yield Call nodes whose func is a bare Name in
+    ``names`` or a ``<mod>.<attr>`` Attribute with attr in ``attrs``
+    (any receiver — ``from time import sleep`` aliases are caught by
+    the Name arm)."""
+    for node in src.walk:
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in names:
+            yield node
+        elif (isinstance(f, ast.Attribute) and f.attr in attrs
+                and isinstance(f.value, ast.Name)):
+            yield node
+
+
+@register
+class NoPrint(Rule):
+    name = "no-print"
+    doc = ("bare print( anywhere in scotty_tpu — route output through "
+           "the obs registry or an overridable echo sink "
+           "(utils.stdout_echo)")
+    include = ("scotty_tpu",)
+
+    def check(self, src: SourceFile):
+        for node in _calls(src, names=("print",)):
+            yield self.finding(
+                self.name, src, node,
+                "bare print( — route output through the scotty_tpu.obs "
+                "registry or an overridable echo sink "
+                "(scotty_tpu.utils.stdout_echo)")
+
+
+@register
+class NoSleep(Rule):
+    name = "no-sleep"
+    doc = ("bare time.sleep outside resilience/clock.py — waits go "
+           "through the injectable Clock so chaos tests stay "
+           "deterministic")
+    include = ("scotty_tpu",)
+    #: SystemClock's implementation — the single sanctioned sleep site
+    exclude = ("scotty_tpu/resilience/clock.py",)
+
+    def check(self, src: SourceFile):
+        for node in _calls(src, names=("sleep",), attrs=("sleep",)):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.value.id not in ("time",)):
+                continue        # clock.sleep / asyncio.sleep are fine
+            yield self.finding(
+                self.name, src, node,
+                "bare time.sleep — route waits through "
+                "scotty_tpu.resilience.clock (injectable Clock)")
+
+
+@register
+class NoWallClock(Rule):
+    name = "no-wall-clock"
+    doc = ("bare time.time()/time.monotonic() in obs/ingest/soak/"
+           "delivery — timestamps come from resilience.clock "
+           "(wall_time / the injectable Clock)")
+    include = ("scotty_tpu/obs", "scotty_tpu/ingest", "scotty_tpu/soak",
+               "scotty_tpu/delivery")
+
+    def check(self, src: SourceFile):
+        for node in _calls(src, names=("time", "monotonic"),
+                           attrs=("time", "monotonic")):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.value.id not in ("time",)):
+                continue        # clock.time()-style receivers are fine
+            yield self.finding(
+                self.name, src, node,
+                "bare wall-clock read — use scotty_tpu.resilience.clock "
+                "(wall_time for export rows, the injectable Clock for "
+                "event time) so ManualClock tests stay deterministic")

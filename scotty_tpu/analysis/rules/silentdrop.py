@@ -1,0 +1,97 @@
+"""``silent-drop`` — broad exception handlers in the data path must
+leave evidence.
+
+The delivery/ingest/connector layers own the exactly-once and
+tuple-conservation invariants (ISSUES 7/8): every record is delivered,
+shed (counted), dead-lettered (counted), or the run fails. A bare
+``except:`` / ``except Exception:`` that neither re-raises nor
+increments a counter / records a flight event is a hole in that
+accounting — the soak audit's conservation identity can't see what the
+handler swallowed. (The kafka ``_default_deserialize`` crash that
+ISSUE 3 dead-lettered, and the poison/dead-letter machinery itself,
+exist precisely because swallowing was the previous failure mode.)
+
+Narrow handlers (``except StopAsyncIteration:`` etc.) pass — typed
+control flow is fine; only ``except:``, ``except Exception:`` and
+``except BaseException:`` with an inert body are flagged. "Evidence"
+in the body = a ``raise``, a ``return``/propagation of the error
+object, or a call to ``inc`` / ``observe`` / ``flight_event`` /
+``record`` / ``record_failure`` / ``handle`` / a dead-letter hook.
+Crash-path side channels that deliberately swallow (a postmortem
+writer must never mask the original failure) carry inline
+suppressions saying so.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, SourceFile, register
+
+#: method calls (Attribute form only — matching bare names here would
+#: let the builtin ``set()``/``dict.record`` collide) that count as
+#: evidence: counter/gauge moves, flight recording, dead-lettering, the
+#: poison handler, loggers, and the supervised-recovery handlers
+#: (handle_failure/_backoff flight-record and count resilience_restarts
+#: before deciding to retry or give up)
+_EVIDENCE_METHODS = frozenset({
+    "inc", "observe", "set", "flight_event", "record", "record_failure",
+    "handle", "dead_letter", "warning", "error", "exception",
+    "handle_failure", "_backoff",
+})
+#: bare-function forms that are unambiguous evidence (module-level
+#: helpers, not shadowable builtins)
+_EVIDENCE_FUNCTIONS = frozenset({
+    "flight_event", "record_failure", "dead_letter", "handle_failure",
+})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _leaves_evidence(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _EVIDENCE_METHODS:
+                return True
+            if isinstance(f, ast.Name) and f.id in _EVIDENCE_FUNCTIONS:
+                return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            return True
+    return False
+
+
+@register
+class SilentDrop(Rule):
+    name = "silent-drop"
+    doc = ("bare/broad except that neither re-raises nor counts in the "
+           "data-path packages — swallowed errors break the "
+           "tuple-conservation accounting")
+    include = ("scotty_tpu/connectors", "scotty_tpu/ingest",
+               "scotty_tpu/delivery", "scotty_tpu/resilience",
+               "scotty_tpu/soak", "scotty_tpu/obs")
+
+    def check(self, src: SourceFile):
+        for node in src.walk:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _leaves_evidence(node):
+                continue
+            yield self.finding(
+                self.name, src, node,
+                "broad except swallows the error without evidence — "
+                "re-raise, dead-letter, or count it (counter inc / "
+                "flight event) so the conservation audit can see it")

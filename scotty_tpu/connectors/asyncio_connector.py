@@ -28,6 +28,7 @@ import asyncio
 from typing import AsyncIterator, Awaitable, Callable, Optional, Tuple
 
 from .. import obs as _obs
+from ..obs import flight as _flight
 from .base import KeyedScottyWindowOperator
 
 #: default bound for :func:`bounded_queue` — deep enough to ride bursts,
@@ -217,7 +218,7 @@ async def queue_source(queue: "asyncio.Queue", sentinel=None, obs=None,
     from ..resilience.connectors import SourceStalled, flag_stall
 
     if obs is not None and queue.maxsize <= 0:
-        obs.flight_event("mark", "queue_source_unbounded")
+        obs.flight_event(_flight.MARK, "queue_source_unbounded")
     n = 0
     while True:
         if stall_timeout_s is None:

@@ -443,7 +443,8 @@ class KeyedScottyWindowOperator:
                         out.append((key, w))
         if self.obs is not None:
             self.obs.counter(_obs.WATERMARKS).inc()
-            self.obs.flight_event("watermark", "watermark", float(wm))
+            self.obs.flight_event(_flight.WATERMARK, "watermark",
+                                  float(wm))
             if out:
                 self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
         return pre + out
@@ -623,7 +624,8 @@ class GlobalScottyWindowOperator:
                if w.has_value()]
         if self.obs is not None:
             self.obs.counter(_obs.WATERMARKS).inc()
-            self.obs.flight_event("watermark", "watermark", float(wm))
+            self.obs.flight_event(_flight.WATERMARK, "watermark",
+                                  float(wm))
             if out:
                 self.obs.counter(_obs.WINDOWS_EMITTED).inc(len(out))
         return pre + out

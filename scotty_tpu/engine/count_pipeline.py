@@ -82,6 +82,7 @@ import numpy as np
 
 from .. import jax_config  # noqa: F401
 from .. import obs as _obs
+from ..obs import flight as _flight
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import (
@@ -533,7 +534,7 @@ class CountStreamPipeline(FusedPipelineDriver):
                 "mis-sized retention model")
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
-                self.obs.record_failure(e, kind="overflow",
+                self.obs.record_failure(e, kind=_flight.OVERFLOW,
                                         config=self.config)
             raise e
 

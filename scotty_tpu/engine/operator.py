@@ -27,6 +27,7 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import flight as _flight
 from ..core.aggregates import AggregateFunction
 from ..core.operator import AggregateWindow, WindowOperator
 from ..core.windows import (
@@ -526,8 +527,6 @@ class TpuWindowOperator(WindowOperator):
         self._serving_next += 1
         self._serving_handles[h] = (idx, tenant)
         if self.obs is not None:
-            from ..obs import flight as _flight
-
             self.obs.counter(_obs.SERVING_REGISTERED).inc()
             if retrace:
                 self.obs.counter(_obs.SERVING_RETRACES).inc()
@@ -560,8 +559,6 @@ class TpuWindowOperator(WindowOperator):
         self._win_active[idx] = False
         self._win_free.append(idx)
         if self.obs is not None:
-            from ..obs import flight as _flight
-
             self.obs.counter(_obs.SERVING_CANCELLED).inc()
             self.obs.flight_event(_flight.QUERY_CANCEL,
                                   f"{reg_tenant}:{w}", float(handle))
@@ -1309,7 +1306,8 @@ class TpuWindowOperator(WindowOperator):
             if n_drop:
                 if self.obs is not None:
                     self.obs.counter(_obs.RESILIENCE_SHED_TUPLES).inc(n_drop)
-                    self.obs.flight_event("shed", _obs.RESILIENCE_SHED_TUPLES,
+                    self.obs.flight_event(_flight.SHED,
+                                          _obs.RESILIENCE_SHED_TUPLES,
                                           n_drop)
                 if self._dm_active:
                     self._dm_host_add(_dev.DEVICE_DROPPED_TUPLES, n_drop)
@@ -1362,7 +1360,7 @@ class TpuWindowOperator(WindowOperator):
         self._pol_refresh()
         if self.obs is not None:
             self.obs.counter(_obs.RESILIENCE_GROW_EVENTS).inc()
-            self.obs.flight_event("grow", "capacity",
+            self.obs.flight_event(_flight.GROW, "capacity",
                                   float(self.config.capacity))
 
     def _flush(self) -> None:
@@ -1554,7 +1552,8 @@ class TpuWindowOperator(WindowOperator):
         obs.histogram(_obs.WATERMARK_DISPATCH_MS).observe(
             (time.perf_counter() - t0) * 1e3)
         obs.counter(_obs.WATERMARKS).inc()
-        obs.flight_event("watermark", "watermark", float(watermark_ts))
+        obs.flight_event(_flight.WATERMARK, "watermark",
+                         float(watermark_ts))
         if self._host_met is not None:
             # floored at 0: a drain watermark deliberately runs past the
             # stream end, and a last-value gauge stuck negative would make
@@ -1781,7 +1780,7 @@ class TpuWindowOperator(WindowOperator):
                 "'shed'/'grow' (scotty_tpu.resilience)" + note)
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
-                self.obs.record_failure(e, kind="overflow",
+                self.obs.record_failure(e, kind=_flight.OVERFLOW,
                                         config=self.config)
             raise e
 
@@ -1854,7 +1853,7 @@ class TpuWindowOperator(WindowOperator):
                     "from the last checkpoint")
                 if self.obs is not None:
                     self.obs.counter(_obs.OVERFLOWS).inc()
-                    self.obs.record_failure(e, kind="overflow",
+                    self.obs.record_failure(e, kind=_flight.OVERFLOW,
                                             config=self.config)
                 raise e
             ws_parts.append(ws_h[:m])

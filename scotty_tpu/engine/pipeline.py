@@ -30,6 +30,7 @@ import numpy as np
 
 from .. import jax_config  # noqa: F401
 from .. import obs as _obs
+from ..obs import flight as _flight
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import (
@@ -470,7 +471,13 @@ class FusedPipelineDriver:
     def _interval_key(self, i: int):
         import jax
 
-        return jax.random.fold_in(self._root, i)
+        # the fold-in data rides an EXPLICIT device_put: the step loop
+        # runs under jax.transfer_guard("disallow") in the differential
+        # tests, and the per-interval index is the one sanctioned
+        # host->device upload (an implicit-transfer creep anywhere else
+        # in the step fails those tests)
+        return jax.random.fold_in(self._root,
+                                  jax.device_put(np.uint32(i)))
 
     def _needs_reset(self) -> bool:
         # NOT keyed on _root: the materialize_* helpers lazily seed _root
@@ -478,16 +485,22 @@ class FusedPipelineDriver:
         return not getattr(self, "_pipeline_ready", False)
 
     def _step_interval(self, key, i: int):
+        import jax
+
+        # explicit upload of the interval scalar (same sanctioned-
+        # transfer contract as _interval_key; aval unchanged, so the
+        # lowered step HLO is identical — pinned by tests/hlo_pins.json)
+        iv = jax.device_put(np.int64(i))
         if self._qstate is not None:
             # serving mode: the query table rides the donated carry
             (self.state, self.dm, self._qstate,
              res) = self._step(self.state, self.dm, self._qstate, key,
-                               np.int64(i))
+                               iv)
         elif self._uses_device_metrics:
             self.state, self.dm, res = self._step(self.state, self.dm, key,
-                                                  np.int64(i))
+                                                  iv)
         else:
-            self.state, res = self._step(self.state, key, np.int64(i))
+            self.state, res = self._step(self.state, key, iv)
         return res
 
     def _sync_anchor(self):
@@ -514,8 +527,11 @@ class FusedPipelineDriver:
             if collect:
                 out.append(res)
             if self._gc is not None and self._interval % self.gc_every == 0:
-                self._gc(np.int64(self._interval * self.wm_period_ms
-                                  - self.max_lateness - self.max_fixed))
+                import jax
+
+                self._gc(jax.device_put(
+                    np.int64(self._interval * self.wm_period_ms
+                             - self.max_lateness - self.max_fixed)))
         return out
 
     _gc = None                      # subclasses assign when GC is a
@@ -772,7 +788,7 @@ class StreamPipeline(FusedPipelineDriver):
                              "advance watermarks more often")
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
-                self.obs.record_failure(e, kind="overflow",
+                self.obs.record_failure(e, kind=_flight.OVERFLOW,
                                         config=self.config)
             raise e
 
@@ -1670,7 +1686,7 @@ class AlignedStreamPipeline(FusedPipelineDriver):
                              "gc more often")
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
-                self.obs.record_failure(e, kind="overflow",
+                self.obs.record_failure(e, kind=_flight.OVERFLOW,
                                         config=self.config)
             raise e
 

@@ -45,6 +45,7 @@ import numpy as np
 
 from .. import jax_config  # noqa: F401
 from .. import obs as _obs
+from ..obs import flight as _flight
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import (
@@ -500,9 +501,16 @@ class SessionStreamPipeline(FusedPipelineDriver):
         self.sess_states = self._init_sessions()
 
     def _step_interval(self, key, i: int):
+        import jax
+
+        # explicit device_put of the per-interval scalars — the one
+        # sanctioned h2d upload under the differential tests'
+        # jax.transfer_guard("disallow") (same avals: HLO unchanged,
+        # pinned by tests/hlo_pins.json)
+        iv, live = jax.device_put((np.int64(i),
+                                   np.bool_(self.live(i))))
         self.state, self.sess_states, self.dm, res = self._step(
-            self.state, self.sess_states, self.dm, key, np.int64(i),
-            np.bool_(self.live(i)))
+            self.state, self.sess_states, self.dm, key, iv, live)
         return res
 
     def _gc(self, bound) -> None:
@@ -545,7 +553,7 @@ class SessionStreamPipeline(FusedPipelineDriver):
                 "unrecoverable under any policy)")
             if self.obs is not None:
                 self.obs.counter(_obs.OVERFLOWS).inc()
-                self.obs.record_failure(e, kind="overflow",
+                self.obs.record_failure(e, kind=_flight.OVERFLOW,
                                         config=self.config)
             raise e
 

@@ -406,7 +406,10 @@ class Observability:
                 return _write(self.postmortem_dir, exception=exc,
                               obs=self, config=config,
                               checkpoint=checkpoint)
-        except Exception:       # noqa: BLE001 — crash-path side channel
+        # scotty: allow(silent-drop) — crash-path side channel: this
+        # runs while the REAL failure is propagating; a secondary
+        # postmortem-write error must never mask it
+        except Exception:       # noqa: BLE001
             pass
         return None
 

@@ -52,6 +52,8 @@ Event-kind vocabulary (plain interned strings; recorders pass these,
 ``poison``      a record was dead-lettered (value = poison count so far)
 ``health``      a /healthz probe computed an unhealthy verdict
 ``mark``        free-form user annotation
+``crash``       generic fatal failure (``record_failure`` when no more
+                specific kind applies)
 ==============  ============================================================
 """
 
@@ -128,6 +130,9 @@ QUERY_REBUCKET = "query_rebucket"
 # removing an aged-out generation
 EMIT = "emit"
 DUPLICATE_SUPPRESSED = "duplicate_suppressed"
+#: generic fatal failure recorded by ``record_failure`` when no more
+#: specific kind applies (the postmortem CLI's ``crash`` cause class)
+CRASH = "crash"
 EPOCH_COMMIT = "epoch_commit"
 CKPT_CORRUPT = "ckpt_corrupt"
 LINEAGE_FALLBACK = "lineage_fallback"
@@ -282,10 +287,17 @@ def write_postmortem(dir_path: str, *, exception: Optional[BaseException]
     os.makedirs(dir_path, exist_ok=True)
     path = _next_bundle_path(dir_path)
     tmp = f"{path}.tmp.{os.getpid()}"
+    # scotty: allow(fsio-discipline) — crash-path writer: bundles dump
+    # WHILE a real failure propagates; an armed fsio fault hook
+    # interposing here would fault the very write that records the
+    # failure (the crash-point fuzzer enumerates bundle sites via
+    # Observability.flight_hook instead)
     with open(tmp, "w") as f:
+        # scotty: allow(fsio-discipline) — same crash-path exemption
         json.dump(bundle, f, indent=1, default=float)
         f.flush()
         os.fsync(f.fileno())
+    # scotty: allow(fsio-discipline) — same crash-path exemption
     os.replace(tmp, path)                    # the atomic commit point
     return path
 

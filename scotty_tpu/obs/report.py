@@ -227,7 +227,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable report instead of the table")
     args = ap.parse_args(argv)
     if args.cmd == "report":
-        print(render(args.file, as_json=args.json))
+        from ..utils import stdout_echo
+
+        stdout_echo(render(args.file, as_json=args.json))
         return 0
     if args.cmd == "diff":
         from .diff import diff_main

@@ -404,7 +404,10 @@ def crash_point_sweep(make_env: Callable[[], tuple],
         try:
             with armed:
                 delivered = run()
-        except Exception as e:   # noqa: BLE001 — a dead run is a finding
+        # scotty: allow(silent-drop) — nothing is swallowed: the dead
+        # run becomes a failure row in the sweep report, which is the
+        # sweep's entire output
+        except Exception as e:   # noqa: BLE001
             report.failures.append({
                 "site": site.label(), "error": f"{type(e).__name__}: {e}"})
             continue

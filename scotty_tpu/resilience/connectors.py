@@ -24,6 +24,7 @@ from typing import Callable, Iterator, Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import flight as _flight
 from .clock import Clock, SystemClock
 from .policy import backoff_delay
 
@@ -69,7 +70,7 @@ def retrying_source(make_source: Callable[[int], Iterator],
             failures += 1
             if obs is not None:
                 obs.counter(_obs.RESILIENCE_SOURCE_RETRIES).inc()
-                obs.flight_event("retry", type(e).__name__, offset)
+                obs.flight_event(_flight.RETRY, type(e).__name__, offset)
             if failures > max_retries:
                 raise SourceExhaustedRetries(
                     f"source failed {failures} consecutive times at "
@@ -96,7 +97,8 @@ class PoisonHandler:
         self.count += 1
         if self.obs is not None:
             self.obs.counter(_obs.RESILIENCE_POISON_RECORDS).inc()
-            self.obs.flight_event("poison", type(exc).__name__, self.count)
+            self.obs.flight_event(_flight.POISON, type(exc).__name__,
+                                  self.count)
         if self.dead_letter is not None:
             self.dead_letter(record, exc)
         if self.limit is not None and self.count > self.limit:
@@ -115,7 +117,7 @@ def flag_stall(obs, name: str, gap_s: float, on_stall=None) -> None:
     events the health endpoint and postmortems already watch."""
     if obs is not None:
         obs.counter(_obs.RESILIENCE_STALL_EVENTS).inc()
-        obs.flight_event("stall", name, gap_s)
+        obs.flight_event(_flight.STALL, name, gap_s)
     if on_stall is not None:
         on_stall(gap_s)
 

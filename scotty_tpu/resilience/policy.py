@@ -41,6 +41,7 @@ import dataclasses
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import flight as _flight
 
 
 class OverflowPolicy:
@@ -172,7 +173,8 @@ def grow_pipeline(pipeline, factory, obs=None):
             grown.obs = pipeline.obs
     if obs is not None:
         obs.counter(_obs.RESILIENCE_GROW_EVENTS).inc()
-        obs.flight_event("grow", "capacity", float(new_config.capacity))
+        obs.flight_event(_flight.GROW, "capacity",
+                         float(new_config.capacity))
     return grown
 
 

@@ -136,7 +136,10 @@ class Supervisor:
                 extra={"restarts": self.restarts,
                        "total_restarts": self.total_restarts,
                        "max_restarts": self.max_restarts})
-        except Exception:       # noqa: BLE001 — crash-path side channel
+        # scotty: allow(silent-drop) — crash-path side channel: the
+        # postmortem dump rides a failure already being handled; a
+        # write error here must never mask or abort the recovery
+        except Exception:       # noqa: BLE001
             pass
 
     def _backoff(self, exc: BaseException) -> None:

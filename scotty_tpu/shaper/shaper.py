@@ -38,6 +38,7 @@ from typing import Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import flight as _flight
 from ..resilience.clock import Clock, SystemClock
 from . import device as _dev
 from .host import BatchAccumulator
@@ -199,7 +200,7 @@ class StreamShaper:
             obs.counter(_obs.SHAPER_FLUSHES).inc()
             obs.histogram(_obs.SHAPER_FILL_RATIO).observe(
                 size / self.batch_size)
-            obs.flight_event("shaper_flush", _obs.SHAPER_FLUSHES,
+            obs.flight_event(_flight.SHAPER_FLUSH, _obs.SHAPER_FLUSHES,
                              float(size))
         if self._sink is not None:
             self._sink(*block)
@@ -225,7 +226,7 @@ class StreamShaper:
         obs.gauge(_obs.SHAPER_HELD_TUPLES).set(acc.held)
         if acc.held_highwater > self._held_hw_recorded:
             self._held_hw_recorded = acc.held_highwater
-            obs.flight_event("shaper_held", _obs.SHAPER_HELD_TUPLES,
+            obs.flight_event(_flight.SHAPER_HELD, _obs.SHAPER_HELD_TUPLES,
                              float(acc.held_highwater))
 
     def _fold_counter(self, name: str, key: str, total) -> None:
@@ -370,8 +371,8 @@ class StreamShaper:
                 "stream through late_routing='combined'")
             if obs is not None:
                 obs.counter(_obs.SHAPER_SLACK_OVERFLOWS).inc()
-                obs.flight_event("shaper_overflow",
+                obs.flight_event(_flight.SHAPER_OVERFLOW,
                                  _obs.SHAPER_SLACK_OVERFLOWS, 1.0)
-                obs.record_failure(e, kind="shaper_overflow",
+                obs.record_failure(e, kind=_flight.SHAPER_OVERFLOW,
                                    config=getattr(self.op, "config", None))
             raise e

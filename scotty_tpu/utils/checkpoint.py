@@ -74,6 +74,9 @@ def _write_npz(path: str, leaves: List) -> List[str]:
     seam that lets verification name WHICH leaf a corruption hit."""
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     buf = io.BytesIO()
+    # scotty: allow(fsio-discipline) — serializes into an in-memory
+    # BytesIO; the bytes reach disk via fsio.write_bytes on the next
+    # line, which records the intent digest
     np.savez(buf, **arrays)
     fsio.write_bytes(path, buf.getvalue())
     return [fsio.digest_bytes(np.ascontiguousarray(a).tobytes())

@@ -1,0 +1,10 @@
+"""Mini-tree corpus (clean twin): every gated name resolves."""
+
+DEFAULT_THRESHOLDS = {
+    "metrics": {
+        "engine_tuples": {"direction": "higher"},
+        "resilience_shed_tuples": {"direction": "lower", "default": 0},
+        "serving_tenant_active_t0": {"direction": "lower"},
+    },
+    "require_cells": True,
+}

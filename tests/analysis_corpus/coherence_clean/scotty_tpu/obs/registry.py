@@ -1,0 +1,10 @@
+"""Mini-tree corpus (clean twin): the created names, including a
+dynamic per-tenant prefix that anchors placeholder doc spellings."""
+
+RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
+
+
+def wire(registry, tenant):
+    registry.counter(RESILIENCE_SHED_TUPLES)
+    registry.counter("engine_tuples")
+    registry.gauge(f"serving_tenant_active_{tenant}")

@@ -1,0 +1,10 @@
+"""Mini-tree corpus: a threshold gate keyed on a metric nothing
+creates — it silently gates nothing."""
+
+DEFAULT_THRESHOLDS = {
+    "metrics": {
+        "engine_tuples_total": {"direction": "higher"},
+        "resilience_shed_tuple": {"direction": "lower", "default": 0},
+    },
+    "require_cells": True,
+}

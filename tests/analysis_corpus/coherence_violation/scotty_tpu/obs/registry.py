@@ -1,0 +1,10 @@
+"""Mini-tree corpus: the names the code ACTUALLY creates (note the
+plural ``resilience_shed_tuples`` — the threshold file dropped the
+'s', the classic typo'd-gate drift)."""
+
+RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
+
+
+def wire(registry):
+    registry.counter(RESILIENCE_SHED_TUPLES)
+    registry.counter("engine_tuples")

@@ -1,0 +1,20 @@
+"""Seeded violation: both arms of the ISSUE 2 donation bug class —
+use-after-donation and a numpy-backed leaf into a donating kernel
+(the checkpoint-restore segfault)."""
+
+import jax
+import numpy as np
+
+
+class Pipeline:
+    def build(self, step):
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def bad_use_after(self):
+        res = self._step(self.state, 1)
+        return float(self.state.sum()) + res      # read after donation
+
+    def bad_restore(self, saved_leaves):
+        host = np.asarray(saved_leaves[0])        # CPU zero-copy leaf
+        res = self._step(host, 1)                 # host memory donated
+        return res

@@ -1,0 +1,12 @@
+"""Clean twin: kinds come from the obs.flight constant vocabulary;
+variables pass (the framework can't resolve them, and the constants
+they carry were checked at their own call sites)."""
+
+from scotty_tpu.obs import flight as _flight
+
+
+def on_overflow(obs, exc, kind):
+    obs.flight_event(_flight.OVERFLOW, "slice_store", 1.0)
+    obs.record_failure(exc, kind=_flight.OVERFLOW)
+    obs.flight.record(_flight.WATERMARK, "watermark", 100.0)
+    obs.flight_event(kind, "forwarded", 0.0)      # variable: passes

@@ -1,0 +1,10 @@
+"""Seeded violation: string-literal flight-event kinds (the ISSUE 6
+review finding) — a typo'd literal records events the postmortem
+classifier silently fails to match."""
+
+
+def on_overflow(obs, exc, flight):
+    obs.flight_event("overlow", "slice_store", 1.0)   # typo'd literal
+    obs.record_failure(exc, kind="overflow")          # literal kind
+    flight.record("shed", "admission", 3.0)
+    obs.flight.record("watermark", "watermark", 100.0)

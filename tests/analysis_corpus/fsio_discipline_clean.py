@@ -1,0 +1,26 @@
+"""Clean twin: every committed byte rides fsio — intent digests
+recorded, crash-point fuzzer can interpose, replace is durable."""
+
+import io
+import json
+import pickle
+
+import numpy as np
+
+from scotty_tpu.utils import fsio
+
+
+def commit_state(path, doc, leaves, op):
+    fsio.write_bytes(path + ".tmp", json.dumps(doc).encode())
+    buf = io.BytesIO()
+    # scotty: allow(fsio-discipline) — serializes into an in-memory
+    # BytesIO; the bytes commit via fsio.write_bytes below
+    np.savez(buf, *leaves)
+    fsio.write_bytes(path + ".npz", buf.getvalue())
+    fsio.write_bytes(path + ".pkl", pickle.dumps(op))
+    fsio.replace(path + ".tmp", path)
+
+
+def read_back(path):
+    with open(path) as f:           # reads are not commits
+        return json.load(f)

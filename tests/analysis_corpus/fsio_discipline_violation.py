@@ -1,0 +1,18 @@
+"""Seeded violation: the ISSUE 8 bug class — committed state written
+around utils.fsio (a silent short write would be blessed by a
+disk-bytes manifest fallback and restore would crash-loop)."""
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+
+def commit_state(path, doc, leaves, op):
+    with open(path + ".tmp", "w") as f:       # fires fsio-discipline
+        json.dump(doc, f)                     # fires fsio-discipline
+    np.savez(path + ".npz", *leaves)          # fires fsio-discipline
+    with open(path + ".pkl", "wb") as g:      # fires fsio-discipline
+        pickle.dump(op, g)                    # fires fsio-discipline
+    os.replace(path + ".tmp", path)           # fires fsio-discipline

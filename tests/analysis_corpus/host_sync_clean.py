@@ -1,0 +1,19 @@
+"""Clean twin: dispatch stays async; the round trip lives in the
+documented drain-point functions."""
+
+import jax
+
+
+class HotLoop:
+    def run(self, n):
+        out = []
+        for i in range(n):
+            out.append(self._step(self.state, i))
+        return out                  # handles only — no sync
+
+    def sync(self):
+        return int(jax.device_get(self.state.n_slices))
+
+    def check_overflow(self):
+        if bool(jax.device_get(self.state.overflow)):
+            raise RuntimeError("overflow")

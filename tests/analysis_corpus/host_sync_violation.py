@@ -1,0 +1,16 @@
+"""Seeded violation: host syncs outside the sanctioned drain points —
+the zero-sync fused-step contract's creep class."""
+
+import jax
+
+
+class HotLoop:
+    def run(self, n):
+        out = []
+        for i in range(n):
+            res = self._step(self.state, i)
+            jax.block_until_ready(res)            # fires host-sync
+            out.append(jax.device_get(res))       # fires host-sync
+            if self.state.overflow.item():        # fires host-sync
+                break
+        return out

@@ -1,0 +1,6 @@
+"""Clean twin: output through an overridable echo sink."""
+
+
+def emit_result(row, echo):
+    echo(f"result: {row}")
+    return row

@@ -1,0 +1,6 @@
+"""Seeded violation: bare print in engine-silence scope."""
+
+
+def emit_result(row):
+    print("result:", row)          # fires no-print
+    return row

@@ -1,0 +1,13 @@
+"""Clean twin: waits ride the injectable Clock (chaos tests pass a
+ManualClock); asyncio.sleep is event-loop time, not a wall-clock
+stall."""
+
+import asyncio
+
+
+def backoff(clock, delay_s):
+    clock.sleep(delay_s)
+
+
+async def poll_tick(delay_s):
+    await asyncio.sleep(delay_s)

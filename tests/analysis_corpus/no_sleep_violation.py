@@ -1,0 +1,10 @@
+"""Seeded violation: wall-clock sleeps instead of the injectable
+Clock."""
+
+import time
+from time import sleep
+
+
+def backoff(delay_s):
+    time.sleep(delay_s)            # fires no-sleep
+    sleep(delay_s)                 # fires no-sleep (imported form)

@@ -1,0 +1,11 @@
+"""Clean twin: timestamps from resilience.clock; perf_counter stays
+allowed for relative durations."""
+
+import time
+
+
+def export_row(clock, value, wall_time):
+    t0 = time.perf_counter()
+    row = {"t": wall_time(), "event_t": clock.now(), "v": value}
+    row["build_ms"] = (time.perf_counter() - t0) * 1e3
+    return row

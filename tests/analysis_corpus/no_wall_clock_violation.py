@@ -1,0 +1,8 @@
+"""Seeded violation: bare wall-clock reads in an export path."""
+
+import time
+from time import monotonic
+
+
+def export_row(value):
+    return {"t": time.time(), "mono": monotonic(), "v": value}

@@ -1,0 +1,24 @@
+"""Clean twin: broad handlers leave evidence (count, dead-letter, or
+re-raise); narrow typed handlers are ordinary control flow."""
+
+
+def deliver_all(records, sink, obs, poison):
+    delivered = 0
+    for rec in records:
+        try:
+            sink(rec)
+            delivered += 1
+        except Exception as e:         # counted + dead-lettered
+            obs.counter("resilience_poison_records").inc()
+            poison.handle(rec, e)
+    return delivered
+
+
+def pump(source, op):
+    while True:
+        try:
+            op.process_element(*next(source))
+        except StopIteration:          # narrow: ordinary control flow
+            break
+        except Exception:
+            raise                      # re-raise is evidence

@@ -1,0 +1,23 @@
+"""Seeded violation: broad except handlers that swallow data-path
+errors without evidence — holes in the tuple-conservation audit."""
+
+
+def deliver_all(records, sink):
+    delivered = 0
+    for rec in records:
+        try:
+            sink(rec)
+            delivered += 1
+        except Exception:              # fires silent-drop
+            pass
+    return delivered
+
+
+def pump(source, op):
+    while True:
+        try:
+            op.process_element(*next(source))
+        except StopIteration:
+            break
+        except:                        # noqa: E722 — fires silent-drop
+            continue

@@ -1,0 +1,8 @@
+"""Framework corpus: a violation silenced by a REASONED suppression —
+reported as suppressed, never as new."""
+
+
+def emit(row):
+    # scotty: allow(no-print) — corpus fixture proving the reasoned
+    # form silences the finding
+    print("row:", row)

@@ -1,0 +1,7 @@
+"""Framework corpus: a reasonless allow comment — the underlying
+finding still counts AND the comment itself is a suppression-format
+finding ("zero findings left unexplained" is the acceptance bar)."""
+
+
+def emit(row):
+    print("row:", row)      # scotty: allow(no-print)

@@ -1,0 +1,243 @@
+"""The invariant linter (ISSUE 9 tentpole): every rule fires on its
+seeded corpus violation, stays quiet on the paired clean twin, the
+framework's suppression/baseline machinery behaves, and — the check
+that gates this repo — the REAL tree is clean (this test IS
+``analysis check`` running inside tier-1)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from scotty_tpu.analysis import (
+    Project, RULES, default_root, load_baseline, run_check,
+    write_baseline,
+)
+from scotty_tpu.analysis.core import SUPPRESSION_FORMAT, Finding
+
+CORPUS = pathlib.Path(__file__).parent / "analysis_corpus"
+
+#: rule → (violation file, clean twin, minimum findings in violation)
+PAIRS = {
+    "no-print": ("no_print_violation.py", "no_print_clean.py", 1),
+    "no-sleep": ("no_sleep_violation.py", "no_sleep_clean.py", 2),
+    "no-wall-clock": ("no_wall_clock_violation.py",
+                      "no_wall_clock_clean.py", 2),
+    "fsio-discipline": ("fsio_discipline_violation.py",
+                        "fsio_discipline_clean.py", 6),
+    "host-sync": ("host_sync_violation.py", "host_sync_clean.py", 3),
+    "donation-safety": ("donation_safety_violation.py",
+                        "donation_safety_clean.py", 2),
+    "flight-kind": ("flight_kind_violation.py",
+                    "flight_kind_clean.py", 4),
+    "silent-drop": ("silent_drop_violation.py",
+                    "silent_drop_clean.py", 2),
+}
+
+
+def _run_on(rel_files, rule, root=CORPUS):
+    project = Project(root, rel_paths=rel_files, doc_paths=())
+    new, suppressed, baselined = run_check(
+        project, [RULES[rule]], respect_scope=False)
+    return new, suppressed
+
+
+@pytest.mark.parametrize("rule", sorted(PAIRS))
+def test_rule_fires_on_violation_corpus(rule):
+    vio, _, n_min = PAIRS[rule]
+    new, _ = _run_on([vio], rule)
+    hits = [f for f in new if f.rule == rule]
+    assert len(hits) >= n_min, (
+        f"{rule} found {len(hits)} violations in {vio}, "
+        f"expected >= {n_min}: {[f.render() for f in new]}")
+
+
+@pytest.mark.parametrize("rule", sorted(PAIRS))
+def test_rule_quiet_on_clean_twin(rule):
+    _, clean, _ = PAIRS[rule]
+    new, _ = _run_on([clean], rule)
+    assert not new, [f.render() for f in new]
+
+
+@pytest.mark.parametrize("variant,expect_findings", [
+    ("coherence_violation", 3),     # 2 typo'd gate keys + 1 doc token
+    ("coherence_clean", 0),
+])
+def test_metric_coherence_on_mini_tree(variant, expect_findings):
+    root = CORPUS / variant
+    project = Project(
+        root, rel_paths=["scotty_tpu/obs/diff.py",
+                         "scotty_tpu/obs/registry.py"],
+        doc_paths=["docs/API.md"])
+    new, _, _ = run_check(project, [RULES["metric-coherence"]],
+                          respect_scope=False)
+    assert len(new) == expect_findings, [f.render() for f in new]
+
+
+def test_reasoned_suppression_silences():
+    new, suppressed = _run_on(["suppression_reasoned.py"], "no-print")
+    assert not new
+    assert len(suppressed) == 1 and suppressed[0].rule == "no-print"
+
+
+def test_reasonless_suppression_is_its_own_finding():
+    new, suppressed = _run_on(["suppression_reasonless.py"], "no-print")
+    assert not suppressed
+    rules = sorted(f.rule for f in new)
+    assert rules == sorted(["no-print", SUPPRESSION_FORMAT]), rules
+
+
+def test_baseline_grandfathers_by_snippet_not_line(tmp_path):
+    vio = PAIRS["no-print"][0]
+    project = Project(CORPUS, rel_paths=[vio], doc_paths=())
+    new, _, _ = run_check(project, [RULES["no-print"]],
+                          respect_scope=False)
+    assert new
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(bl_path, new)
+    baseline = load_baseline(bl_path)
+    again, _, baselined = run_check(project, [RULES["no-print"]],
+                                    baseline=baseline,
+                                    respect_scope=False)
+    assert not again and len(baselined) == len(new)
+    # a DIFFERENT finding (other snippet) is not grandfathered
+    other = Finding(rule="no-print", path=vio, line=99,
+                    message="x", snippet="print('fresh')")
+    assert other.key() not in baseline
+
+
+def test_real_tree_is_clean():
+    """`analysis check` inside tier-1: zero new findings on the repo,
+    every suppression carrying a reason (reasonless ones surface as
+    suppression-format findings and fail here)."""
+    root = default_root()
+    project = Project(root)
+    new, suppressed, _ = run_check(
+        project, baseline=load_baseline(
+            root / "analysis_baseline.json"))
+    assert not new, "\n".join(f.render() for f in new)
+    # the suppressions that explain the deliberate sites exist
+    assert suppressed, "expected reasoned suppressions in the tree"
+
+
+def test_every_registered_rule_has_corpus_coverage():
+    """A rule without a seeded violation proves nothing — adding a rule
+    requires adding its corpus pair (metric-coherence uses the
+    mini-trees instead of a flat pair)."""
+    covered = set(PAIRS) | {"metric-coherence"}
+    assert covered == set(RULES), (
+        f"uncovered rules: {set(RULES) ^ covered}")
+
+
+def test_cli_check_json_and_exit_codes(tmp_path):
+    """The CLI face: exit 0 + parseable JSON on the clean tree; exit 1
+    when pointed at a tree containing a violation."""
+    out = subprocess.run(
+        [sys.executable, "-m", "scotty_tpu.analysis", "check",
+         "--format", "json"],
+        capture_output=True, text=True, cwd=str(default_root()))
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["new"] == [] and doc["suppressed"] >= 1
+    # a dirty mini-root: one violation file under scotty_tpu/
+    dirty = tmp_path / "scotty_tpu"
+    dirty.mkdir()
+    (dirty / "mod.py").write_text("def f(x):\n    print(x)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "scotty_tpu.analysis", "check",
+         "--rule", "no-print", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=str(default_root()))
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "no-print" in out.stdout
+
+
+def test_partial_rule_write_baseline_keeps_other_rules(tmp_path):
+    """`check --rule X --write-baseline` must not drop OTHER rules'
+    grandfathered entries (review finding: the naive rewrite lost
+    them and the next full check went red)."""
+    pkg = tmp_path / "scotty_tpu"
+    pkg.mkdir()
+    # a plain no-sleep finding AND a reasonless no-sleep allow: the
+    # partial no-print run can re-derive NEITHER the no-sleep entry nor
+    # its suppression-format entry — both must survive via keep
+    (pkg / "mod.py").write_text(
+        "import time\n\ndef f(x):\n    print(x)\n    time.sleep(1)\n"
+        "    time.sleep(2)      # scotty: allow(no-sleep)\n")
+    env = [sys.executable, "-m", "scotty_tpu.analysis", "check",
+           "--root", str(tmp_path)]
+    cwd = str(default_root())
+    # grandfather everything, then re-write for ONE rule only
+    subprocess.run(env + ["--write-baseline"], capture_output=True,
+                   cwd=cwd)
+    out = subprocess.run(env + ["--rule", "no-print",
+                                "--write-baseline"],
+                         capture_output=True, text=True, cwd=cwd)
+    assert out.returncode == 0, out.stdout + out.stderr
+    bl = load_baseline(tmp_path / "analysis_baseline.json")
+    assert any(k[0] == "no-sleep" for k in bl), bl
+    assert any(k[0] == SUPPRESSION_FORMAT for k in bl), bl
+    out = subprocess.run(env, capture_output=True, text=True, cwd=cwd)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_write_baseline_covers_suppression_format(tmp_path):
+    """After --write-baseline, the immediate re-check exits 0 even when
+    the findings included a reasonless suppression (review finding:
+    SUPPRESSION_FORMAT findings skipped the baseline filter)."""
+    pkg = tmp_path / "scotty_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def f(x):\n    print(x)      # scotty: allow(no-print)\n")
+    env = [sys.executable, "-m", "scotty_tpu.analysis", "check",
+           "--root", str(tmp_path)]
+    cwd = str(default_root())
+    out = subprocess.run(env, capture_output=True, text=True, cwd=cwd)
+    assert out.returncode == 1
+    subprocess.run(env + ["--write-baseline"], capture_output=True,
+                   cwd=cwd)
+    out = subprocess.run(env, capture_output=True, text=True, cwd=cwd)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_pin_hlo_update_refuses_corrupt_pins_file(tmp_path):
+    """A corrupt pins file must propagate, not be silently reset — a
+    --step subset update over {} would discard the other steps'
+    lineage hashes (review finding)."""
+    bad = tmp_path / "pins.json"
+    bad.write_text('{"schema": "wrong/1", "pins": {}}')
+    out = subprocess.run(
+        [sys.executable, "-m", "scotty_tpu.analysis", "pin-hlo",
+         "--update", "--step", "aligned", "--pins", str(bad)],
+        capture_output=True, text=True, cwd=str(default_root()))
+    assert out.returncode != 0
+    assert "not an hlo-pins file" in (out.stdout + out.stderr)
+    # the corrupt file was NOT overwritten
+    assert bad.read_text().startswith('{"schema": "wrong/1"')
+
+
+def test_silent_drop_builtin_set_is_not_evidence(tmp_path):
+    """`except Exception: ids = set()` must still flag — the builtin
+    constructor is not a counter move (review finding: the bare-name
+    arm of the evidence matcher accepted it)."""
+    (tmp_path / "mod.py").write_text(
+        "def f(sink, rec):\n"
+        "    try:\n"
+        "        sink(rec)\n"
+        "    except Exception:\n"
+        "        ids = set()\n"
+        "    return ids\n")
+    project = Project(tmp_path, rel_paths=["mod.py"], doc_paths=())
+    new, _, _ = run_check(project, [RULES["silent-drop"]],
+                          respect_scope=False)
+    assert len(new) == 1 and new[0].rule == "silent-drop", new
+
+
+def test_cli_rule_catalog_lists_all_rules():
+    out = subprocess.run(
+        [sys.executable, "-m", "scotty_tpu.analysis", "check", "--list"],
+        capture_output=True, text=True, cwd=str(default_root()))
+    assert out.returncode == 0
+    for name in RULES:
+        assert name in out.stdout

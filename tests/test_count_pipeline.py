@@ -227,3 +227,24 @@ def test_no_overflow_on_contract_streams():
     p.reset()
     p.run(5, collect=False)
     assert not bool(jax.device_get(p.state.overflow))
+
+
+def test_count_steps_clean_under_transfer_guard():
+    """ISSUE 9 satellite: warmed count-measure steps dispatch with zero
+    implicit transfers under jax.transfer_guard("disallow") and the
+    emitted windows bit-match the per-record oracle replay."""
+    agg = SumAggregation()
+    windows = [TumblingWindow(Count, 7)]
+    p = CountStreamPipeline(windows, [agg], throughput=2000,
+                            wm_period_ms=100, max_lateness=100, seed=0,
+                            out_of_order_pct=0.2)
+    p.reset()
+    outs = list(p.run(1))       # warmup: compile outside the guard
+    with jax.transfer_guard("disallow"):
+        outs.extend(p.run(4))
+    fetched = jax.device_get(outs)
+    p.check_overflow()
+    got = pipeline_windows(p, fetched, agg, 5)
+    ref = oracle_windows(
+        make_dev(windows, agg, 100), p, agg, 5)
+    assert_same(ref, got)

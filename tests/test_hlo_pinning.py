@@ -1,0 +1,49 @@
+"""Tier-1 HLO pinning (ISSUE 9 satellite): the canonical fused-step
+lowerings hash to the values recorded in tests/hlo_pins.json.
+
+This replaces the manual per-PR "aligned-step HLO hash byte-identical"
+ritual (hand-run since ISSUE 1; the pinned aligned hash 19fd4d91… is
+the exact value ISSUE 8 recorded, carried forward unchanged by
+reproducing its construction byte-for-byte in
+scotty_tpu.analysis.hlo). A red test here means the jitted step's HLO
+drifted: if deliberate, run ``python -m scotty_tpu.analysis pin-hlo
+--update`` and let review see the hash diff; if not, find the
+instrumentation/refactor that leaked into the traced path.
+"""
+
+import pytest
+
+from scotty_tpu.analysis import hlo
+
+
+@pytest.fixture(scope="module")
+def pins():
+    # loaded inside the fixture (not at import) so a missing/corrupt
+    # pins file fails with the actionable message, not a collection
+    # error that hides it
+    try:
+        return hlo.load_pins()
+    except (OSError, ValueError) as e:
+        pytest.fail(f"cannot load tests/hlo_pins.json ({e}) — run "
+                    "python -m scotty_tpu.analysis pin-hlo --update")
+
+
+@pytest.mark.parametrize("name", sorted(hlo.CANONICAL_STEPS))
+def test_step_lowering_matches_pin(name, pins):
+    assert name in pins, (
+        f"no pin recorded for canonical step {name!r} — run "
+        "python -m scotty_tpu.analysis pin-hlo --update")
+    got = hlo.step_hash(name)
+    assert got == pins[name], (
+        f"{name} step HLO drifted: {got} != pinned {pins[name]} — "
+        "deliberate? pin-hlo --update; accidental? something leaked "
+        "into the jitted path")
+
+
+def test_mutated_config_fails_the_pin(pins):
+    """The pin actually discriminates: a deliberately mutated step
+    config (tumbling 100 ms instead of the canonical 50 ms) must lower
+    to different HLO — otherwise a green pin test proves nothing."""
+    mutated = hlo.lowered_hash(
+        hlo.CANONICAL_STEPS["aligned"](window_ms=100))
+    assert mutated != pins["aligned"]

@@ -1,130 +1,31 @@
-"""Tier-1 lint: the engine core stays silent (ISSUE 1 satellite; extended
-to connectors/ and bench/ in ISSUE 2, serving/ in ISSUE 6, ingest/ and
-soak/ in ISSUE 7, delivery/ in ISSUE 8), nothing sleeps on the wall
-clock outside the injectable-clock module (ISSUE 3 satellite;
-serving/ingest/soak are covered by the all-of-scotty_tpu sweep), and the
-obs/ingest/soak/delivery layers never read the wall clock directly
-(ISSUE 4 satellite, extended in ISSUES 7/8 — a soak that timed its
-audits on a bare ``time.time()``, or a delivery ledger that stamped
-epochs off the wall clock, could never run deterministically on a
-ManualClock).
+"""Tier-1 hygiene lints, now driven by the analysis rules (ISSUE 9
+satellite): the three grown-by-accretion AST walkers this file used to
+carry (no-print since ISSUE 1, no-sleep since ISSUE 3, no-wall-clock
+since ISSUE 4, each re-extended by hand in ISSUES 2/6/7/8) collapsed
+into one parametrized test over :mod:`scotty_tpu.analysis`. Extending
+a scope is now a one-line ``include``/``exclude`` change on the rule
+class in scotty_tpu/analysis/rules/hygiene.py — and the rules' firing
+behavior is itself proven by the seeded corpus
+(tests/analysis_corpus/, tests/test_analysis.py).
 
-The reference's engine never logs — its only output was the benchmark-side
-throughput logger (SURVEY.md §5). The port preserves that discipline: all
-output from ``scotty_tpu/engine/``, ``scotty_tpu/core/``,
-``scotty_tpu/connectors/`` and ``scotty_tpu/bench/`` must flow through the
-metrics registry / overridable echo sinks (scotty_tpu.obs), never a bare
-``print(`` — bench output in particular must stay capturable so the
-``obs diff`` gate and tests can consume it. AST-based so strings/comments
-mentioning print don't trip it.
-
-The sleep lint covers ALL of ``scotty_tpu/``: every backoff/watchdog wait
-must go through :mod:`scotty_tpu.resilience.clock` (the one exempt
-module), so chaos tests can drive recovery deterministically with a
-ManualClock — a bare ``time.sleep`` anywhere would reintroduce
-wall-clock nondeterminism into the resilience paths.
+Kept as a separate file (rather than folded into test_analysis.py's
+whole-tree check) so a hygiene regression fails with the rule's name
+in the test id, exactly as the old walkers did.
 """
 
-import ast
-import pathlib
+import pytest
 
-import scotty_tpu
+from scotty_tpu.analysis import Project, RULES, default_root, run_check
 
-PKG_ROOT = pathlib.Path(scotty_tpu.__file__).parent
-SILENT_DIRS = ("engine", "core", "connectors", "bench", "serving",
-               "ingest", "soak", "delivery")
-#: packages whose wall-clock reads must route through resilience.clock
-#: (wall_time / the injectable Clock); time.perf_counter stays allowed
-WALLTIME_DIRS = ("obs", "ingest", "soak", "delivery")
-#: the single module allowed to call time.sleep (SystemClock lives there)
-SLEEP_EXEMPT = PKG_ROOT / "resilience" / "clock.py"
+HYGIENE_RULES = ("no-print", "no-sleep", "no-wall-clock")
 
 
-def _print_calls(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            yield f"{path}:{node.lineno}"
+@pytest.fixture(scope="module")
+def project():
+    return Project(default_root())
 
 
-def test_engine_core_have_no_bare_print():
-    offenders = []
-    for d in SILENT_DIRS:
-        for path in sorted((PKG_ROOT / d).rglob("*.py")):
-            offenders.extend(_print_calls(path))
-    assert not offenders, (
-        "bare print( in the silent engine core — route output through "
-        "the scotty_tpu.obs registry/sinks instead: "
-        + ", ".join(offenders))
-
-
-def _sleep_calls(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        # time.sleep(...)
-        if (isinstance(f, ast.Attribute) and f.attr == "sleep"
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "time"):
-            yield f"{path}:{node.lineno}"
-        # from time import sleep; sleep(...)
-        elif isinstance(f, ast.Name) and f.id == "sleep":
-            yield f"{path}:{node.lineno}"
-
-
-def test_no_bare_time_sleep():
-    """All waits go through the injectable clock
-    (scotty_tpu.resilience.clock) so backoff/watchdog logic stays
-    deterministic under chaos tests; ``asyncio.sleep``/``Clock.sleep``
-    calls are fine — only the wall-clock ``time.sleep`` (and a bare
-    imported ``sleep``) are rejected, everywhere but clock.py itself."""
-    offenders = []
-    for path in sorted(PKG_ROOT.rglob("*.py")):
-        if path == SLEEP_EXEMPT:
-            continue
-        offenders.extend(_sleep_calls(path))
-    assert not offenders, (
-        "bare time.sleep in scotty_tpu — route waits through "
-        "scotty_tpu.resilience.clock (injectable Clock): "
-        + ", ".join(offenders))
-
-
-def _walltime_calls(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        # time.time(...) / time.monotonic(...)
-        if (isinstance(f, ast.Attribute)
-                and f.attr in ("time", "monotonic")
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "time"):
-            yield f"{path}:{node.lineno}"
-        # from time import time/monotonic; time(...) / monotonic(...)
-        elif isinstance(f, ast.Name) and f.id in ("time", "monotonic"):
-            yield f"{path}:{node.lineno}"
-
-
-def test_no_bare_walltime_in_obs():
-    """ISSUE 4 satellite, mirroring the no-bare-sleep rule (extended over
-    ``ingest/`` and ``soak/`` in ISSUE 7): flight recorder / postmortem /
-    export timestamps — and every soak pace/audit/watchdog read — must
-    come from the injectable clock (``resilience.clock.Clock`` for
-    monotonic event time, ``resilience.clock.wall_time`` for export
-    rows) — never a bare ``time.time()``/``time.monotonic()`` — so chaos
-    tests can drive the whole operational layer on a ManualClock and
-    bundle timelines stay deterministic. ``time.perf_counter`` (relative
-    span durations) stays allowed."""
-    offenders = []
-    for d in WALLTIME_DIRS:
-        for path in sorted((PKG_ROOT / d).rglob("*.py")):
-            offenders.extend(_walltime_calls(path))
-    assert not offenders, (
-        "bare time.time()/time.monotonic() in scotty_tpu/{obs,ingest,"
-        "soak}/ — route timestamps through scotty_tpu.resilience.clock "
-        "(injectable Clock / wall_time): " + ", ".join(offenders))
+@pytest.mark.parametrize("rule", HYGIENE_RULES)
+def test_hygiene_rule_clean_over_package(rule, project):
+    new, _, _ = run_check(project, [RULES[rule]])
+    assert not new, "\n".join(f.render() for f in new)

@@ -346,3 +346,47 @@ def test_sub_row_chunking_differential():
         assert set(got) == set(exp), (i, got, exp)
         for k in got:
             assert got[k] == pytest.approx(exp[k], rel=1e-4), (i, k)
+
+
+def test_steps_clean_under_transfer_guard():
+    """ISSUE 9 satellite — the dynamic complement of the host-sync rule:
+    after warmup, N aligned steps run under
+    ``jax.transfer_guard("disallow")``. The only sanctioned
+    host->device movement per interval is the EXPLICIT device_put of
+    the interval scalars in FusedPipelineDriver (an implicit transfer
+    creeping into the step loop — a numpy operand, a host-forced
+    concretization — fails here). The results must still bit-match the
+    oracle: the guard proves transfer-cleanliness, the differential
+    body proves it didn't change semantics."""
+    import jax
+
+    windows = [TumblingWindow(Time, 50)]
+    p = AlignedStreamPipeline(
+        windows, [SumAggregation()], config=CFG, throughput=20_000,
+        wm_period_ms=100, max_lateness=100, seed=5, gc_every=10 ** 9)
+    sim = SlicingWindowOperator()
+    for w in windows:
+        sim.add_window_assigner(w)
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(100)
+    p.reset()
+    p.run(1)        # warmup: compile outside the guard
+    outs = [None]
+    with jax.transfer_guard("disallow"):
+        outs.extend(p.run(3))
+    p.sync()        # drain point: device_get is explicit, outside guard
+    for i in range(4):
+        vals, ts = p.materialize_interval(i)
+        for v, t in zip(vals, ts):
+            sim.process_element(float(v), int(t))
+        exp = {(w.get_start(), w.get_end()): float(w.get_agg_values()[0])
+               for w in sim.process_watermark((i + 1) * 100)
+               if w.has_value()}
+        if outs[i] is None:
+            continue
+        got = {(s, e): float(v[0])
+               for s, e, c, v in p.lowered_results(outs[i]) if c > 0}
+        assert set(got) == set(exp), (i, got, exp)
+        for k in got:
+            assert got[k] == pytest.approx(exp[k], rel=1e-4), (i, k)
+    p.check_overflow()

@@ -122,3 +122,41 @@ def test_session_pipeline_hll_matches_device_operator():
         total += len(got)
     p.check_overflow()
     assert total > 0
+
+
+def test_session_steps_clean_under_transfer_guard():
+    """ISSUE 9 satellite: warmed session steps (the donated three-carry
+    step plus its per-interval (index, live) scalars) dispatch with
+    zero implicit transfers under jax.transfer_guard("disallow");
+    results still bit-match the host oracle."""
+    import jax
+
+    windows = [SessionWindow(Time, 1000)]
+    p = SessionStreamPipeline(
+        windows, [SumAggregation()], config=CFG, throughput=4000,
+        wm_period_ms=1000, max_lateness=1000, seed=7, session_config=SC)
+    sim = SlicingWindowOperator()
+    for w in windows:
+        sim.add_window_assigner(w)
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(1000)
+    p.reset()
+    outs = list(p.run(1))       # warmup: compile outside the guard
+    with jax.transfer_guard("disallow"):
+        outs.extend(p.run(5))
+    p.sync()
+    for i, out in enumerate(outs):
+        vals, ts = p.materialize_interval(i)
+        if ts.size:
+            order = np.argsort(ts, kind="stable")
+            sim.process_elements(vals[order], ts[order])
+        want = {(w.get_start(), w.get_end()): w.get_agg_values()
+                for w in sim.process_watermark((i + 1) * 1000)
+                if w.has_value()}
+        got = {(s, e): v for (s, e, c, v) in p.lowered_results(out)}
+        assert set(got) == set(want), (i, set(want) ^ set(got))
+        for k in want:
+            for a, b in zip(want[k], got[k]):
+                assert float(a) == pytest.approx(float(b), rel=2e-4), \
+                    (i, k)
+    p.check_overflow()
