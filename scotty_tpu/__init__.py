@@ -30,6 +30,7 @@ from .core import (
     AggregateFunction,
     AggregateWindow,
     CountAggregation,
+    CountMinSketchAggregation,
     DDSketchQuantileAggregation,
     FixedBandWindow,
     HyperLogLogAggregation,
@@ -111,6 +112,7 @@ def __getattr__(name):
 
 __all__ = [
     "AggregateFunction", "AggregateWindow", "CountAggregation",
+    "CountMinSketchAggregation",
     "DDSketchQuantileAggregation", "FixedBandWindow", "HyperLogLogAggregation",
     "InvertibleReduceAggregateFunction", "MaxAggregation", "MeanAggregation",
     "MinAggregation", "QuantileAggregation", "ReduceAggregateFunction",

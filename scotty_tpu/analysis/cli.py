@@ -95,6 +95,17 @@ def pin_hlo_main(update: bool = False, pins_file=None, steps=None,
                  echo=None) -> int:
     if echo is None:
         echo = stdout_echo
+    # the mesh step lowers over an 8-device mesh, and the flag must land
+    # before anything initializes a JAX backend — the CLI owns its
+    # process, so set it here (tier-1's conftest does the same; the
+    # single-device steps' lowerings are device-count-independent, which
+    # tests/test_hlo_pinning.py pins either way)
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     from . import hlo
 
     names = list(steps or hlo.CANONICAL_STEPS)

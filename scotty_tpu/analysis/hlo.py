@@ -86,11 +86,44 @@ def _count_lowered():
     return p._step.lower(p.state, p.dm, p._interval_key(0), np.int64(0))
 
 
+def _mesh_lowered():
+    """Canonical mesh-sharded keyed step (ISSUE 10): 16 keys over the
+    8-device virtual mesh — the shard_map per-shard program + the
+    in-executable psum global fold. Needs 8 devices BEFORE jax
+    initializes: tier-1's conftest forces them, and the ``pin-hlo`` CLI
+    sets the same flag when it owns the process (a live backend with
+    fewer devices fails loudly here instead of pinning a different
+    topology's lowering)."""
+    import jax
+    import numpy as np
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.mesh import MeshKeyedPipeline
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            "the mesh pin lowers over an 8-device mesh; run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 (set "
+            "before anything initializes a JAX backend)")
+    p = MeshKeyedPipeline(
+        [TumblingWindow(WindowMeasure.Time, 50)], [SumAggregation()],
+        n_keys=16, n_shards=8,
+        config=EngineConfig(capacity=1 << 10, batch_size=32,
+                            annex_capacity=32, min_trigger_pad=32),
+        throughput=16 * 2000, wm_period_ms=100, max_lateness=100, seed=5,
+        gc_every=10 ** 9)
+    p.reset()
+    return p._step.lower(p.state, p._interval_key(0),
+                         jax.device_put(np.int64(0)))
+
+
 #: the pinned step configs; insertion order is the report order
 CANONICAL_STEPS = {
     "aligned": _aligned_lowered,
     "session": _session_lowered,
     "count": _count_lowered,
+    "mesh": _mesh_lowered,
 }
 
 

@@ -37,6 +37,11 @@ DRAIN_POINT_FUNCTIONS = frozenset({
     "materialize_interval", "materialize_interval_late",
     "_fetch_grid", "_fetch_sessions", "_pol_refresh", "_grow_capacity",
     "measure_link", "process_watermark_arrays_combined",
+    # mesh-sharded keyed engine (ISSUE 10): the cross-shard global fold's
+    # one result fetch, the all-fetched global lowering, and the
+    # per-shard occupancy/overflow reads — each documented as riding the
+    # same drain cadence as check_overflow
+    "query_global", "lowered_global", "shard_occupancy",
 })
 
 _SYNC_ATTRS = ("device_get", "block_until_ready", "item")
@@ -65,7 +70,7 @@ class HostSyncBan(Rule):
            "packages — syncs belong at documented drain points only")
     include = ("scotty_tpu/engine", "scotty_tpu/parallel",
                "scotty_tpu/shaper", "scotty_tpu/serving",
-               "scotty_tpu/core")
+               "scotty_tpu/core", "scotty_tpu/mesh")
 
     def check(self, src: SourceFile):
         for node in src.walk:

@@ -116,6 +116,13 @@ def make_aggregation(name: str) -> AggregateFunction:
         return DDSketchQuantileAggregation(0.5)
     if key in ("hll", "distinct"):
         return HyperLogLogAggregation(8)
+    if key in ("cms", "countmin"):
+        from ..core.aggregates import CountMinSketchAggregation
+
+        # target 2500.0: an arbitrary fixed point query in the generators'
+        # [0, 10000) value range — the cell measures sketch-ingest cost,
+        # not the answer to one heavy hitter
+        return CountMinSketchAggregation(2500.0, depth=4, width=256)
     raise ValueError(f"unknown aggregation {name!r} "
                      f"(known: {sorted(BUILTIN_AGGREGATIONS)})")
 
@@ -188,6 +195,13 @@ class BenchmarkConfig:
     #: Soak cell offered load (records per second; --offered-rate
     #: overrides); 0 = the 50 000/s default
     offered_rate: float = 0.0
+    #: MeshKeyed cell (ISSUE 10): device shards the key axis partitions
+    #: over; 0 = every local device
+    n_shards: int = 0
+    #: run the MeshKeyed cell's mid-run-rebalance differential arm (a
+    #: twin run migrates keys at a sync boundary and emissions must
+    #: bit-match the unmoved twin)
+    mesh_rebalance: bool = True
     #: delivery guarantee for connector-backed cells (ISSUE 8; the
     #: runner's --delivery flag overrides): "at_least_once" (the
     #: benchmarked default — no ledger) or "exactly_once" (a
@@ -229,6 +243,8 @@ class BenchmarkConfig:
             soak_seconds=raw.get("soakSeconds", 0.0),
             offered_rate=raw.get("offeredRate", 0.0),
             delivery=raw.get("delivery", "at_least_once"),
+            n_shards=raw.get("nShards", 0),
+            mesh_rebalance=raw.get("meshRebalance", True),
         )
 
 

@@ -30,6 +30,7 @@ from .aggregates import (
     DeviceAggregateSpec,
     SumAggregation,
     CountAggregation,
+    CountMinSketchAggregation,
     MinAggregation,
     MaxAggregation,
     MeanAggregation,
@@ -49,7 +50,8 @@ __all__ = [
     "AddModification", "DeleteModification", "ShiftModification",
     "AggregateFunction", "CommutativeAggregateFunction", "ReduceAggregateFunction",
     "InvertibleReduceAggregateFunction", "DeviceAggregateSpec",
-    "SumAggregation", "CountAggregation", "MinAggregation", "MaxAggregation",
+    "SumAggregation", "CountAggregation", "CountMinSketchAggregation",
+    "MinAggregation", "MaxAggregation",
     "MeanAggregation", "QuantileAggregation", "DDSketchQuantileAggregation",
     "HyperLogLogAggregation", "BUILTIN_AGGREGATIONS",
     "AggregateWindow", "WindowCollector", "WindowOperator",

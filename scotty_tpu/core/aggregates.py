@@ -49,6 +49,14 @@ class DeviceAggregateSpec:
     * sparse: ``lift_sparse(values) -> (col[B], val[B])`` — each tuple touches
       exactly one of the ``width`` columns (sketches: one histogram bucket /
       one HLL register per tuple), so ingest stays O(B) instead of O(B*width).
+      Multi-cell sketches (count-min: one cell per hash row) declare
+      ``cells_per_tuple = d`` and return ``(col[d, B], val[d, B])`` — the
+      engine's scatter-combine sites index as ``part.at[pos, col]`` where
+      ``pos`` is the per-lane slice row, and advanced-index broadcasting
+      fans the [B] rows across the d cells with no extra lanes generated.
+      Paths that densify per-lane one-hots (sessions, context chains, the
+      count record ring, the factored-MXU histogram) stay single-cell and
+      reject d > 1 at registration.
 
     ``lower(partials[N, width], counts[N]) -> [N]`` produces final values.
     ``identity`` is the combine-neutral element used for empty slices.
@@ -61,6 +69,10 @@ class DeviceAggregateSpec:
     lift_dense: Callable[[Any], Any] | None = None
     lift_sparse: Callable[[Any], tuple] | None = None
     dtype: Any = np.float32
+    #: sparse cells each tuple touches (count-min: one per hash row). The
+    #: scatter-combine ingest paths broadcast over it; one-hot paths
+    #: require 1.
+    cells_per_tuple: int = 1
     #: Hashable semantic identity (aggregation type + parameters) — the
     #: callables above are closures, so kernel caches key on this instead.
     token: tuple = ()
@@ -581,6 +593,124 @@ class HyperLogLogAggregation(AggregateFunction):
         )
 
 
+#: count-min hash-row salts: splitmix32 of the row index, fixed so the
+#: host oracle, the device kernel and every checkpointed partial agree
+#: forever (changing them is a state-format break)
+def _cms_salt(r: int) -> int:
+    z = (r + 0x9E3779B9) & 0xFFFFFFFF
+    z = ((z ^ (z >> 16)) * 0x85EBCA6B) & 0xFFFFFFFF
+    z = ((z ^ (z >> 13)) * 0xC2B2AE35) & 0xFFFFFFFF
+    return z ^ (z >> 16)
+
+
+def _cms_mix_host(bits: np.ndarray, salt: int) -> np.ndarray:
+    """Host mirror of the device 32-bit mix (same constants as the HLL
+    device hash) — uint32 wraparound is the algorithm."""
+    with np.errstate(over="ignore"):
+        x = np.asarray(bits, dtype=np.uint32) ^ np.uint32(salt)
+        x = (x ^ (x >> np.uint32(16))) * np.uint32(0x7FEB352D)
+        x = (x ^ (x >> np.uint32(15))) * np.uint32(0x846CA68B)
+        return x ^ (x >> np.uint32(16))
+
+
+class CountMinSketchAggregation(CommutativeAggregateFunction):
+    """Count-min sketch (Cormode & Muthukrishnan 2005): ``depth`` hash
+    rows of ``width`` counters; each tuple increments one counter per row,
+    and the estimated frequency of ``target`` is the MINIMUM of its
+    ``depth`` counters — an overestimate by at most the colliding mass,
+    ``err <= 2N/width`` per row with probability ``1 - (1/2)^depth``.
+
+    The device substitute for exact per-value frequency (heavy-hitter)
+    queries at millions of keys (ROADMAP item 5): the partial is a fixed
+    ``[depth·width]`` count vector, combine is elementwise ``sum`` — so
+    window merges ride the same prefix-sum range-query path as plain sums,
+    and the sketch works through every slice-sharing pipeline including
+    the keyed/mesh paths. Hashing is over the value's float32 bit pattern
+    with per-row salts; the scalar face below IS the oracle the device
+    kernel is differentially tested against (bit-identical bucketing).
+    """
+
+    def __init__(self, target: float, depth: int = 4, width: int = 256):
+        if depth < 1 or width < 2 or (width & (width - 1)):
+            raise ValueError("count-min needs depth >= 1 and a "
+                             "power-of-two width >= 2")
+        self.target = float(target)
+        self.depth = int(depth)
+        self.width = int(width)
+        self._salts = [_cms_salt(r) for r in range(self.depth)]
+
+    # -- shared bucketing (host side; the device lift mirrors it) ----------
+    def _cols(self, values) -> np.ndarray:
+        """[depth, B] absolute columns (row-offset included) of each
+        value's counters."""
+        bits = np.float32(values).reshape(-1).view(np.uint32)
+        return np.stack([
+            r * self.width
+            + (_cms_mix_host(bits, self._salts[r])
+               & np.uint32(self.width - 1)).astype(np.int64)
+            for r in range(self.depth)])
+
+    def _target_cols(self):
+        return self._cols([self.target])[:, 0]
+
+    # -- scalar face (the exact-bucketing oracle) --------------------------
+    def lift(self, value):
+        counts = [0] * (self.depth * self.width)
+        for c in self._cols([value])[:, 0]:
+            counts[int(c)] += 1
+        return counts
+
+    def lift_and_combine(self, partial, value):
+        partial = list(partial)
+        for c in self._cols([value])[:, 0]:
+            partial[int(c)] += 1
+        return partial
+
+    def combine(self, a, b):
+        return [x + y for x, y in zip(a, b)]
+
+    def clone_partial(self, partial):
+        return list(partial)
+
+    def lower(self, partial):
+        return float(min(partial[int(c)] for c in self._target_cols()))
+
+    def device_spec(self) -> DeviceAggregateSpec:
+        import jax.numpy as jnp
+
+        depth, width = self.depth, self.width
+        salts = np.asarray(self._salts, dtype=np.uint32)
+        tcols = np.asarray(self._target_cols(), dtype=np.int64)
+
+        def lift_sparse(v):
+            # device twin of _cols: mix the f32 bit pattern per hash row
+            x0 = v.astype(jnp.float32).view(jnp.int32).astype(jnp.uint32)
+            x = x0[None, :] ^ jnp.asarray(salts)[:, None]       # [d, B]
+            x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+            x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+            x = x ^ (x >> 16)
+            col = (jnp.arange(depth, dtype=jnp.int32)[:, None] * width
+                   + (x & jnp.uint32(width - 1)).astype(jnp.int32))
+            return col, jnp.ones((depth,) + v.shape, dtype=jnp.float32)
+
+        def lower(partials: np.ndarray, counts: np.ndarray) -> np.ndarray:
+            return np.min(np.asarray(partials)[..., tcols], axis=-1)
+
+        def lower_device(partials, counts):
+            return jnp.min(partials[..., jnp.asarray(tcols)], axis=-1)
+
+        return DeviceAggregateSpec(
+            kind="sum",
+            width=self.depth * self.width,
+            identity=0.0,
+            lift_sparse=lift_sparse,
+            lower=lower,
+            lower_device=lower_device,
+            cells_per_tuple=self.depth,
+            token=("cms", self.target, self.depth, self.width),
+        )
+
+
 BUILTIN_AGGREGATIONS = {
     "sum": SumAggregation,
     "count": CountAggregation,
@@ -590,4 +720,5 @@ BUILTIN_AGGREGATIONS = {
     "quantile": QuantileAggregation,
     "ddsketch": DDSketchQuantileAggregation,
     "hll": HyperLogLogAggregation,
+    "cms": CountMinSketchAggregation,
 }

@@ -638,6 +638,18 @@ class TpuWindowOperator(WindowOperator):
         if not self.aggregations:
             raise RuntimeError("no aggregations registered")
         self._spec = self._compute_spec()
+        if any(a.cells_per_tuple > 1 for a in self._spec.aggs) and (
+                self._spec.session_gaps or self._spec.count_periods
+                or any(isinstance(w, (ForwardContextAware,
+                                      ForwardContextFree))
+                       for w in self.windows)):
+            # sessions/context chains/the count record ring densify per-lane
+            # one-hots ([B, width]), which assumes one cell per tuple; the
+            # scatter-combine time-grid paths broadcast over the extra cells
+            raise UnsupportedOnDevice(
+                "multi-cell sparse aggregations (count-min) ride the "
+                "time-grid paths only; use SlicingWindowOperator for "
+                "session/count/context workloads")
         C, A = self.config.capacity, self.config.annex_capacity
         # Session windows run on their own per-registration active-session
         # arrays (engine/sessions.py); the grid slice buffer serves only

@@ -1032,14 +1032,20 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         max_width = 1
         for a in self.aggregations:
             sp = a.device_spec()
-            if sp.is_sparse and sp.kind == "sum":
+            # multi-cell sketches (count-min) skip the factored/one-hot
+            # strategies — both assume one column per lane — and take the
+            # flat scatter, whose advanced-index broadcast fans the [B]
+            # row ids across the d cells
+            if sp.is_sparse and sp.kind == "sum" \
+                    and sp.cells_per_tuple == 1:
                 wa = 1 << ((sp.width.bit_length()) // 2)
                 if wa * (sp.width // wa) == sp.width:
                     self._factored[sp.token] = (wa, sp.width // wa)
                     max_width = max(max_width, wa + sp.width // wa)
                     continue
             if sp.is_sparse:
-                onehot_ok[sp.token] = R * sp.width <= max_chunk_elems
+                onehot_ok[sp.token] = (sp.cells_per_tuple == 1
+                                       and R * sp.width <= max_chunk_elems)
                 if onehot_ok[sp.token]:
                     max_width = max(max_width, sp.width)
             else:

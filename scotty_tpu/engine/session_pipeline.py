@@ -112,6 +112,12 @@ class SessionStreamPipeline(FusedPipelineDriver):
         aggs = tuple(a.device_spec() for a in self.aggregations)
         if any(a is None for a in aggs):
             raise NotImplementedError("device-realizable aggregations only")
+        if any(a.cells_per_tuple > 1 for a in aggs):
+            # the session chain kernel and the one-hot segment reduce both
+            # assume one sparse cell per tuple
+            raise NotImplementedError(
+                "session pipeline: multi-cell sparse aggregations "
+                "(count-min) are unsupported; use the time-grid pipelines")
 
         # ---- generator layout (slice-aligned rows, like the aligned
         # pipeline; for pure-session workloads an artificial row grid keeps

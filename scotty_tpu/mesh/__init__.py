@@ -1,0 +1,33 @@
+"""Mesh-sharded keyed execution: keys as the scale-out axis (ISSUE 10).
+
+The reference's only distribution story is delegating key partitioning to
+the host engine (``keyBy``) or to the separately-published Disco system
+(SURVEY.md §2.8(a)); its JVM core never scales past one machine. This
+package makes keys a REAL sharded device axis:
+
+* :class:`~scotty_tpu.mesh.routing.RoutingTable` — the key→shard map.
+  Physical row ``r`` of the ``[K, ...]`` keyed state belongs to shard
+  ``r // rows_per_shard``; the table is a permutation of logical keys
+  over physical rows, mirrored host-side (packing, result attribution)
+  and device-side (host-sync-free routing of device-resident rounds).
+* :class:`~scotty_tpu.mesh.engine.MeshKeyedEngine` — the keyed window
+  operator stepped under ``shard_map`` with donated carries: per-shard
+  fused keyed kernels run independently; cross-shard/global aggregates
+  fold via ``psum``/``pmin``/``pmax`` INSIDE the executable (the seam
+  ``parallel/global_op.py`` prototypes, now on the keyed path).
+* :class:`~scotty_tpu.mesh.pipeline.MeshKeyedPipeline` — the fused
+  benchmark pipeline whose generated stream is a pure function of the
+  LOGICAL key, so the same workload bit-matches under any shard count
+  or routing — the property every differential/scaling cell rests on.
+* Hot-key rebalance — per-key load read at existing drain points, a
+  greedy swap plan, and the rebalance itself applied ONLY at Supervisor
+  checkpoint boundaries (the PR 3/PR 8 atomic verified-checkpoint
+  machinery): a rebalanced restore bit-matches an unmoved oracle.
+"""
+
+from .routing import RoutingTable, plan_rebalance
+from .engine import MeshKeyedEngine
+from .pipeline import MeshKeyedPipeline
+
+__all__ = ["RoutingTable", "plan_rebalance", "MeshKeyedEngine",
+           "MeshKeyedPipeline"]

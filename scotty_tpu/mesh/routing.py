@@ -1,0 +1,189 @@
+"""Key→shard routing table + hot-key rebalance planning.
+
+The sharded keyed state is a ``[K, ...]`` pytree whose leading axis is
+split over the mesh's key axis: physical row ``r`` lives on shard
+``r // rows_per_shard``. The :class:`RoutingTable` is the permutation
+``row_of[key] -> r`` (inverse ``key_at[r] -> key``) that decides WHICH
+logical key occupies which row — the one degree of freedom the static
+shapes leave open, and therefore the whole rebalance mechanism: moving a
+hot key to a cold shard is a row swap, never a reshape.
+
+Static-shape discipline: every shard owns exactly ``K // n_shards`` rows
+forever (XLA shapes cannot follow load), so a rebalance is a sequence of
+row SWAPS — the hot key takes the cold shard's coldest row and that row's
+key takes the hot key's old row. :func:`plan_rebalance` builds such a
+swap list greedily from per-key load counts (read at existing drain
+points — no extra device syncs).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class RoutingTable:
+    """Permutation of ``n_keys`` logical keys over physical state rows,
+    partitioned contiguously over ``n_shards`` shards.
+
+    Host mirror: ``row_of`` (int32 ``[K]``, key → physical row) and
+    ``key_at`` (int32 ``[K]``, physical row → key). Device mirror:
+    :meth:`device_row_of` — a replicated int32 array the ingest path
+    gathers through, so routing a device-resident round never syncs the
+    host. The identity table (key ``k`` at row ``k``) is the seed layout;
+    every rebalance produces a NEW table (tables are value objects — the
+    engine swaps its reference at the checkpoint boundary).
+    """
+
+    def __init__(self, n_keys: int, n_shards: int,
+                 row_of: Optional[np.ndarray] = None):
+        if n_keys < 1 or n_shards < 1 or n_keys % n_shards:
+            raise ValueError(
+                f"n_keys {n_keys} must be a positive multiple of "
+                f"n_shards {n_shards} (every shard owns the same static "
+                "row count — XLA shapes cannot follow load)")
+        self.n_keys = int(n_keys)
+        self.n_shards = int(n_shards)
+        self.rows_per_shard = self.n_keys // self.n_shards
+        if row_of is None:
+            self.row_of = np.arange(self.n_keys, dtype=np.int32)
+        else:
+            self.row_of = np.asarray(row_of, dtype=np.int32).copy()
+            if self.row_of.shape != (self.n_keys,) or \
+                    sorted(self.row_of.tolist()) != list(range(self.n_keys)):
+                raise ValueError("row_of must be a permutation of "
+                                 f"range({self.n_keys})")
+        self.key_at = np.empty(self.n_keys, dtype=np.int32)
+        self.key_at[self.row_of] = np.arange(self.n_keys, dtype=np.int32)
+        self._dev_row_of = None
+
+    # -- lookups -----------------------------------------------------------
+    def shard_of(self, keys) -> np.ndarray:
+        """Shard id of each logical key (host mirror)."""
+        return self.row_of[np.asarray(keys, dtype=np.int64)] \
+            // self.rows_per_shard
+
+    def rows_of(self, keys) -> np.ndarray:
+        return self.row_of[np.asarray(keys, dtype=np.int64)]
+
+    def device_row_of(self):
+        """The key→row map as a device array (replicated; built lazily,
+        rebuilt after a rebalance) — the ingest path's host-sync-free
+        routing gather."""
+        if self._dev_row_of is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._dev_row_of = jax.device_put(
+                jnp.asarray(self.row_of, dtype=jnp.int32))
+        return self._dev_row_of
+
+    # -- rebalance ---------------------------------------------------------
+    def swapped(self, swaps: Sequence[Tuple[int, int]]) -> "RoutingTable":
+        """A new table with each ``(key_a, key_b)`` pair's rows exchanged
+        (the physical permutation the engine applies to its state rows is
+        :meth:`permutation_from`)."""
+        row_of = self.row_of.copy()
+        for a, b in swaps:
+            row_of[a], row_of[b] = row_of[b], row_of[a]
+        return RoutingTable(self.n_keys, self.n_shards, row_of=row_of)
+
+    def permutation_from(self, old: "RoutingTable") -> np.ndarray:
+        """``perm[r_new] = r_old``: the row gather taking state laid out
+        under ``old`` to this table's layout (``new_leaf = leaf[perm]``).
+        Requires the same key set; shard counts may differ (the N→M
+        restore path rides this)."""
+        if old.n_keys != self.n_keys:
+            raise ValueError(
+                f"routing tables cover different key sets "
+                f"({old.n_keys} vs {self.n_keys})")
+        # new row r holds key self.key_at[r], which old kept at
+        # old.row_of[key]
+        return old.row_of[self.key_at].astype(np.int64)
+
+    def shard_loads(self, key_loads: np.ndarray) -> np.ndarray:
+        """Per-shard load totals of a per-KEY load vector."""
+        loads = np.asarray(key_loads, dtype=np.float64)
+        by_row = loads[self.key_at]
+        return by_row.reshape(self.n_shards, self.rows_per_shard).sum(axis=1)
+
+    # -- persistence (checkpoint sidecar) ----------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "schema": "scotty_tpu.mesh_routing/1",
+            "n_keys": self.n_keys, "n_shards": self.n_shards,
+            "row_of": self.row_of.tolist(),
+        })
+
+    @staticmethod
+    def from_json(doc: str) -> "RoutingTable":
+        raw = json.loads(doc)
+        if raw.get("schema") != "scotty_tpu.mesh_routing/1":
+            raise ValueError(
+                f"not a mesh routing table (schema={raw.get('schema')!r})")
+        return RoutingTable(raw["n_keys"], raw["n_shards"],
+                            row_of=np.asarray(raw["row_of"], np.int32))
+
+
+def plan_rebalance(table: RoutingTable, key_loads: np.ndarray,
+                   max_moves: int = 64,
+                   imbalance_threshold: float = 1.25
+                   ) -> Tuple[List[Tuple[int, int]], dict]:
+    """Greedy hot-key swap plan from per-key load counts.
+
+    While the hottest shard carries more than ``imbalance_threshold`` ×
+    the mean shard load (and the move budget lasts), swap its hottest key
+    with the coldest key of the coldest shard — each swap preserves the
+    static rows-per-shard invariant. Returns ``(swaps, stats)`` where
+    ``stats`` records the before/after imbalance ratio and the hot keys
+    seen; an empty plan means the mesh is already balanced.
+
+    Deliberately host-side and O(K log K): it runs at checkpoint
+    boundaries only (the sole point a rebalance may be applied), never on
+    the per-interval path.
+    """
+    loads = np.asarray(key_loads, dtype=np.float64).copy()
+    if loads.shape != (table.n_keys,):
+        raise ValueError(f"key_loads must be [{table.n_keys}]")
+    cur = table
+    swaps: List[Tuple[int, int]] = []
+    shard_tot = cur.shard_loads(loads)
+    mean = float(shard_tot.mean()) or 1.0
+    before = float(shard_tot.max()) / mean if mean else 1.0
+    hot_keys: List[int] = []
+    for _ in range(max_moves):
+        shard_tot = cur.shard_loads(loads)
+        mean = float(shard_tot.mean()) or 1.0
+        hi = int(shard_tot.argmax())
+        lo = int(shard_tot.argmin())
+        if hi == lo or shard_tot[hi] <= imbalance_threshold * mean:
+            break
+        rps = cur.rows_per_shard
+        hi_rows = np.arange(hi * rps, (hi + 1) * rps)
+        lo_rows = np.arange(lo * rps, (lo + 1) * rps)
+        hi_keys = cur.key_at[hi_rows]
+        lo_keys = cur.key_at[lo_rows]
+        a = int(hi_keys[np.argmax(loads[hi_keys])])   # hottest on hot shard
+        b = int(lo_keys[np.argmin(loads[lo_keys])])   # coldest on cold shard
+        if loads[a] <= loads[b]:
+            break                                     # swap would not help
+        cand = cur.swapped([(a, b)])
+        if float(cand.shard_loads(loads).max()) >= float(shard_tot[hi]):
+            # one dominant key IS the imbalance: moving it just relocates
+            # the hot shard (and a further iteration would swap it back —
+            # the oscillation this guard exists for). Converged.
+            break
+        cur = cand
+        swaps.append((a, b))
+        hot_keys.append(a)
+    shard_tot = cur.shard_loads(loads)
+    mean = float(shard_tot.mean()) or 1.0
+    stats = {
+        "imbalance_before": before,
+        "imbalance_after": float(shard_tot.max()) / mean if mean else 1.0,
+        "hot_keys": hot_keys,
+        "n_swaps": len(swaps),
+    }
+    return swaps, stats

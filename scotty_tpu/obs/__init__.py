@@ -174,6 +174,12 @@ DELIVERY_EPOCHS_COMMITTED = "delivery_epochs_committed"
 CKPT_INTEGRITY_FAILURES = "ckpt_integrity_failures"
 CKPT_LINEAGE_FALLBACKS = "ckpt_lineage_fallbacks"
 
+# mesh-sharded keyed engine contract (scotty_tpu.mesh — counters/gauges)
+MESH_REBALANCES = "mesh_rebalances"
+MESH_HOT_KEYS = "mesh_hot_keys"
+MESH_KEYS_MOVED = "mesh_keys_moved"
+MESH_SHARD_IMBALANCE = "mesh_shard_imbalance"
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -258,6 +264,12 @@ METRIC_HELP = {
         "(seq <= delivered high-water after a supervised restore)",
     DELIVERY_EPOCHS_COMMITTED:
         "delivery epochs closed by a checkpoint commit",
+    MESH_REBALANCES:
+        "hot-key rebalances applied at checkpoint boundaries",
+    MESH_HOT_KEYS: "hot keys detected against the shard-mean load",
+    MESH_KEYS_MOVED: "keys migrated between shards by rebalances",
+    MESH_SHARD_IMBALANCE:
+        "hottest-shard load / mean shard load (gauge, drain-point read)",
     CKPT_INTEGRITY_FAILURES:
         "checkpoint generations that failed digest verification",
     CKPT_LINEAGE_FALLBACKS:

@@ -90,6 +90,14 @@ DEFAULT_THRESHOLDS = {
         # are lazily created, so "default": 0 gates the appearing case.
         "serving_retraces": {"direction": "lower", "default": 0},
         "serving_rejected": {"direction": "lower", "default": 0},
+        # mesh-sharded keyed contract (ISSUE 10): hot keys being detected
+        # or rebalances firing between two exports of the same workload
+        # gate — a seeded bench stream is balanced by construction, so
+        # these APPEARING means either the stream changed or the detector
+        # regressed into false positives. Lazily created ("default": 0
+        # gates the appearing case, like the resilience set).
+        "mesh_rebalances": {"direction": "lower", "default": 0},
+        "mesh_hot_keys": {"direction": "lower", "default": 0},
         # delivery / checkpoint-integrity contract (ISSUE 8): replayed
         # duplicates reaching the suppression horizon, or checkpoint
         # generations failing digest verification, appearing between two

@@ -119,6 +119,13 @@ QUERY_CANCEL = "query_cancel"
 QUERY_REJECT = "query_reject"
 QUERY_EVICT = "query_evict"
 QUERY_REBUCKET = "query_rebucket"
+# mesh-sharded keyed engine events (ISSUE 10, scotty_tpu.mesh): a hot key
+# detected against the shard-mean load (name = key id, value = its load
+# window), and a rebalance applied at a checkpoint boundary (name =
+# "<n>swaps", value = keys moved) — a postmortem timeline shows exactly
+# when and why keys migrated
+MESH_HOT_KEY = "mesh_hot_key"
+MESH_REBALANCE = "mesh_rebalance"
 # exactly-once delivery + checkpoint-integrity events (ISSUE 8,
 # scotty_tpu.delivery + the supervisor lineage): a sink delivery (value =
 # seq — fired BEFORE the downstream handoff, so a fuzzer crash at this

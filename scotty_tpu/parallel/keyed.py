@@ -86,11 +86,7 @@ class KeyedTpuWindowOperator:
         self.max_lateness = max_lateness
 
     # -- build -------------------------------------------------------------
-    def _build(self) -> None:
-        import jax
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
+    def _compute_spec(self):
         from ..engine import core as ec
 
         periods, bands, offset_periods = [], [], []
@@ -104,13 +100,22 @@ class KeyedTpuWindowOperator:
                                            int(w.size % w.slide)))
             elif isinstance(w, FixedBandWindow):
                 bands.append((int(w.start), int(w.size)))
-        self._spec = ec.EngineSpec(
+        return ec.EngineSpec(
             periods=ec.collapse_periods(periods),
             bands=tuple(sorted(set(bands))),
             count_periods=(),
             aggs=tuple(a.device_spec() for a in self.aggregations),
             offset_periods=tuple(sorted(set(offset_periods))),
         )
+
+    def _build(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..engine import core as ec
+
+        self._spec = self._compute_spec()
         C, A = self.config.capacity, self.config.annex_capacity
         key = (self._spec.periods, self._spec.bands, self._spec.offset_periods,
                tuple(a.token for a in self._spec.aggs), C, A, self.n_keys,
@@ -361,14 +366,19 @@ class KeyedTpuWindowOperator:
         only — the emit contract of the reference connectors (they collect
         only hasValue results, flink KeyedScottyWindowOperator.java:79-82)."""
         ws, we, cnt, lowered = self.process_watermark_arrays(watermark_ts)
+        # vectorized extraction (VERDICT r5 item 7): one nonzero scan over
+        # the [K, T] count grid + per-agg fancy-index gathers replace the
+        # K×T Python double loop — at 64K keys the dense scan dominated
+        # emit when most (key, trigger) cells are empty
+        kk_idx, t_idx = np.nonzero(cnt > 0)
+        cols = [np.asarray(lw)[kk_idx, t_idx] for lw in lowered]
+        ws_nz = ws[t_idx]
+        we_nz = we[t_idx]
         out = []
-        for kk in range(self.n_keys):
-            for i in range(ws.shape[0]):
-                if cnt[kk, i] > 0:
-                    values = [lw[kk, i] for lw in lowered]
-                    out.append((kk, AggregateWindow(
-                        WindowMeasure.Time, int(ws[i]), int(we[i]), values,
-                        True)))
+        for j, kk in enumerate(kk_idx.tolist()):
+            out.append((kk, AggregateWindow(
+                WindowMeasure.Time, int(ws_nz[j]), int(we_nz[j]),
+                [c[j] for c in cols], True)))
         return out
 
 
@@ -423,9 +433,10 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
                     "keyed aligned pipeline: time tumbling/sliding only")
             max_fixed = max(max_fixed, w.clear_delay())
         aggs = tuple(a.device_spec() for a in self.aggregations)
-        if any(a is None or a.is_sparse for a in aggs):
+        if any(a is None for a in aggs):
             raise NotImplementedError(
-                "keyed aligned pipeline: dense-lift aggregations only")
+                "keyed aligned pipeline: device-realizable aggregations "
+                "only")
         g = AlignedStreamPipeline.slice_grid(self.windows, P)
         per_key = throughput // K
         R = per_key * g // 1000
@@ -447,8 +458,10 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
         make_triggers, self.T = build_trigger_grid(self.windows, P)
 
         # R-chunking keeps the [K, S, Rc, width] lift temporary bounded
-        # (the budget counts LIFTED elements, like the other pipelines)
-        max_width = max(a.width for a in aggs)
+        # (the budget counts LIFTED elements, like the other pipelines;
+        # sparse lifts scatter into flat per-row targets — per-lane cost
+        # only — so they count as width 1, like the session pipeline)
+        max_width = max(1 if a.is_sparse else a.width for a in aggs)
         n_chunks = 1
         while (K * S * (R // n_chunks) * max_width) > max_chunk_elems \
                 and n_chunks < R:
@@ -477,11 +490,32 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
 
             def body(parts_c, c):
                 vals = gen_vals(jax.random.fold_in(key, c))
+                flat = vals.reshape(-1)                  # [K*S*Rc]
                 new_parts = []
                 for aspec, acc in zip(aggs, parts_c):
-                    lifted = aspec.lift_dense(vals.reshape(-1)) \
-                        .reshape(K, S, Rc, -1)
-                    upd = red[aspec.kind](lifted, axis=2)    # [K, S, w]
+                    if aspec.is_sparse:
+                        # flat per-row scatter (the aligned pipeline's
+                        # generic sketch fold): one f32 scatter lane per
+                        # generated tuple — multi-cell sketches (count-
+                        # min) broadcast the [lanes] row ids across their
+                        # d cells via advanced indexing
+                        col, v = aspec.lift_sparse(flat)
+                        row_id = jnp.arange(K * S * Rc,
+                                            dtype=jnp.int32) // Rc
+                        fi = row_id * aspec.width + col.astype(jnp.int32)
+                        tgt = jnp.full((K * S * aspec.width,),
+                                       aspec.identity, jnp.float32)
+                        if aspec.kind == "sum":
+                            tgt = tgt.at[fi].add(v)
+                        elif aspec.kind == "min":
+                            tgt = tgt.at[fi].min(v)
+                        else:
+                            tgt = tgt.at[fi].max(v)
+                        upd = tgt.reshape(K, S, aspec.width)
+                    else:
+                        lifted = aspec.lift_dense(flat) \
+                            .reshape(K, S, Rc, -1)
+                        upd = red[aspec.kind](lifted, axis=2)  # [K, S, w]
                     if aspec.kind == "sum":
                         new_parts.append(acc + upd)
                     elif aspec.kind == "min":
@@ -510,6 +544,13 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
                 return jax.lax.dynamic_update_slice(
                     buf, rows.astype(buf.dtype), idx)
 
+            # vmapped per-key-index appends: the index vector n is constant
+            # across keys, but the K·S scatter lanes this lowers to are
+            # three orders of magnitude below the generated-lane count — a
+            # shared-scalar-index slab DUS was tried for VERDICT r5 item 7
+            # and measured ~30% SLOWER on the CPU backend (dynamic-start
+            # slab updates defeat in-place fusion); the keyed cell's emit
+            # gap is generation/lift-bound, not append-bound.
             app = jax.vmap(app1)
             rs_k = jnp.broadcast_to(row_starts, (K, S))
             state = state._replace(

@@ -524,6 +524,116 @@ def restore_keyed_operator(op, path: str, verify: bool = True) -> None:
     op._host_met = meta["host_met"]
 
 
+# ---------------------------------------------------------------------------
+# Mesh-sharded keyed engine (ISSUE 10): shard-count-portable snapshots
+# ---------------------------------------------------------------------------
+
+
+def save_mesh_state(state, routing, path: str, meta_extra: dict) -> None:
+    """Snapshot a mesh-sharded ``[K, ...]`` keyed pytree in CANONICAL
+    LOGICAL-KEY order: physical row ``r`` holds key ``routing.key_at[r]``,
+    so ``leaf[routing.row_of]`` is the layout-independent form. A bundle
+    saved under N shards therefore restores under M shards (or any
+    post-rebalance routing) by one permutation at load time — and a
+    rebalanced restore bit-matches an unmoved oracle because the bytes on
+    disk never depend on the routing at save time. The live routing table
+    rides alongside as a sidecar (diagnostics + the crash-mid-rebalance
+    story: the committed bundle is always the PRE-move layout)."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    host = jax.device_get(state)
+    row_of = routing.row_of
+    leaves = [np.asarray(leaf)[row_of] for leaf in jax.tree.flatten(host)[0]]
+    leaf_digests = _write_npz(os.path.join(path, "mesh_state.npz"), leaves)
+    fsio.write_bytes(os.path.join(path, "routing.json"),
+                     routing.to_json().encode())
+    _write_json(os.path.join(path, "meta.json"), {
+        "kind": "mesh", "n_keys": routing.n_keys,
+        "saved_n_shards": routing.n_shards,
+        "n_leaves": len(leaves),
+        "leaf_sha256": leaf_digests,
+        **meta_extra,
+    })
+
+
+def load_mesh_state(path: str, template_state, routing,
+                    verify: bool = True):
+    """Load a canonical mesh snapshot into the PHYSICAL layout of
+    ``routing`` (any shard count): logical row ``k`` lands at physical
+    row ``routing.row_of[k]``. Returns ``(device_tree, meta)`` — the
+    caller device_puts with its own sharding."""
+    import jax
+
+    if verify:
+        _verify_before_restore(path)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != "mesh":
+        raise ValueError(f"snapshot kind {meta.get('kind')!r} is not a "
+                         "mesh checkpoint")
+    if meta["n_keys"] != routing.n_keys:
+        raise ValueError(
+            f"snapshot covers {meta['n_keys']} keys, this engine has "
+            f"{routing.n_keys} — the key set is part of the state")
+    data = np.load(os.path.join(path, "mesh_state.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(meta["n_leaves"])]
+    template = jax.tree.flatten(template_state)[0]
+    if len(leaves) != len(template):
+        raise ValueError(
+            "mesh checkpoint leaf count mismatch: construct the engine "
+            "with the same windows/aggregations/config as saved")
+    key_at = routing.key_at
+    cast = []
+    for i, (l, t) in enumerate(zip(leaves, template)):
+        t_np = np.asarray(t)
+        if np.asarray(l).shape != t_np.shape:
+            raise ValueError(
+                f"mesh checkpoint leaf {i} has shape "
+                f"{np.asarray(l).shape}, this engine expects "
+                f"{t_np.shape} — same windows/aggregations/config "
+                "required (capacity shapes the state)")
+        cast.append(np.asarray(l, dtype=t_np.dtype)[key_at])
+    treedef = jax.tree.structure(template_state)
+    return _device_copy(jax.tree.unflatten(treedef, cast)), meta
+
+
+def save_mesh_engine(eng, path: str) -> None:
+    """Snapshot a :class:`~scotty_tpu.mesh.engine.MeshKeyedEngine` (state
+    in canonical logical order + host clocks + routing sidecar)."""
+    if not eng._built:
+        raise ValueError("engine not built yet; nothing to checkpoint")
+    if eng._n_pending:
+        eng._flush()
+    save_mesh_state(eng._state, eng.routing, path, {
+        "last_watermark": eng._last_watermark,
+        "max_lateness": eng.max_lateness,
+        "max_fixed_window_size": eng.max_fixed_window_size,
+        "host_met": eng._host_met,
+        "annex_dirty": eng._annex_dirty,
+    })
+
+
+def restore_mesh_engine(eng, path: str, verify: bool = True) -> None:
+    """Restore into a freshly-configured MeshKeyedEngine — SAME windows/
+    aggregations/config/n_keys, but ANY shard count or routing table:
+    the canonical on-disk order re-permutes into the restoring engine's
+    physical layout (the N→M differential in tests/test_mesh.py)."""
+    import jax
+
+    if not eng._built:
+        eng._build()
+    tree, meta = load_mesh_state(path, eng._state, eng.routing,
+                                 verify=verify)
+    eng._state = jax.device_put(tree, eng._sharding())
+    eng._last_watermark = meta["last_watermark"]
+    eng.max_lateness = meta["max_lateness"]
+    eng.max_fixed_window_size = meta["max_fixed_window_size"]
+    eng._host_met = meta["host_met"]
+    eng._annex_dirty = meta.get("annex_dirty", False)
+    eng.mark_load_baseline()
+
+
 def _pipeline_tree(p) -> dict:
     """A fused pipeline's complete device state as one pytree: the main
     state (slice buffer / count ring / grid state) plus, for the session

@@ -1,0 +1,219 @@
+"""Count-min sketch aggregation (ISSUE 10 satellite, ROADMAP item 5):
+fixed [depth·width] sum-combine partial riding the sparse-lift seam —
+device bucketing bit-matches the scalar-face host oracle, the estimate
+obeys the CMS error bound against exact counts, and the multi-cell lift
+is rejected on the one-hot paths that cannot broadcast it."""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    CountMinSketchAggregation,
+    SessionWindow,
+    SlicingWindowOperator,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.operator import TpuWindowOperator, UnsupportedOnDevice
+
+Time = WindowMeasure.Time
+Count = WindowMeasure.Count
+CFG = EngineConfig(capacity=256, annex_capacity=32, batch_size=256,
+                   min_trigger_pad=32)
+
+
+def _heavy_stream(seed=7, n=3000, heavy=42.0, p_heavy=0.3, t_hi=1000):
+    rng = np.random.default_rng(seed)
+    vals = np.where(rng.random(n) < p_heavy, heavy,
+                    rng.integers(0, 500, size=n)).astype(np.float64)
+    ts = np.sort(rng.integers(0, t_hi, size=n))
+    return vals, ts
+
+
+def test_cms_validates_parameters():
+    with pytest.raises(ValueError):
+        CountMinSketchAggregation(1.0, depth=0)
+    with pytest.raises(ValueError):
+        CountMinSketchAggregation(1.0, width=100)     # not a power of two
+
+
+def test_cms_scalar_face_error_bound():
+    """est >= exact always (one-sided), and est - exact <= 2N/width per
+    row on this concrete stream — the classic CMS guarantee, checked
+    deterministically for the fixed salts."""
+    agg = CountMinSketchAggregation(42.0, depth=4, width=256)
+    vals, _ = _heavy_stream()
+    part = [0] * (agg.depth * agg.width)
+    for v in vals:
+        part = agg.lift_and_combine(part, float(v))
+    exact = int((vals == 42.0).sum())
+    est = agg.lower(part)
+    assert est >= exact
+    assert est - exact <= 2 * len(vals) / 256
+
+
+def test_cms_device_matches_host_oracle_through_engine():
+    vals, ts = _heavy_stream()
+    agg_args = dict(depth=4, width=256)
+    op = TpuWindowOperator(config=CFG)
+    op.add_window_assigner(TumblingWindow(Time, 250))
+    op.add_aggregation(CountMinSketchAggregation(42.0, **agg_args))
+    op.process_elements(vals, ts)
+    got = [w for w in op.process_watermark(1001) if w.has_value()]
+
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(TumblingWindow(Time, 250))
+    sim.add_aggregation(CountMinSketchAggregation(42.0, **agg_args))
+    for v, t in zip(vals, ts):
+        sim.process_element(float(v), int(t))
+    want = [w for w in sim.process_watermark(1001) if w.has_value()]
+    assert len(got) == len(want) == 4
+    for a, b in zip(want, got):
+        exact = int(((vals == 42.0) & (ts >= a.get_start())
+                     & (ts < a.get_end())).sum())
+        n_win = int(((ts >= a.get_start()) & (ts < a.get_end())).sum())
+        est_h = float(a.get_agg_values()[0])
+        est_d = float(b.get_agg_values()[0])
+        assert est_h == est_d            # bit-identical bucketing
+        assert exact <= est_d <= exact + 2 * n_win / 256
+
+
+def test_cms_out_of_order_annex_path():
+    """Late tuples fold through the annex's scatter-combine — the
+    multi-cell broadcast must survive the covered/annex split too."""
+    agg = CountMinSketchAggregation(7.0, depth=2, width=128)
+    rng = np.random.default_rng(3)
+    n = 600
+    vals = np.where(rng.random(n) < 0.2, 7.0,
+                    rng.integers(0, 100, size=n)).astype(np.float64)
+    ts = rng.integers(0, 500, size=n).astype(np.int64)
+    # bounded disorder within max_lateness
+    op = TpuWindowOperator(config=CFG)
+    op.add_window_assigner(TumblingWindow(Time, 100))
+    op.add_aggregation(CountMinSketchAggregation(7.0, depth=2, width=128))
+    op.set_max_lateness(1000)
+    order = np.argsort(ts, kind="stable")
+    # feed sorted batches but interleave one displaced late batch
+    op.process_elements(vals[order][:500], ts[order][:500])
+    op.process_elements(vals[order][500:], ts[order][500:])
+    got = [w for w in op.process_watermark(501) if w.has_value()]
+
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(TumblingWindow(Time, 100))
+    sim.add_aggregation(CountMinSketchAggregation(7.0, depth=2, width=128))
+    sim.set_max_lateness(1000)
+    for v, t in zip(vals[order], ts[order]):
+        sim.process_element(float(v), int(t))
+    want = [w for w in sim.process_watermark(501) if w.has_value()]
+    assert len(got) == len(want)
+    for a, b in zip(want, got):
+        assert float(a.get_agg_values()[0]) == float(b.get_agg_values()[0])
+
+
+def test_cms_through_keyed_operator():
+    """The keyed path (ISSUE 10 wiring): per-key CMS partials through the
+    [K, ...] batched kernels match per-key scalar-face oracles."""
+    from scotty_tpu.parallel import KeyedTpuWindowOperator
+
+    rng = np.random.default_rng(5)
+    K, n = 4, 1200
+    keys = rng.integers(0, K, size=n)
+    vals = np.where(rng.random(n) < 0.25, 9.0,
+                    rng.integers(0, 200, size=n)).astype(np.float64)
+    ts = np.sort(rng.integers(0, 400, size=n))
+    op = KeyedTpuWindowOperator(
+        n_keys=K, config=EngineConfig(capacity=1 << 10, batch_size=32,
+                                      annex_capacity=128,
+                                      min_trigger_pad=32))
+    op.add_window_assigner(TumblingWindow(Time, 100))
+    op.add_aggregation(CountMinSketchAggregation(9.0, depth=2, width=128))
+    op.process_keyed_elements(keys, vals, ts)
+    got = op.process_watermark(401)
+    by_key = {}
+    for k, w in got:
+        by_key.setdefault(k, []).append(w)
+    for k in range(K):
+        agg = CountMinSketchAggregation(9.0, depth=2, width=128)
+        sim = SlicingWindowOperator()
+        sim.add_window_assigner(TumblingWindow(Time, 100))
+        sim.add_aggregation(agg)
+        m = keys == k
+        for v, t in zip(vals[m], ts[m]):
+            sim.process_element(float(v), int(t))
+        want = [w for w in sim.process_watermark(401) if w.has_value()]
+        assert len(by_key.get(k, [])) == len(want), k
+        for a, b in zip(want, by_key[k]):
+            assert float(a.get_agg_values()[0]) \
+                == float(b.get_agg_values()[0]), (k, a.get_start())
+
+
+def test_cms_through_keyed_aligned_pipeline():
+    """The fused keyed pipeline now takes sparse lifts (the flat scatter
+    fold): CMS estimates bit-match the scalar face on the materialized
+    stream."""
+    from scotty_tpu.parallel.keyed import KeyedAlignedPipeline
+
+    agg = CountMinSketchAggregation(2500.0, depth=2, width=128)
+    p = KeyedAlignedPipeline(
+        [TumblingWindow(Time, 100)], [agg], n_keys=8,
+        config=EngineConfig(capacity=1 << 10, batch_size=32,
+                            annex_capacity=8, min_trigger_pad=32),
+        throughput=8 * 2000, wm_period_ms=100, max_lateness=100, seed=3,
+        gc_every=4)
+    p.reset()
+    for i in range(3):
+        out = p.run(1)[0]
+        for kk in (0, 7):
+            vals, _ts = p.materialize_interval(i, kk)
+            rows = p.lowered_results_for_key(out, kk)
+            assert rows
+            for (s, e, c, v) in rows:
+                part = [0] * (agg.depth * agg.width)
+                for val in vals:
+                    part = agg.lift_and_combine(part, float(val))
+                assert float(v[0]) == agg.lower(part), (i, kk, s, e)
+    p.check_overflow()
+
+
+def test_cms_rejected_on_one_hot_paths():
+    """Sessions (and count/context) densify one column per lane — the
+    multi-cell lift must be refused loudly, not mis-bucketed."""
+    op = TpuWindowOperator(config=CFG)
+    op.add_window_assigner(SessionWindow(Time, 100))
+    op.add_aggregation(CountMinSketchAggregation(1.0, depth=2, width=64))
+    with pytest.raises(UnsupportedOnDevice, match="time-grid"):
+        op.process_element(1.0, 10)
+    op2 = TpuWindowOperator(config=CFG)
+    op2.add_window_assigner(TumblingWindow(Count, 10))
+    op2.add_aggregation(CountMinSketchAggregation(1.0, depth=2, width=64))
+    with pytest.raises(UnsupportedOnDevice, match="time-grid"):
+        op2.process_element(1.0, 10)
+    # host simulator remains the session/count fallback
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(SessionWindow(Time, 100))
+    sim.add_aggregation(CountMinSketchAggregation(1.0, depth=2, width=64))
+    sim.process_element(1.0, 10)
+    out = [w for w in sim.process_watermark(500) if w.has_value()]
+    assert len(out) == 1 and float(out[0].get_agg_values()[0]) == 1.0
+
+
+def test_cms_alongside_dense_aggs():
+    """Mixed registration: CMS + sum through one engine spec (the
+    partials tuple mixes multi-cell sparse and dense widths)."""
+    vals, ts = _heavy_stream(n=800)
+    op = TpuWindowOperator(config=CFG)
+    op.add_window_assigner(TumblingWindow(Time, 500))
+    op.add_aggregation(SumAggregation())
+    op.add_aggregation(CountMinSketchAggregation(42.0, depth=2,
+                                                 width=128))
+    op.process_elements(vals, ts)
+    got = [w for w in op.process_watermark(1001) if w.has_value()]
+    assert len(got) == 2
+    for w in got:
+        m = (ts >= w.get_start()) & (ts < w.get_end())
+        assert float(w.get_agg_values()[0]) == pytest.approx(
+            float(vals[m].sum()), rel=1e-6)
+        exact = int((vals[m] == 42.0).sum())
+        assert w.get_agg_values()[1] >= exact

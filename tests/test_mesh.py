@@ -1,0 +1,562 @@
+"""Mesh-sharded keyed engine (ISSUE 10): shard_map execution, routing,
+hot-key rebalance at checkpoint boundaries, and shard-count-portable
+checkpoints — all differential against per-key host simulators and
+never-rebalanced engine twins (conftest provides the virtual 8-device
+CPU mesh)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    CountMinSketchAggregation,
+    MaxAggregation,
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.mesh import (
+    MeshKeyedEngine,
+    MeshKeyedPipeline,
+    RoutingTable,
+    plan_rebalance,
+)
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=1 << 10, batch_size=32, annex_capacity=128,
+                   min_trigger_pad=32)
+WINDOWS = [TumblingWindow(Time, 20), SlidingWindow(Time, 50, 10)]
+
+
+# ---------------------------------------------------------------------------
+# Routing table + planner (pure host)
+# ---------------------------------------------------------------------------
+
+
+def test_routing_table_identity_and_swaps():
+    t = RoutingTable(16, 4)
+    assert t.rows_per_shard == 4
+    assert (t.shard_of([0, 5, 15]) == [0, 1, 3]).all()
+    t2 = t.swapped([(0, 12)])
+    assert t2.shard_of([0])[0] == 3 and t2.shard_of([12])[0] == 0
+    # permutation_from: applying it to row-major data relocates rows
+    perm = t2.permutation_from(t)
+    data = np.arange(16) * 10          # physical rows under t == keys
+    moved = data[perm]
+    assert moved[t2.row_of[0]] == 0 and moved[t2.row_of[12]] == 120
+
+
+def test_routing_table_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        RoutingTable(10, 4)            # not divisible
+    with pytest.raises(ValueError):
+        RoutingTable(8, 4, row_of=np.zeros(8, np.int32))  # not a perm
+
+
+def test_routing_table_json_roundtrip():
+    t = RoutingTable(8, 2).swapped([(1, 6)])
+    t2 = RoutingTable.from_json(t.to_json())
+    assert (t2.row_of == t.row_of).all()
+    assert t2.n_shards == 2
+
+
+def test_plan_rebalance_balances_and_converges():
+    t = RoutingTable(16, 4)
+    loads = np.ones(16)
+    loads[0], loads[1] = 10, 9          # two hot keys on shard 0
+    swaps, stats = plan_rebalance(t, loads, max_moves=8)
+    assert swaps and stats["imbalance_after"] < stats["imbalance_before"]
+    nt = t.swapped(swaps)
+    assert sorted(nt.row_of.tolist()) == list(range(16))
+    # a single dominant key cannot be split: the planner must CONVERGE,
+    # not oscillate the key between shards forever
+    loads2 = np.ones(16)
+    loads2[3] = 100.0
+    swaps2, _ = plan_rebalance(t, loads2, max_moves=64)
+    assert len(swaps2) < 64
+
+
+# ---------------------------------------------------------------------------
+# Engine: shard_map execution differential
+# ---------------------------------------------------------------------------
+
+
+def _keyed_oracle(n_keys, windows, agg_factories, streams, wm,
+                  lateness=1000):
+    out = {}
+    for k in range(n_keys):
+        op = SlicingWindowOperator()
+        for w in windows:
+            op.add_window_assigner(w)
+        for mk in agg_factories:
+            op.add_aggregation(mk())
+        op.set_max_lateness(lateness)
+        for v, t in streams(k):
+            op.process_element(float(v), int(t))
+        out[k] = [w for w in op.process_watermark(wm) if w.has_value()]
+    return out
+
+
+def _hot_stream(seed=11, n_keys=16, n=800, hot=3, t_hi=300):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_keys, size=n)
+    keys[: n // 3] = hot
+    ts = np.sort(rng.integers(0, t_hi, size=n))
+    vals = rng.integers(1, 50, size=n).astype(np.float64)
+    return keys, vals, ts
+
+
+def _make_engine(n_keys=16, n_shards=8):
+    eng = MeshKeyedEngine(n_keys=n_keys, n_shards=n_shards, config=CFG)
+    for w in WINDOWS:
+        eng.add_window_assigner(w)
+    eng.add_aggregation(SumAggregation())
+    eng.add_aggregation(MaxAggregation())
+    return eng
+
+
+def test_mesh_engine_matches_per_key_simulators():
+    keys, vals, ts = _hot_stream()
+    eng = _make_engine()
+    eng.process_keyed_elements(keys, vals, ts)
+    wm = int(ts[-1]) + 1
+    got = eng.process_watermark(wm)
+    want = _keyed_oracle(16, WINDOWS, [SumAggregation, MaxAggregation],
+                         lambda k: zip(vals[keys == k], ts[keys == k]), wm)
+    got_by_key = {k: [] for k in range(16)}
+    for k, w in got:
+        got_by_key[k].append(w)
+    for k in range(16):
+        assert len(got_by_key[k]) == len(want[k]), k
+        for a, b in zip(want[k], got_by_key[k]):
+            assert (a.get_start(), a.get_end()) == (b.get_start(),
+                                                    b.get_end())
+            for x, y in zip(a.get_agg_values(), b.get_agg_values()):
+                assert float(x) == pytest.approx(float(y), rel=1e-5)
+
+
+def test_mesh_engine_global_fold_is_in_executable_psum():
+    """query_global folds all-shard totals via psum/pmin/pmax INSIDE one
+    lowered program (the global_op.py seam on the keyed path)."""
+    keys, vals, ts = _hot_stream()
+    eng = _make_engine()
+    eng.process_keyed_elements(keys, vals, ts)
+    cnt, lowered = eng.query_global([0], [300])
+    assert int(cnt[0]) == len(keys)
+    assert float(lowered[0][0]) == pytest.approx(float(vals.sum()),
+                                                 rel=1e-6)
+    assert float(lowered[1][0]) == float(vals.max())
+    # the collective is in the executable, not a host-side reduction
+    import jax
+
+    low = jax.jit(eng._global_query_fn).lower(
+        eng._state, np.zeros(32, np.int64), np.full(32, 300, np.int64),
+        np.arange(32) < 1)
+    assert low.as_text().count("all-reduce") \
+        + low.as_text().count("all_reduce") >= 2
+
+
+def test_mesh_engine_rebalance_bitmatches_unmoved_oracle():
+    keys, vals, ts = _hot_stream()
+    rng = np.random.default_rng(5)
+    more_keys = rng.integers(0, 16, size=200)
+    more_ts = np.sort(rng.integers(300, 500, size=200))
+    more_vals = rng.integers(1, 50, size=200).astype(np.float64)
+
+    def feed(eng, rebalance):
+        eng.process_keyed_elements(keys, vals, ts)
+        first = eng.process_watermark(int(ts[-1]) + 1)
+        if rebalance:
+            swaps, stats = eng.detect_hot_keys(max_moves=8)
+            assert 3 in stats["hot_keys"]          # the planted hot key
+            eng.rebalance(swaps)
+        eng.process_keyed_elements(more_keys, more_vals, more_ts)
+        return first, eng.process_watermark(501)
+
+    f1, got = feed(_make_engine(), rebalance=True)
+    f2, want = feed(_make_engine(), rebalance=False)
+    assert len(got) == len(want) and len(f1) == len(f2)
+    for (ka, wa), (kb, wb) in zip(want, got):
+        assert ka == kb
+        assert (wa.get_start(), wa.get_end()) == (wb.get_start(),
+                                                  wb.get_end())
+        for x, y in zip(wa.get_agg_values(), wb.get_agg_values()):
+            assert float(x) == float(y), (ka, wa.get_start())
+
+
+def test_mesh_engine_device_round_routes_through_table():
+    """ingest_device_round(logical_major=True): a device-resident
+    logical-major [K, B] round lands on the right physical rows via the
+    DEVICE routing table — including after a rebalance made the table
+    non-identity — and results match per-key host simulators."""
+    import jax
+    import jax.numpy as jnp
+
+    K, B = 16, 32
+    rng = np.random.default_rng(2)
+    eng = _make_engine(n_keys=K)
+    # seed some state, checkpoint-boundary-style flush, then rebalance so
+    # the routing table is NOT the identity
+    eng.process_keyed_elements([0], [1.0], [0])
+    _ = eng.process_watermark(1)
+    eng.rebalance([(0, 9), (3, 12)])
+    assert eng.routing.row_of[0] == 9
+
+    all_rows = {k: [(1.0, 0)] if k == 0 else [] for k in range(K)}
+    lo = 1
+    for _ in range(3):
+        ts = np.sort(rng.integers(lo, lo + 50, size=(K, B)),
+                     axis=1).astype(np.int64)
+        vals = rng.integers(1, 9, size=(K, B)).astype(np.float32)
+        eng.ingest_device_round(
+            jax.device_put(jnp.asarray(ts)),
+            jax.device_put(jnp.asarray(vals)),
+            jax.device_put(np.ones((K, B), bool)), lo, lo + 49)
+        for k in range(K):
+            all_rows[k].extend(zip(vals[k], ts[k]))
+        lo += 50
+    wm = lo + 100
+    got = eng.process_watermark(wm)
+    want = _keyed_oracle(K, WINDOWS, [SumAggregation, MaxAggregation],
+                         lambda k: all_rows[k], wm)
+    got_by_key = {k: [] for k in range(K)}
+    for k, w in got:
+        got_by_key[k].append(w)
+    for k in range(K):
+        assert len(got_by_key[k]) == len(want[k]), k
+        for a, b in zip(want[k], got_by_key[k]):
+            assert (a.get_start(), a.get_end()) == (b.get_start(),
+                                                    b.get_end())
+            for x, y in zip(a.get_agg_values(), b.get_agg_values()):
+                assert float(x) == pytest.approx(float(y), rel=1e-5), k
+
+
+def test_mesh_engine_rejects_rebalance_with_pending_rounds():
+    eng = _make_engine()
+    eng.process_keyed_elements([1], [1.0], [10])
+    with pytest.raises(RuntimeError, match="checkpoint"):
+        eng.rebalance([(0, 8)])
+
+
+def test_mesh_checkpoint_restores_under_different_shard_counts(tmp_path):
+    """Save under 8 shards, restore under 2 and 1 (and after the saver
+    rebalanced): every restore continues the stream bit-identically."""
+    keys, vals, ts = _hot_stream()
+    rng = np.random.default_rng(7)
+    more_keys = rng.integers(0, 16, size=200)
+    more_ts = np.sort(rng.integers(300, 500, size=200))
+    more_vals = rng.integers(1, 50, size=200).astype(np.float64)
+
+    eng = _make_engine(n_shards=8)
+    eng.process_keyed_elements(keys, vals, ts)
+    _ = eng.process_watermark(int(ts[-1]) + 1)
+    ck = str(tmp_path / "ck")
+    eng.save(ck)
+
+    def finish(e):
+        e.process_keyed_elements(more_keys, more_vals, more_ts)
+        return e.process_watermark(501)
+
+    want = finish(eng)
+    for m in (2, 1):
+        e2 = _make_engine(n_shards=m)
+        e2.restore(ck)
+        got = finish(e2)
+        assert len(got) == len(want), m
+        for (ka, wa), (kb, wb) in zip(want, got):
+            assert ka == kb and wa.get_start() == wb.get_start()
+            for x, y in zip(wa.get_agg_values(), wb.get_agg_values()):
+                assert float(x) == float(y), (m, ka)
+
+
+def test_mesh_checkpoint_rejects_wrong_key_count(tmp_path):
+    eng = _make_engine(n_keys=16)
+    eng.process_keyed_elements([1], [1.0], [10])
+    _ = eng.process_watermark(11)
+    ck = str(tmp_path / "ck")
+    eng.save(ck)
+    other = MeshKeyedEngine(n_keys=32, n_shards=8, config=CFG)
+    for w in WINDOWS:
+        other.add_window_assigner(w)
+    other.add_aggregation(SumAggregation())
+    other.add_aggregation(MaxAggregation())
+    with pytest.raises(ValueError, match="16 keys"):
+        other.restore(ck)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor boundary: atomic commit, rebalance after the commit point,
+# corrupt newest bundle -> lineage fallback (the PR 8 machinery)
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_checkpoint_and_rebalance_with_lineage_fallback(
+        tmp_path):
+    import scotty_tpu.obs as obs_mod
+    from scotty_tpu.resilience.supervisor import Supervisor
+
+    keys, vals, ts = _hot_stream()
+    rng = np.random.default_rng(9)
+    mid_keys = rng.integers(0, 16, size=200)
+    mid_ts = np.sort(rng.integers(300, 500, size=200))
+    mid_vals = rng.integers(1, 50, size=200).astype(np.float64)
+    late_keys = rng.integers(0, 16, size=150)
+    late_ts = np.sort(rng.integers(500, 700, size=150))
+    late_vals = rng.integers(1, 50, size=150).astype(np.float64)
+
+    obs = obs_mod.Observability()
+    sup = Supervisor(str(tmp_path / "sup"), obs=obs, keep_checkpoints=3)
+    eng = _make_engine()
+    eng.set_observability(obs)
+    eng.process_keyed_elements(keys, vals, ts)
+    _ = eng.process_watermark(int(ts[-1]) + 1)
+    stats = eng.checkpoint_and_rebalance(sup, pos=1, max_moves=8)
+    assert stats["moved"] > 0                       # planted hot key moved
+    snap = obs.registry.snapshot()
+    assert snap.get("mesh_rebalances") == 1
+    assert snap.get("mesh_hot_keys", 0) >= 1
+
+    eng.process_keyed_elements(mid_keys, mid_vals, mid_ts)
+    _ = eng.process_watermark(501)
+    eng.checkpoint_and_rebalance(sup, pos=2, max_moves=8)
+
+    # corrupt the NEWEST generation's state payload: restores must fall
+    # back through the lineage to ckpt-1 (counted, not fatal)
+    ck2 = os.path.join(str(tmp_path / "sup"), "ckpt-2")
+    target = os.path.join(ck2, "mesh_state.npz")
+    blob = bytearray(open(target, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(target, "wb").write(bytes(blob))
+
+    obs2 = obs_mod.Observability()
+    sup2 = Supervisor(str(tmp_path / "sup"), obs=obs2, keep_checkpoints=3)
+    found = sup2.latest_checkpoint()
+    assert found is not None
+    ck, _off = found
+    assert os.path.basename(ck) == "ckpt-1"
+    snap2 = obs2.registry.snapshot()
+    assert snap2.get("ckpt_integrity_failures") == 1
+    assert snap2.get("ckpt_lineage_fallbacks") == 1
+
+    # restore from the surviving generation and replay from its offset:
+    # emissions bit-match an uninterrupted engine
+    e2 = _make_engine(n_shards=4)
+    e2.restore(ck, verify=False)        # lineage walk just verified it
+    e2.process_keyed_elements(mid_keys, mid_vals, mid_ts)
+    _ = e2.process_watermark(501)
+    e2.process_keyed_elements(late_keys, late_vals, late_ts)
+    got = e2.process_watermark(701)
+
+    e3 = _make_engine()
+    e3.process_keyed_elements(keys, vals, ts)
+    _ = e3.process_watermark(int(ts[-1]) + 1)
+    e3.process_keyed_elements(mid_keys, mid_vals, mid_ts)
+    _ = e3.process_watermark(501)
+    e3.process_keyed_elements(late_keys, late_vals, late_ts)
+    want = e3.process_watermark(701)
+    assert len(got) == len(want)
+    for (ka, wa), (kb, wb) in zip(want, got):
+        assert ka == kb and wa.get_start() == wb.get_start()
+        for x, y in zip(wa.get_agg_values(), wb.get_agg_values()):
+            assert float(x) == float(y)
+
+
+# ---------------------------------------------------------------------------
+# Fused pipeline: shard-count invariance, in-executable global fold,
+# rebalance mid-run, portable snapshots
+# ---------------------------------------------------------------------------
+
+
+def _make_pipeline(n_shards, seed=13, n_keys=16):
+    windows = [TumblingWindow(Time, 100), SlidingWindow(Time, 500, 100)]
+    p = MeshKeyedPipeline(
+        windows, [SumAggregation(), MaxAggregation()], n_keys=n_keys,
+        n_shards=n_shards, config=CFG, throughput=n_keys * 2000,
+        wm_period_ms=100, max_lateness=100, seed=seed, gc_every=3)
+    p.reset()
+    return p
+
+
+def test_mesh_pipeline_shard_invariant_and_matches_simulator():
+    p8, p1 = _make_pipeline(8), _make_pipeline(1)
+    sim = SlicingWindowOperator()
+    for w in p8.windows:
+        sim.add_window_assigner(w)
+    sim.add_aggregation(SumAggregation())
+    sim.add_aggregation(MaxAggregation())
+    sim.set_max_lateness(100)
+    kk_sim = 5
+    for i in range(6):
+        a, b = p8.run(1)[0], p1.run(1)[0]
+        for kk in (0, 5, 15):
+            ra = p8.lowered_results_for_key(a, kk)
+            rb = p1.lowered_results_for_key(b, kk)
+            assert ra == rb, (i, kk)
+        vals, ts = p8.materialize_interval(i, kk_sim)
+        order = np.argsort(ts, kind="stable")
+        sim.process_elements(vals[order], ts[order])
+        want = {}
+        for w in sim.process_watermark((i + 1) * 100):
+            if w.has_value():
+                want.setdefault((w.get_start(), w.get_end()),
+                                w.get_agg_values())
+        got = {(s, e): v
+               for (s, e, c, v) in p8.lowered_results_for_key(a, kk_sim)}
+        assert set(got) == set(want), (i, set(want) ^ set(got))
+        for k2 in want:
+            for x, y in zip(want[k2], got[k2]):
+                assert float(x) == pytest.approx(float(y), rel=2e-4)
+    p8.check_overflow()
+    p1.check_overflow()
+
+
+def test_mesh_pipeline_global_fold_matches_shard_reduction():
+    import jax
+
+    p = _make_pipeline(8)
+    out = p.run(3)[-1]
+    ws, we, cnt, results, gcnt, gparts = jax.device_get(out)
+    assert (gcnt == cnt.sum(axis=0)).all()
+    assert np.allclose(np.asarray(gparts[0]),
+                       np.asarray(results[0]).sum(axis=0))
+    assert np.allclose(np.asarray(gparts[1]),
+                       np.asarray(results[1]).max(axis=0))
+    rows = p.lowered_global(out)
+    assert rows and all(c > 0 for _, _, c, _ in rows)
+
+
+def test_mesh_pipeline_midrun_rebalance_bitmatches():
+    pr, pn = _make_pipeline(8, seed=21), _make_pipeline(8, seed=21)
+    for _ in range(3):
+        pr.run(1), pn.run(1)
+    pr.sync()
+    pr.rebalance([(0, 9), (5, 12)])
+    assert pr.routing.row_of[0] == 9
+    for i in range(3):
+        a, b = pr.run(1)[0], pn.run(1)[0]
+        for kk in (0, 3, 5, 9, 12):
+            assert pr.lowered_results_for_key(a, kk) \
+                == pn.lowered_results_for_key(b, kk), (i, kk)
+    pr.check_overflow()
+
+
+def test_mesh_pipeline_snapshot_portable_across_shard_counts(tmp_path):
+    pr = _make_pipeline(8, seed=31)
+    pr.run(3)
+    pr.sync()
+    pr.rebalance([(2, 11)])
+    ck = str(tmp_path / "pck")
+    pr.save(ck)
+    p2 = _make_pipeline(2, seed=31)
+    p2.restore(ck)
+    assert p2._interval == pr._interval
+    a, b = pr.run(1)[0], p2.run(1)[0]
+    for kk in (0, 2, 7, 11):
+        assert pr.lowered_results_for_key(a, kk) \
+            == p2.lowered_results_for_key(b, kk)
+    # routing travels as a readable sidecar
+    doc = json.load(open(os.path.join(ck, "routing.json")))
+    assert doc["n_shards"] == 8 and doc["n_keys"] == 16
+
+
+def test_mesh_pipeline_sparse_cms_matches_host_oracle():
+    """The count-min sketch rides the mesh keyed path (ISSUE 10 satellite:
+    the sparse-lift seam through the sharded pipeline) — estimates
+    bit-match the scalar-face oracle on the materialized stream."""
+    agg = CountMinSketchAggregation(2500.0, depth=2, width=128)
+    p = MeshKeyedPipeline(
+        [TumblingWindow(Time, 100)], [agg], n_keys=8, n_shards=8,
+        config=CFG, throughput=8 * 2000, wm_period_ms=100,
+        max_lateness=100, seed=3, gc_every=4)
+    p.reset()
+    for i in range(3):
+        out = p.run(1)[0]
+        for kk in (0, 7):
+            vals, _ts = p.materialize_interval(i, kk)
+            rows = p.lowered_results_for_key(out, kk)
+            assert rows
+            for (s, e, c, v) in rows:
+                part = [0] * (agg.depth * agg.width)
+                for val in vals:        # one tumbling window per interval
+                    part = agg.lift_and_combine(part, float(val))
+                assert float(v[0]) == agg.lower(part), (i, kk, s, e)
+    p.check_overflow()
+
+
+def test_mesh_cell_in_fresh_interpreter_subprocess():
+    """The virtual-8-device CI certification (ISSUE 10): a FRESH
+    interpreter with ``XLA_FLAGS=--xla_force_host_platform_device_count
+    =8`` set before any JAX import (the PR 2 isolation discipline — no
+    inherited backend, no conftest ordering dependence) runs a sharded
+    cell end to end: shard_map step, psum fold, rebalance, oracle
+    match."""
+    import subprocess
+    import sys
+
+    body = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import numpy as np
+import jax
+assert len(jax.devices()) == 8, jax.devices()
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.mesh import MeshKeyedPipeline
+cfg = EngineConfig(capacity=64, annex_capacity=8, min_trigger_pad=32)
+def make(n):
+    p = MeshKeyedPipeline([TumblingWindow(WindowMeasure.Time, 1000)],
+                          [SumAggregation()], n_keys=128, n_shards=n,
+                          config=cfg, throughput=128 * 1000,
+                          wm_period_ms=1000, max_lateness=1000, seed=2)
+    p.reset()
+    return p
+p8, p1 = make(8), make(1)
+for i in range(3):
+    a, b = p8.run(1)[0], p1.run(1)[0]
+    for kk in (0, 64, 127):
+        assert p8.lowered_results_for_key(a, kk) \
+            == p1.lowered_results_for_key(b, kk), (i, kk)
+ws, we, cnt, results, gcnt, gparts = jax.device_get(p8.run(1)[0])
+assert (gcnt == cnt.sum(axis=0)).all()
+p1.run(1)            # keep the twin on the same interval
+p8.sync(); p8.rebalance([(0, 64)])
+a, b = p8.run(1)[0], p1.run(1)[0]
+for kk in (0, 64):
+    assert p8.lowered_results_for_key(a, kk) \
+        == p1.lowered_results_for_key(b, kk)
+p8.check_overflow(); p1.check_overflow()
+print("MESH_SUBPROCESS_OK")
+"""
+    # scrubbed env: the child must build its OWN 8-device CPU backend
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", body], env=env,
+                       capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0 and "MESH_SUBPROCESS_OK" in r.stdout, (
+        f"isolated mesh cell failed (rc={r.returncode}):\n"
+        f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+
+
+def test_mesh_bench_cell_smoke():
+    """run_mesh_keyed_cell completes with the mesh contract fields
+    (scaling arms + differential arms) on a small geometry."""
+    from scotty_tpu.bench.harness import BenchmarkConfig
+    from scotty_tpu.bench.runner import run_mesh_keyed_cell
+
+    cfg = BenchmarkConfig(name="mesh-smoke", throughput=1 << 18,
+                          runtime_s=2, capacity=64, n_keys=128,
+                          watermark_period_ms=1000, max_lateness=1000)
+    r = run_mesh_keyed_cell(cfg, "Tumbling(1000)", "sum")
+    assert r.tuples_per_sec > 0
+    assert r.n_shards == 8 and r.n_keys == 128
+    assert r.oracle_match and r.rebalance_match
+    assert r.tuples_per_sec_1shard > 0 and r.scaling_ratio > 0
+    assert len(r.per_shard_occupancy) == 8
