@@ -41,6 +41,7 @@ from .core import (
     QuantileAggregation,
     ReduceAggregateFunction,
     CappedSessionWindow,
+    GenericSessionWindow,
     SessionWindow,
     SlidingWindow,
     SumAggregation,
@@ -116,7 +117,7 @@ __all__ = [
     "DDSketchQuantileAggregation", "FixedBandWindow", "HyperLogLogAggregation",
     "InvertibleReduceAggregateFunction", "MaxAggregation", "MeanAggregation",
     "MinAggregation", "QuantileAggregation", "ReduceAggregateFunction",
-    "CappedSessionWindow", "SessionWindow", "SlidingWindow", "SumAggregation", "TimeMeasure",
+    "CappedSessionWindow", "GenericSessionWindow", "SessionWindow", "SlidingWindow", "SumAggregation", "TimeMeasure",
     "TumblingWindow", "Window", "WindowMeasure", "WindowOperator",
     "SlicingWindowOperator", "MemoryStateFactory", "StateFactory",
     "HybridWindowOperator", "TpuWindowOperator", "EngineConfig",

@@ -86,6 +86,30 @@ def _count_lowered():
     return p._step.lower(p.state, p.dm, p._interval_key(0), np.int64(0))
 
 
+def _context_lowered():
+    """Canonical generic-context chunk kernel (ISSUE 11): the vectorized
+    chain/speculative dispatch unit for a capped-session decider — the
+    lowering every speculative chunk run and in-order context chunk
+    executes. Frozen like the other canonical configs."""
+    import numpy as np
+
+    from scotty_tpu import SumAggregation
+    from scotty_tpu.engine import context as ectx
+    from scotty_tpu.engine import sessions as es
+
+    import jax
+
+    aggs = (SumAggregation().device_spec(),)
+    spec = ectx.CappedSessionDecider(10, 40)
+    kern = jax.jit(ectx.build_context_chunk(aggs, spec, 256, 256),
+                   donate_argnums=0)
+    st = es.init_session_state(aggs, 256, orphan_capacity=64)
+    ts = np.arange(256, dtype=np.int64)
+    vals = np.ones(256, np.float32)
+    m = np.ones(256, bool)
+    return kern.lower(st, ts, vals, m)
+
+
 def _mesh_lowered():
     """Canonical mesh-sharded keyed step (ISSUE 10): 16 keys over the
     8-device virtual mesh — the shard_map per-shard program + the
@@ -123,6 +147,7 @@ CANONICAL_STEPS = {
     "aligned": _aligned_lowered,
     "session": _session_lowered,
     "count": _count_lowered,
+    "context": _context_lowered,
     "mesh": _mesh_lowered,
 }
 

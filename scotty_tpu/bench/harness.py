@@ -99,6 +99,10 @@ def parse_window_spec(spec: str, seed: int = 0) -> List[Window]:
         from ..core.windows import CappedSessionWindow
 
         return [CappedSessionWindow(T, args[0], args[1])]
+    if name_l == "genericsession":
+        from ..core.windows import GenericSessionWindow
+
+        return [GenericSessionWindow(T, args[0])]
     raise ValueError(f"unknown window spec {name!r}")
 
 

@@ -393,6 +393,18 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if engine == "ShapedOOO":
         return run_shaped_ooo_cell(cfg, window_spec, agg_name, obs=obs)
 
+    if engine == "ContextChaos":
+        return run_context_chaos_cell(cfg, window_spec, agg_name, obs=obs)
+
+    if engine == "CountFused":
+        return run_count_fused_cell(cfg, window_spec, agg_name, obs=obs)
+
+    if engine == "RingFed":
+        return run_ring_fed_cell(cfg, window_spec, agg_name, obs=obs)
+
+    if engine == "RingFedMesh":
+        return run_ring_fed_mesh_cell(cfg, window_spec, agg_name, obs=obs)
+
     if engine == "IngestExternal":
         return run_ingest_external_cell(cfg, window_spec, agg_name,
                                         obs=obs)
@@ -771,6 +783,776 @@ def run_shaped_ooo_cell(cfg: BenchmarkConfig, window_spec: str,
     stats = shaper.device_stats()
     res.shaper_late_routed = stats.get("late_routed", 0)
     res.shaper_reordered = stats.get("reordered", 0)
+    finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
+    return res
+
+
+def _aligned_inprogram_arm(cfg: BenchmarkConfig, windows, agg_name: str,
+                           legacy: bool):
+    """In-program comparator for the ring-fed headline (ISSUE 11 /
+    ADVICE r5 finding 1): the fused AlignedStreamPipeline at the cell's
+    geometry — ``(tps, gen_share)`` where ``gen_share`` is the fraction
+    of the steady-state interval the STREAM GENERATOR alone accounts
+    for, measured by timing the step's own generator closure
+    (``_gen_active`` — the legacy arm times the pinned r4 draws) as a
+    separate jit over the same rows/chunks."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+
+    tp = _round_throughput(
+        cfg.throughput,
+        AlignedStreamPipeline.slice_grid(windows, cfg.watermark_period_ms))
+    p = AlignedStreamPipeline(
+        windows, [make_aggregation(agg_name)],
+        config=EngineConfig(capacity=cfg.capacity, annex_capacity=8,
+                            min_trigger_pad=32),
+        throughput=tp, wm_period_ms=cfg.watermark_period_ms,
+        max_lateness=cfg.max_lateness, seed=cfg.seed, gc_every=32,
+        legacy_generator=legacy)
+    p.reset()
+    p.run(3, collect=False)
+    p.sync()
+    timed = 5
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p.run(timed, collect=False)
+        p.sync()
+        best = min(best, (time.perf_counter() - t0) / timed)
+    p.check_overflow()
+
+    S, d, R = p.S, p.rows_per_chunk, p.R
+    gen = p._gen_active
+
+    @jax.jit
+    def probe(key):
+        def body(acc, c):
+            out = gen(key, c * d + jnp.arange(d, dtype=jnp.int64))
+            vals = out[0] if isinstance(out, tuple) else out
+            a = acc + jnp.sum(vals)
+            if isinstance(out, tuple):      # legacy: offsets are live too
+                a = a + jnp.sum(out[1]).astype(jnp.float32)
+            return a, None
+        acc, _ = jax.lax.scan(body, jnp.float32(0),
+                              jnp.arange(S // d, dtype=jnp.int64))
+        return acc
+
+    key = p._interval_key(0)
+    jax.device_get(probe(key))              # compile
+    best_gen = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for r in range(timed):
+            h = probe(jax.random.fold_in(key, r))
+        jax.device_get(h)
+        best_gen = min(best_gen, (time.perf_counter() - t0) / timed)
+    return (p.tuples_per_interval / best,
+            min(1.0, best_gen / best))
+
+
+def run_ring_fed_cell(cfg: BenchmarkConfig, window_spec: str,
+                      agg_name: str,
+                      obs: Optional[_obs.Observability] = None
+                      ) -> BenchResult:
+    """Ring-fed headline cell (ISSUE 11, closes ADVICE r5 finding 1):
+    the headline window class fed from the PR 7 ingest ring — a
+    HOST-resident pregenerated in-order stream through
+    ``BatchAccumulator.offer_block`` → ``IngestRing`` →
+    ``DeviceRingFeeder`` prefetch → the batch operator — instead of the
+    in-program generator, so the recorded number contains ZERO
+    generator work. Comparators ride the row: the in-program fused
+    pipeline at the same geometry (``inprogram_tps``), the pinned
+    legacy-anchor generator arm (``legacy_anchor_tps``, ADVICE r5's
+    workload-identical cross-round anchor), and the measured
+    ``generator_share`` of each in-program arm's steady-state interval
+    — quantifying exactly how much of the headline the generator is."""
+    import jax
+
+    from ..engine import EngineConfig, TpuWindowOperator
+    from ..ingest import LineRateFeed, RingConfig
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    B = cfg.batch_size
+    n_chunks = int(max(6, cfg.throughput * cfg.runtime_s // B))
+    span = max(1.0, cfg.runtime_s * 1000 / n_chunks)
+    # event time starts past the widest window span so triggers fire
+    # from the first watermarks (the in-program pipelines' prefill
+    # equivalent); pooled chunks cycle so pregeneration memory stays
+    # bounded at any runtime
+    off0 = max(w.clear_delay() for w in windows)
+    rng = np.random.default_rng(cfg.seed)
+    n_pools = min(n_chunks, 12)
+    pools = []
+    for _ in range(n_pools):
+        ts = np.sort(rng.integers(0, max(1, int(span)),
+                                  size=B)).astype(np.int64)
+        vals = (rng.random(B) * 10_000).astype(np.float32)
+        pools.append((vals, ts))
+
+    def chunk(i):
+        vals, ts = pools[i % n_pools]
+        lo = off0 + int(i * span)
+        return vals, ts + np.int64(lo), off0 + int((i + 1) * span)
+
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=cfg.capacity, batch_size=B,
+        overflow_policy=cfg.overflow_policy))
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(make_aggregation(agg_name))
+    op.set_max_lateness(cfg.max_lateness)
+    feed = LineRateFeed(op, ring=RingConfig(
+        depth=cfg.ring_depth or 8, block_size=cfg.ring_block_size or B))
+
+    warm_hi = 0
+    for i in (0, 1):
+        v, t, warm_hi = chunk(i)
+        feed.offer_block(v, t)
+    op.process_watermark_async(warm_hi + 1)
+    jax.device_get(op._state.n_slices)
+    if obs is not None:
+        op.set_observability(obs)
+        obs.registry.reset_clock()
+    next_wm = (warm_hi // cfg.watermark_period_ms + 2) \
+        * cfg.watermark_period_ms
+    pending = []
+    t0 = time.perf_counter()
+    for i in range(2, n_chunks):
+        v, t, hi = chunk(i)
+        feed.offer_block(v, t)
+        while hi >= next_wm:
+            out = op.process_watermark_async(next_wm)
+            if out[3] is not None:
+                pending.append((out[0].shape[0], out[3]))
+            next_wm += cfg.watermark_period_ms
+    feed.drain()
+    out = op.process_watermark_async(next_wm)
+    if out[3] is not None:
+        pending.append((out[0].shape[0], out[3]))
+    emitted = 0
+    fetched = jax.device_get([c for _, c in pending])
+    for (T, _), cnt in zip(pending, fetched):
+        emitted += int((cnt[:T] > 0).sum())
+    op.check_overflow()
+    wall = time.perf_counter() - t0
+    n_tuples = (n_chunks - 2) * B
+    if obs is not None:
+        obs.registry.stop_clock()
+        op.set_observability(None)
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    res.emit_ms_device = wall / max(1, len(pending)) * 1e3
+    snap = feed.snapshot()
+    res.prefetch_overlap_ratio = feed.feeder.overlap_ratio()
+    res.ring_full_events = int(snap["full_events"])
+    res.ring_shed = int(snap["shed"])
+    res.ring_blocks = int(snap["blocks"])
+
+    # -- in-program + pinned legacy-anchor comparator arms ----------------
+    res.inprogram_tps, res.generator_share = _aligned_inprogram_arm(
+        cfg, windows, agg_name, legacy=False)
+    try:
+        (res.legacy_anchor_tps,
+         res.generator_share_legacy) = _aligned_inprogram_arm(
+            cfg, windows, agg_name, legacy=True)
+    except NotImplementedError as e:
+        res.legacy_anchor_note = f"legacy arm unavailable: {e}"
+    res.ring_fed_vs_inprogram = res.tuples_per_sec / max(
+        res.inprogram_tps, 1e-9)
+    res.platform = jax.devices()[0].platform
+    finalize_observability(res, obs, [], emitted, n_tuples=n_tuples)
+    return res
+
+
+def run_ring_fed_mesh_cell(cfg: BenchmarkConfig, window_spec: str,
+                           agg_name: str,
+                           obs: Optional[_obs.Observability] = None
+                           ) -> BenchResult:
+    """Ring-fed MESH cell (ISSUE 11): a HOST-resident keyed external
+    stream staged through the keyed PR 7 ingest ring
+    (``IngestRing(keyed=True)`` → ``RingIngestor`` →
+    ``BlockSinkFeeder``) into the mesh-sharded keyed engine by LOGICAL
+    key — no in-program generator anywhere in the recorded number.
+    Comparators: the in-program ``MeshKeyedPipeline`` at the same
+    keys/shards geometry (``inprogram_tps``) and the pinned
+    legacy-anchor arm (``legacy_anchor_tps``) for cross-round context;
+    ``platform``/``host_cores`` recorded — mesh scaling floors stay
+    TPU-box certifications."""
+    import os as _os
+
+    import jax
+
+    from ..engine import EngineConfig
+    from ..ingest.feeder import BlockSinkFeeder, RingIngestor
+    from ..ingest.ring import IngestRing, RingConfig
+    from ..mesh import MeshKeyedEngine, MeshKeyedPipeline
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    K = max(4, cfg.n_keys)
+    n_shards = cfg.n_shards or len(jax.devices())
+    B = cfg.ring_block_size or (1 << 16)
+    Bk = max(64, 1 << int(np.ceil(np.log2(max(2, 4 * B // K)))))
+    eng = MeshKeyedEngine(
+        n_keys=K, n_shards=n_shards,
+        config=EngineConfig(capacity=max(128, min(cfg.capacity, 512)),
+                            batch_size=Bk, annex_capacity=8,
+                            min_trigger_pad=32))
+    for w in windows:
+        eng.add_window_assigner(w)
+    eng.add_aggregation(make_aggregation(agg_name))
+    eng.set_max_lateness(cfg.max_lateness)
+
+    ring = IngestRing(cfg.ring_depth or 8, B, keyed=True,
+                      value_dtype=np.float32)
+    sink = BlockSinkFeeder(
+        ring, lambda keys, vals, ts: eng.process_keyed_elements(
+            keys.astype(np.int64), vals, ts))
+    ingestor = RingIngestor(ring, sink, obs=obs)
+
+    n_chunks = int(max(6, cfg.throughput * cfg.runtime_s // B))
+    span = max(1.0, cfg.runtime_s * 1000 / n_chunks)
+    off0 = max(w.clear_delay() for w in windows)
+    rng = np.random.default_rng(cfg.seed)
+    n_pools = min(n_chunks, 12)
+    pools = []
+    for _ in range(n_pools):
+        ts = np.sort(rng.integers(0, max(1, int(span)),
+                                  size=B)).astype(np.int64)
+        keys = rng.integers(0, K, size=B)
+        vals = (rng.random(B) * 10_000).astype(np.float32)
+        pools.append((keys, vals, ts))
+
+    def offer(i):
+        keys, vals, ts = pools[i % n_pools]
+        lo = off0 + int(i * span)
+        ingestor.offer_block(vals, ts + np.int64(lo), keys)
+        ingestor.poll()
+        return off0 + int((i + 1) * span)
+
+    hi = offer(0)
+    hi = offer(1)
+    eng.process_watermark_async(hi + 1)
+    jax.device_get(jax.tree.leaves(eng._state)[0])
+    if obs is not None:
+        obs.registry.reset_clock()
+    next_wm = (hi // cfg.watermark_period_ms + 2) * cfg.watermark_period_ms
+    pending = []
+    t0 = time.perf_counter()
+    for i in range(2, n_chunks):
+        hi = offer(i)
+        while hi >= next_wm:
+            pending.append(eng.process_watermark_async(next_wm))
+            next_wm += cfg.watermark_period_ms
+    ingestor.drain()
+    pending.append(eng.process_watermark_async(next_wm))
+    emitted = 0
+    for out in pending:
+        ws, we, cnt, lowered = eng.lower_results(*out)
+        emitted += int((cnt > 0).sum())
+    eng.check_overflow()
+    wall = time.perf_counter() - t0
+    n_tuples = (n_chunks - 2) * B
+    if obs is not None:
+        obs.registry.stop_clock()
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    res.n_keys = int(K)
+    res.n_shards = int(n_shards)
+    snap = ingestor.snapshot()
+    res.ring_full_events = int(snap["full_events"])
+    res.ring_shed = int(snap["shed"])
+    res.ring_blocks = int(snap["blocks"])
+
+    # in-program mesh comparator at the same geometry
+    p = MeshKeyedPipeline(
+        windows, [make_aggregation(agg_name)], n_keys=K,
+        n_shards=n_shards,
+        config=EngineConfig(capacity=max(128, min(cfg.capacity, 512)),
+                            annex_capacity=8, min_trigger_pad=32),
+        throughput=cfg.throughput, wm_period_ms=cfg.watermark_period_ms,
+        max_lateness=cfg.max_lateness, seed=cfg.seed)
+    p.reset()
+    p.run(2, collect=False)
+    p.sync()
+    best = float("inf")
+    for _ in range(3):
+        t1 = time.perf_counter()
+        p.run(3, collect=False)
+        p.sync()
+        best = min(best, (time.perf_counter() - t1) / 3)
+    p.check_overflow()
+    res.inprogram_tps = p.tuples_per_interval / best
+    res.ring_fed_vs_inprogram = res.tuples_per_sec / max(
+        res.inprogram_tps, 1e-9)
+    try:
+        res.legacy_anchor_tps, res.generator_share_legacy = \
+            _aligned_inprogram_arm(cfg, windows, agg_name, legacy=True)
+    except NotImplementedError as e:
+        res.legacy_anchor_note = f"legacy arm unavailable: {e}"
+    res.platform = jax.devices()[0].platform
+    res.host_cores = _os.cpu_count()
+    finalize_observability(res, obs, [], emitted, n_tuples=n_tuples)
+    return res
+
+
+def run_count_fused_cell(cfg: BenchmarkConfig, window_spec: str,
+                         agg_name: str,
+                         obs: Optional[_obs.Observability] = None
+                         ) -> BenchResult:
+    """Count-measure fused cell with an embedded oracle arm (ISSUE 11):
+    the throughput number is the standard fused-pipeline discipline at
+    the configured ``outOfOrderPct`` (``tuples_per_sec_inorder`` rides
+    alongside from an in-order twin), and a SMALL replica of the same
+    window/lateness geometry is differentially replayed — in-order vs
+    the reference simulator, out-of-order vs the engine's record-merge
+    rank semantics — recording ``oracle_match``/``oracle_windows``.
+    The >= 50 M t/s ROADMAP floor stays a TPU-box certification; the
+    cell records ``platform`` alongside."""
+    import jax
+
+    from ..engine import EngineConfig, TpuWindowOperator
+    from ..engine.count_pipeline import CountStreamPipeline
+    from .. import SlicingWindowOperator
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    econf = EngineConfig(capacity=cfg.capacity, annex_capacity=8,
+                         min_trigger_pad=32,
+                         overflow_policy=cfg.overflow_policy)
+
+    def mk(throughput, ooo, lateness):
+        return CountStreamPipeline(
+            windows, [make_aggregation(agg_name)], config=econf,
+            throughput=throughput, wm_period_ms=cfg.watermark_period_ms,
+            max_lateness=lateness, seed=cfg.seed, out_of_order_pct=ooo,
+            collect_device_metrics=obs is not None)
+
+    p = mk(cfg.throughput, cfg.out_of_order_pct, cfg.max_lateness)
+    res = _run_pipeline_cell(p, cfg, window_spec, agg_name,
+                             "count-fused", obs=obs)
+
+    # in-order comparator twin (best of 3 short segments)
+    p0 = mk(cfg.throughput, 0.0, cfg.max_lateness)
+    p0.reset()
+    p0.run(2, collect=False)
+    p0.sync()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        p0.run(3, collect=False)
+        p0.sync()
+        best = min(best, (time.perf_counter() - t0) / 3)
+    p0.check_overflow()
+    res.tuples_per_sec_inorder = p0.tuples_per_interval / best
+
+    # -- oracle arm: small replica, replayed through the semantics
+    # oracle for its arrival class (simulator in-order, engine OOO)
+    def lowered_rows(agg, fetched, n_iv):
+        sp = agg.device_spec()
+        out = []
+        for i in range(n_iv):
+            ws, we, cnt, resi = fetched[i]
+            rows = [(int(ws[j]), int(we[j]), float(np.asarray(
+                sp.lower(np.asarray(resi[0][j])[None, :],
+                         np.asarray([int(cnt[j])]))[0])))
+                    for j in range(len(ws)) if cnt[j] > 0]
+            out.append(sorted(rows))
+        return out
+
+    def oracle_rows(po, op, n_iv):
+        out = []
+        for i in range(n_iv):
+            vs, ts = po.materialize_interval(i)
+            for v, t in zip(vs, ts):
+                op.process_element(float(v), int(t))
+            out.append(sorted(
+                (w.start, w.end, float(w.agg_values[0]))
+                for w in op.process_watermark(
+                    (i + 1) * po.wm_period_ms)))
+        return out
+
+    agg = make_aggregation(agg_name)
+    oracle_match = True
+    o_windows = 0
+    n_iv = 5
+    for ooo in (0.0, cfg.out_of_order_pct or 0.25):
+        po = mk(2000, ooo, min(cfg.max_lateness,
+                               cfg.watermark_period_ms))
+        fetched = jax.device_get(po.run(n_iv))
+        po.check_overflow()
+        got = lowered_rows(agg, fetched, n_iv)
+        if ooo == 0.0:
+            op = SlicingWindowOperator()
+        else:
+            # record retention spans lateness + the largest count
+            # window's clear delay (ms-mixed, reference parity) at the
+            # oracle's tuple rate — size the record ring above it
+            op = TpuWindowOperator(config=EngineConfig(
+                capacity=1 << 13, batch_size=64, annex_capacity=256,
+                min_trigger_pad=32, record_capacity=1 << 15))
+        for w in windows:
+            op.add_window_assigner(w)
+        op.add_aggregation(make_aggregation(agg_name))
+        op.set_max_lateness(po.max_lateness)
+        ref = oracle_rows(po, op, n_iv)
+        for g_rows, r_rows in zip(got, ref):
+            o_windows += len(r_rows)
+            if [g[:2] for g in g_rows] != [r[:2] for r in r_rows]:
+                oracle_match = False
+                continue
+            for g, r in zip(g_rows, r_rows):
+                if abs(g[2] - r[2]) > 3e-4 * max(1.0, abs(r[2])):
+                    oracle_match = False
+    res.oracle_match = bool(oracle_match)
+    res.oracle_windows = int(o_windows)
+    res.platform = jax.devices()[0].platform
+    res.tpu_floor_note = ("the >= 50 M t/s sliding-count ROADMAP floor "
+                          "is a TPU-box certification; this cell "
+                          f"records platform={res.platform}")
+    return res
+
+
+class _ExactContextOracle:
+    """Arrival-order scalar replay of the session / capped-session
+    calculus — the reference-semantics third leg of the chaos cells'
+    three-way oracle (the capped branch mirrors
+    tests/test_context_windows.py::_ExactCapped; ``cap=None`` is the
+    plain-session specialization, which the tuned engine and the
+    generic SessionDecider both realize)."""
+
+    def __init__(self, gap: int, cap=None):
+        self.gap = int(gap)
+        self.cap = int(cap) if cap is not None else None
+        self.s: list = []          # [first, last, sum] sorted by first
+        self.orphans: list = []    # (pos, value)
+
+    def _fits(self, f, l, t):
+        if self.cap is None:
+            return True
+        return (l - t if f > t else t - f) <= self.cap
+
+    def add(self, v: float, t: int) -> None:
+        g, s = self.gap, self.s
+        exact = declined = False
+        fit_i = -1
+        for i, (f, l, _) in enumerate(s):
+            if f <= t <= l:
+                s[i][2] += v
+                return                      # inside
+            if f - g <= t <= l + g:
+                if t == f - g:
+                    exact = True
+                elif fit_i < 0 and self._fits(f, l, t):
+                    fit_i = i
+                else:
+                    declined = True
+        if fit_i >= 0:
+            f, l, acc = s[fit_i]
+            if t < f:                       # start-extension
+                s[fit_i][0] = t
+                s[fit_i][2] = acc + v
+                if fit_i > 0 and s[fit_i - 1][1] + g >= t \
+                        and (self.cap is None
+                             or l - s[fit_i - 1][0] <= self.cap):
+                    pf, _, pacc = s.pop(fit_i - 1)
+                    s[fit_i - 1][0] = pf
+                    s[fit_i - 1][2] += pacc
+                return
+            s[fit_i][1] = t                 # end-extension
+            s[fit_i][2] = acc + v
+            if fit_i + 1 < len(s) and t + g >= s[fit_i + 1][0] \
+                    and (self.cap is None
+                         or s[fit_i + 1][1] - f <= self.cap):
+                _, nl, nacc = s.pop(fit_i + 1)
+                s[fit_i][1] = nl
+                s[fit_i][2] += nacc
+            return
+        if declined or not exact:
+            k = 0
+            while k < len(s) and s[k][0] <= t:
+                k += 1
+            s.insert(k, [t, t, v])
+            return
+        self.orphans.append((t, v))        # exact-gap fall-through
+
+    def sweep(self, wm: int):
+        out, keep = [], []
+        for f, l, acc in self.s:
+            if l + self.gap < wm:
+                ws, we = f, l + self.gap
+                acc += sum(v for (p, v) in self.orphans if ws <= p < we)
+                self.orphans = [(p, v) for (p, v) in self.orphans
+                                if not (ws <= p < we)]
+                out.append((ws, we, acc))
+            else:
+                keep.append([f, l, acc])
+        self.s = keep
+        return out
+
+
+def _context_chaos_stream(cfg: BenchmarkConfig, gap: int, R: int,
+                          n_pools: int = 16):
+    """Seeded per-interval chaos pools for the context/session cells:
+    ``K`` bursts per watermark interval separated by ``1.5 * gap``
+    silences (so sessions actually CLOSE), an ``outOfOrderPct`` late
+    fraction displaced back by up to the lateness bound (so chunks
+    arrive OOO), and occasional mid-silence BRIDGE tuples delivered
+    late (so live sessions actually MERGE). Returns ``(pools, K)``
+    where ``pools[j] = (vals f32[R'], ts_off i64[R'])`` are
+    interval-relative and cycle by interval index."""
+    P = cfg.watermark_period_ms
+    cycle = min(P, max(4, int(2.5 * gap)))
+    K = max(1, P // cycle)
+    burst = max(1, cycle - int(1.5 * gap))
+    # displacement stays under half the gap so silences survive (late
+    # DEPTH comes from the bridges, delivered up to a full interval
+    # late); merges are driven by the mid-silence bridges, which sit
+    # within gap of BOTH neighboring bursts
+    back = min(cfg.max_lateness, max(1, gap // 2))
+    rng = np.random.default_rng(cfg.seed)
+    per_burst = max(8, R // K)
+    pools = []
+    for _ in range(n_pools):
+        parts_t = []
+        for k in range(K):
+            lo = k * cycle
+            ts = np.sort(rng.integers(lo, lo + burst,
+                                      size=per_burst)).astype(np.int64)
+            parts_t.append(ts)
+        ts = np.concatenate(parts_t)
+        late = rng.random(ts.size) < cfg.out_of_order_pct
+        ts = np.where(late,
+                      np.maximum(ts - rng.integers(0, back, size=ts.size),
+                                 0), ts)
+        # bridges: mid-silence tuples, delivered at the end of the
+        # interval's arrival order — they MERGE the two adjacent live
+        # sessions (silence = 1.5 * gap, so the midpoint is within gap
+        # of both burst edges)
+        bridges = [np.int64(k * cycle - int(0.75 * gap))
+                   for k in range(1, K) if rng.random() < 0.35]
+        if bridges:
+            ts = np.concatenate([ts, np.asarray(bridges, np.int64)])
+        vals = (rng.random(ts.size) * 100.0).astype(np.float32)
+        pools.append((vals, ts))
+    return pools, K
+
+
+def run_context_chaos_cell(cfg: BenchmarkConfig, window_spec: str,
+                           agg_name: str,
+                           obs: Optional[_obs.Observability] = None
+                           ) -> BenchResult:
+    """Context/session chaos cell (ISSUE 11): a seeded host-fed stream
+    that actually GAPS (silent spans close sessions), MERGES (late
+    mid-silence bridges join live sessions) and arrives OUT OF ORDER
+    (bounded back-displacement), through the batch operator's context
+    machinery — the speculative chunked path for specs certifying
+    ``speculation_params`` (GenericSession), the tuned session engine
+    for ``Session``, the per-tuple scan fallback for order-dependent
+    specs (CappedSession).
+
+    Two arms: a throughput arm at the configured offered load
+    (scan-bound window classes scale it down honestly — the recorded
+    row carries the actual tuple count), and a three-way ORACLE arm on
+    a smaller replica of the same stream class: engine vs the
+    per-tuple-scan twin (bit-comparable bounds/pathway equivalence) vs
+    the host reference simulator vs an independent arrival-order
+    scalar replay — ``oracle_match``/``scan_match``/``oracle_windows``
+    land in the result row. Speculative telemetry
+    (``ctx_speculative_*``) rides the metrics section and the
+    ``fallback_rate`` field."""
+    import jax
+
+    from ..core.windows import (CappedSessionWindow, GenericSessionWindow,
+                                SessionWindow)
+    from ..engine import EngineConfig, TpuWindowOperator
+    from .. import SlicingWindowOperator
+
+    if agg_name != "sum":
+        raise NotImplementedError(
+            "ContextChaos cells replay a sum oracle; aggFunctions must "
+            "be ['sum']")
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    if len(windows) != 1 or not isinstance(
+            windows[0], (SessionWindow, GenericSessionWindow,
+                         CappedSessionWindow)):
+        raise NotImplementedError(
+            "ContextChaos cells take exactly one Session / "
+            "GenericSession / CappedSession window")
+    w = windows[0]
+    gap = int(w.gap)
+    cap = int(w.max_span) if isinstance(w, CappedSessionWindow) else None
+    spec = w.device_context_spec()
+    sp = spec.speculation_params() if spec is not None else None
+    if sp is not None and sp.order_free \
+            and not isinstance(w, SessionWindow):
+        scale = 1.0                 # speculative chunked batching
+        mode = "speculative"
+    elif isinstance(w, SessionWindow):
+        scale = 1 / 40              # tuned chain + sequential late scan
+        mode = "session"
+    else:
+        scale = 1 / 150             # per-tuple scan carries the OOO load
+        mode = "scan"
+    P = cfg.watermark_period_ms
+    lateness = cfg.max_lateness
+    R = max(256, int(cfg.throughput * scale))
+    intervals = max(8, cfg.runtime_s)
+
+    def mk_op(batch_size):
+        op = TpuWindowOperator(config=EngineConfig(
+            capacity=max(256, min(cfg.capacity, 1024)), batch_size=batch_size,
+            annex_capacity=64, min_trigger_pad=32))
+        op.add_window_assigner(w)
+        op.add_aggregation(make_aggregation(agg_name))
+        op.set_max_lateness(lateness)
+        return op
+
+    pools, K = _context_chaos_stream(cfg, gap, R)
+    B = 1 << max(10, int(np.ceil(np.log2(max(2, pools[0][1].size)))))
+    op = mk_op(B)
+
+    def feed(i):
+        vals, ts_off = pools[i % len(pools)]
+        op.process_elements(vals, ts_off + np.int64(i) * P)
+        op._flush()
+
+    def wm_of(i):
+        return (i + 1) * P - lateness
+
+    # warmup: compile apply/chunk/sweep kernels. The sync anchor must be
+    # re-read per drain: the context/session kernels DONATE their state
+    # buffers, so a handle bound once would be deleted on TPU and would
+    # return a stale cached host copy (no queue drain) on CPU.
+    def drain():
+        st = (op._ctx_states[0] if op._ctx_states
+              else op._session_states[0])
+        jax.device_get(st.n)
+
+    feed(0)
+    op.process_watermark_async(max(1, wm_of(0)))
+    drain()
+    if obs is not None:
+        op.set_observability(obs)
+        obs.registry.reset_clock()
+    warm_stats = dict(getattr(op, "_ctx_spec_stats", {}) or {})
+
+    pending = []
+    lats = []
+    SAMPLE_EVERY = 8
+    n_tuples = 0
+    t0 = time.perf_counter()
+    for i in range(1, intervals + 1):
+        feed(i)
+        n_tuples += pools[i % len(pools)][1].size
+        sample = i % SAMPLE_EVERY == 0
+        if sample:
+            drain()
+            t1 = time.perf_counter()
+        out = op.process_watermark_async(wm_of(i))
+        ms = tuple(g[0] for g in out[1])
+        pending.append(ms)
+        if sample:
+            jax.device_get(ms)
+            lats.append((time.perf_counter() - t1) * 1e3)
+    drain()
+    wall = time.perf_counter() - t0
+    op.check_overflow()
+    emitted = int(sum(int(m) for grp in jax.device_get(pending)
+                      for m in grp))
+    if obs is not None:
+        obs.registry.stop_clock()
+        op.set_observability(None)
+    stats = dict(getattr(op, "_ctx_spec_stats", {}) or {})
+    for k in stats:
+        stats[k] -= warm_stats.get(k, 0)
+
+    # -- three-way oracle arm on a small replica of the stream class ------
+    ocfg = BenchmarkConfig(
+        name=cfg.name, throughput=max(256, 48 * K), runtime_s=cfg.runtime_s,
+        watermark_period_ms=P, max_lateness=lateness, seed=cfg.seed + 1,
+        out_of_order_pct=cfg.out_of_order_pct)
+    o_pools, _ = _context_chaos_stream(ocfg, gap, ocfg.throughput,
+                                       n_pools=8)
+    o_intervals = max(intervals, 60)
+    eng = mk_op(1024)
+    scan = mk_op(1024)
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(w)
+    sim.add_aggregation(make_aggregation(agg_name))
+    sim.set_max_lateness(lateness)
+    oracle = _ExactContextOracle(gap, cap)
+    oracle_match = scan_match = True
+    o_windows = 0
+    for i in range(o_intervals):
+        vals, ts_off = o_pools[i % len(o_pools)]
+        ts = ts_off + np.int64(i) * P
+        eng.process_elements(vals, ts)
+        eng._flush()
+        if not scan._built:
+            scan._build()
+        scan._ctx_planners = tuple(None for _ in scan._ctx_planners)
+        scan.process_elements(vals, ts)
+        scan._flush()
+        for v, t in zip(vals, ts):
+            sim.process_element(float(v), int(t))
+            oracle.add(float(v), int(t))
+        wm = max(1, wm_of(i))
+        r_e = [x for x in eng.process_watermark(wm)]
+        r_s = [x for x in scan.process_watermark(wm)]
+        r_m = [x for x in sim.process_watermark(wm)]
+        exp = oracle.sweep(wm)
+        o_windows += len(exp)
+        be = [(x.start, x.end) for x in r_e]
+        if be != [(x.start, x.end) for x in r_s]:
+            scan_match = False
+        if be != [(ws, we) for (ws, we, _) in exp] \
+                or be != [(x.get_start(), x.get_end()) for x in r_m]:
+            oracle_match = False
+            continue
+        for x, y, (_, _, acc) in zip(r_e, r_s, exp):
+            xv = float(x.agg_values[0]) if x.has_value() else None
+            yv = float(y.agg_values[0]) if y.has_value() else None
+            if (xv is None) != (yv is None) or (
+                    xv is not None
+                    and abs(xv - yv) > 1e-4 * max(1.0, abs(yv))):
+                scan_match = False
+            if xv is not None \
+                    and abs(xv - acc) > 1e-3 * max(1.0, abs(acc)):
+                oracle_match = False
+    eng.check_overflow()
+    scan.check_overflow()
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    res.n_lat_samples = len(lats)
+    for k, v in latency_stats(lats).items():
+        setattr(res, k, v)
+    res.emit_ms_device = wall / intervals * 1e3
+    res.context_mode = mode
+    res.oracle_match = bool(oracle_match)
+    res.scan_match = bool(scan_match)
+    res.oracle_windows = int(o_windows)
+    total = stats.get("speculative_tuples", 0) \
+        + stats.get("fallback_tuples", 0)
+    res.ctx_speculative_tuples = int(stats.get("speculative_tuples", 0))
+    res.ctx_fallback_tuples = int(stats.get("fallback_tuples", 0))
+    res.ctx_fallback_runs = int(stats.get("fallback_runs", 0))
+    res.ctx_fallback_rate = (stats.get("fallback_tuples", 0) / total
+                             if total else 0.0)
+    res.platform = jax.devices()[0].platform
     finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
     return res
 
@@ -1679,6 +2461,16 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                               "serving_rejected", "serving_cache_hits",
                               "churn_ops", "throughput_static",
                               "throughput_delta_pct", "oracle_match",
+                              "scan_match", "oracle_windows",
+                              "tuples_per_sec_inorder",
+                              "inprogram_tps", "generator_share",
+                              "legacy_anchor_tps",
+                              "generator_share_legacy",
+                              "legacy_anchor_note",
+                              "ring_fed_vs_inprogram",
+                              "context_mode", "ctx_speculative_tuples",
+                              "ctx_fallback_tuples", "ctx_fallback_runs",
+                              "ctx_fallback_rate",
                               "churn_schedule", "churn_seed",
                               "ring_occupancy_p50", "ring_occupancy_p90",
                               "ring_occupancy_p99",

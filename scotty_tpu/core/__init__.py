@@ -13,6 +13,7 @@ from .windows import (
     TumblingWindow,
     SlidingWindow,
     CappedSessionWindow,
+    GenericSessionWindow,
     SessionWindow,
     FixedBandWindow,
     WindowContext,
@@ -45,7 +46,7 @@ from .time_measure import TimeMeasure
 __all__ = [
     "Window", "WindowMeasure", "TIME", "COUNT",
     "ContextFreeWindow", "ForwardContextAware", "ForwardContextFree",
-    "TumblingWindow", "SlidingWindow", "CappedSessionWindow", "SessionWindow", "FixedBandWindow",
+    "TumblingWindow", "SlidingWindow", "CappedSessionWindow", "GenericSessionWindow", "SessionWindow", "FixedBandWindow",
     "WindowContext", "ActiveWindow", "TupleContext",
     "AddModification", "DeleteModification", "ShiftModification",
     "AggregateFunction", "CommutativeAggregateFunction", "ReduceAggregateFunction",

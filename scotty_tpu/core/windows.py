@@ -630,3 +630,31 @@ class CappedSessionWindow(ForwardContextAware):
     def __str__(self) -> str:
         return (f"CappedSessionWindow{{measure={self.measure.value}, "
                 f"gap={self.gap}, maxSpan={self.max_span}}}")
+
+
+@dataclass(frozen=True)
+class GenericSessionWindow(ForwardContextAware):
+    """Plain gap sessions expressed through the GENERIC context contract
+    (ISSUE 11): semantically identical to :class:`SessionWindow`, but
+    deliberately NOT a ``SessionWindow`` subclass, so the device engine
+    routes it through the generic ``DeviceContextSpec`` machinery
+    (engine/context.py) instead of the tuned session arrays — the
+    coherence window for the generic path's differential suites, and the
+    shipped example of an ``order_free`` speculation certification
+    (:class:`scotty_tpu.engine.context.SpeculationCert`). The host face
+    reuses the reference session calculus verbatim."""
+
+    measure: WindowMeasure
+    gap: int
+
+    def create_context(self) -> "SessionWindow.SessionContext":
+        return SessionWindow.SessionContext(self.gap, self.measure)
+
+    def device_context_spec(self):
+        from ..engine.context import SessionDecider
+
+        return SessionDecider(self.gap)
+
+    def __str__(self) -> str:
+        return (f"GenericSessionWindow{{measure={self.measure.value}, "
+                f"gap={self.gap}}}")

@@ -33,7 +33,9 @@ round-trips.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +43,35 @@ import jax.numpy as jnp
 from ..core.aggregates import DeviceAggregateSpec
 from .core import I64_MAX, I64_MIN
 from .sessions import SessionState, init_session_state  # noqa: F401 (re-export)
+
+
+class SpeculationCert(NamedTuple):
+    """Certification enabling the speculative chunked fast path
+    (:class:`SpeculativePlanner`) for a chain-certified context spec
+    (``inorder_chain_params() is not None``). The implementor certifies:
+
+    * ``reach`` — the interaction bound: two tuples (or a tuple and a
+      live row edge) farther than ``reach`` apart in event time can
+      never influence each other's ``decide`` outcomes, directly or
+      through any window either of them touches (sessions: the gap —
+      windows only ever span observed tuple extents, so reach composes).
+    * ``order_free`` — when True, an ISOLATED set of tuples (no two
+      members, and no member and live-row edge, farther apart than
+      ``reach``-connected hulls allow) produces the same final active
+      arrays under every arrival order, EXCEPT at exact-``reach``
+      start-side collisions (the orphan fall-through) — which the
+      planner detects per tuple against the actual arrival order and
+      routes to the scan. Plain sessions qualify; the capped calculus
+      does not (a cap-decline's split point depends on arrival order),
+      so capped specs set False and only arrival-sorted components take
+      the fast path.
+    * ``trigger_done`` is exactly ``last + reach < wm`` per live row
+      (both shipped deciders) — the planner's host mirror prunes rows
+      on that rule, in lockstep with the device sweep.
+    """
+
+    reach: int
+    order_free: bool
 
 
 class ContextDecision(NamedTuple):
@@ -119,6 +150,18 @@ class DeviceContextSpec:
         contract, pinned by the differential tests."""
         return None
 
+    def speculation_params(self) -> Optional[SpeculationCert]:
+        """Optional speculative chunked batching over OUT-OF-ORDER
+        chunks (ISSUE 11): return a :class:`SpeculationCert` to let the
+        operator sort each chunk, segment it where ``decide`` provably
+        cannot interact across the cut (consecutive sorted timestamps
+        more than ``reach`` apart), execute safe segment runs as one
+        vectorized chunk-kernel dispatch, and fall back to the
+        per-tuple scan only for segments the safety guards reject.
+        Requires ``inorder_chain_params()``; None (default) keeps OOO
+        chunks on the sequential scan."""
+        return None
+
 
 class SessionDecider(DeviceContextSpec):
     """SessionWindow's calculus through the generic contract — the
@@ -177,6 +220,13 @@ class SessionDecider(DeviceContextSpec):
         # sorted streams only ever extend the newest session or open a
         # new one after a gap — the uncapped chain
         return (self.gap, None)
+
+    def speculation_params(self):
+        # the session calculus is arrival-order free within an isolated
+        # gap-connected component (merging is confluent, aggregates
+        # commute) except at exact-gap start-side collisions — which the
+        # planner detects per tuple and routes to the scan
+        return SpeculationCert(reach=self.gap, order_free=True)
 
 
 class CappedSessionDecider(DeviceContextSpec):
@@ -261,6 +311,340 @@ class CappedSessionDecider(DeviceContextSpec):
         # (older rows can never fit when the newest declines — their
         # spans are larger and their reach smaller)
         return (self.gap, self.max_span)
+
+    def speculation_params(self):
+        # NOT order-free: a cap-decline's split point depends on arrival
+        # order (the same isolated set partitions differently under
+        # different orders), so only arrival-sorted components batch
+        return SpeculationCert(reach=self.gap, order_free=False)
+
+
+class SpeculativePlanner:
+    """Host-side segmentation + safety classifier for ONE context
+    window's speculative chunked batching (ISSUE 11).
+
+    The planner sorts each arrival-order chunk, cuts it into
+    interaction components (consecutive sorted timestamps more than
+    ``reach`` apart never interact — :class:`SpeculationCert`), proves
+    per component that executing it SORTED through the vectorized
+    chain kernel (:func:`build_context_chunk`) is equivalent to the
+    per-tuple arrival-order scan, and returns a run plan: maximal
+    stretches of safe components as single chunk-kernel dispatches,
+    unsafe components through the scan in exact arrival order.
+
+    Safety rests on a host mirror of the live-row BOUNDS (first/last
+    only — values stay on device) that the planner maintains from the
+    same inputs the device kernels consume:
+
+    * chunk runs update the mirror through the exact host replay of the
+      chain-kernel walk (:meth:`note_chunk`);
+    * per-tuple scan runs make the affected region UNKNOWN: rows with
+      ``first <= V`` (``V`` = scanned max + reach — the first-edge
+      blast radius) move to a stale set summarized by ``U``, an upper
+      bound on every unknown row's ``last`` (scan extensions are
+      bounded by the scanned max, so ``U`` stays sound);
+    * sweeps prune mirrored rows by the certified trigger rule
+      (``last + reach < wm``) and clear the stale region once the
+      watermark passes ``U + reach`` (every unknown row has completed
+      by then).
+
+    A component is CHUNK-safe iff, against the pre-batch mirror:
+
+    * it cannot touch the stale region (``lo > U + reach``);
+    * it cannot touch any known non-top row (``lo > l_second +
+      reach``; rows are disjoint and ordered, so the second-newest
+      ``last`` bounds them all);
+    * if it touches the known top row (``lo <= l_top + reach``) it is
+      the FIRST such component, starts inside it (``lo >= f_top`` —
+      the chunk kernel never extends a row's start), and no OTHER
+      component also touches the top (two components interacting
+      through a wide row interact with each other);
+    * ``order_free`` specs: no tuple is exposed to the exact-``reach``
+      start-side orphan collision under the ACTUAL arrival order (a
+      tuple whose exact partner arrived first, with no other in-reach
+      tuple or the top row arriving before it);
+    * non-``order_free`` specs (capped): the component's arrival order
+      is already sorted, so the chunk kernel is the certified in-order
+      chain on that stretch.
+    """
+
+    #: mirror of build_context_chunk's default segment budget
+    MAX_SEGMENTS = 64
+
+    def __init__(self, spec: DeviceContextSpec):
+        cert = spec.speculation_params()
+        chain = spec.inorder_chain_params()
+        if cert is None or chain is None:
+            raise ValueError(
+                "SpeculativePlanner needs speculation_params() AND "
+                "inorder_chain_params() certifications")
+        self.reach = int(cert.reach)
+        self.order_free = bool(cert.order_free)
+        self.gap = int(chain[0])
+        self.cap = None if chain[1] is None else int(chain[1])
+        if self.reach != self.gap:
+            # the component cut doubles as the chain's gap break (a
+            # component has no internal break), which needs reach==gap
+            raise ValueError(
+                "speculation reach must equal the chain gap "
+                f"(reach={self.reach}, gap={self.gap})")
+        self.first = np.empty(0, np.int64)     # known live-row bounds
+        self.last = np.empty(0, np.int64)      # (sorted by first)
+        self.stale_u: Optional[int] = None     # unknown-row last bound
+
+    # -- classification ----------------------------------------------------
+    def plan(self, tss: np.ndarray):
+        """Runs for one arrival-order chunk: ``[("chunk"|"scan",
+        idx_array)]`` where chunk indices are ts-sorted and scan indices
+        are in arrival order. Components never interact, so processing
+        runs in sorted-component order preserves arrival semantics."""
+        n = int(tss.size)
+        if n == 0:
+            return []
+        r = self.reach
+        order = np.argsort(tss, kind="stable")
+        ts_s = tss[order]
+        cuts = np.flatnonzero(np.diff(ts_s) > r) + 1
+        bounds = np.concatenate(([0], cuts, [n]))
+        kf, kl = self.first, self.last
+        f_top = int(kf[-1]) if kf.size else None
+        l_top = int(kl[-1]) if kl.size else None
+        l_second = int(kl[-2]) if kf.size > 1 else None
+        U = self.stale_u
+
+        comps = list(zip(bounds[:-1], bounds[1:]))
+        # components touching the known top row form a PREFIX (sorted);
+        # two of them interact THROUGH the top row, so only a lone
+        # top-toucher may batch
+        n_top = 0
+        if l_top is not None:
+            while n_top < len(comps) \
+                    and int(ts_s[comps[n_top][0]]) <= l_top + r:
+                n_top += 1
+        safe_flags = []
+        for ci, (a, b) in enumerate(comps):
+            lo = int(ts_s[a])
+            safe = True
+            if U is not None and lo <= U + r:
+                safe = False
+            elif l_second is not None and lo <= l_second + r:
+                safe = False
+            elif ci < n_top and (n_top > 1 or lo < f_top):
+                # (components beyond the top-zone prefix always have
+                # lo > l_top + reach >= f_top, so start containment
+                # only binds here)
+                safe = False
+            if safe and not self.order_free:
+                oa = order[a:b]
+                if oa.size > 1 and not bool((oa[:-1] < oa[1:]).all()):
+                    safe = False
+            if safe and self.order_free \
+                    and self._orphan_hazard(ts_s, order, int(a), int(b),
+                                            l_top):
+                safe = False
+            safe_flags.append(safe)
+
+        runs = []
+        i = 0
+        while i < len(comps):
+            if safe_flags[i]:
+                j = i
+                while j + 1 < len(comps) and safe_flags[j + 1]:
+                    j += 1
+                runs.append(("chunk",
+                             order[comps[i][0]:comps[j][1]]))
+                i = j + 1
+            else:
+                # interacting unsafe components (the multi-top prefix)
+                # must replay INTERLEAVED in arrival order; isolated
+                # unsafe components may too — coalescing adjacent scan
+                # components is always arrival-faithful
+                j = i
+                while j + 1 < len(comps) and not safe_flags[j + 1]:
+                    j += 1
+                idx = np.sort(
+                    np.concatenate([order[a:b]
+                                    for (a, b) in comps[i:j + 1]]))
+                runs.append(("scan", idx))
+                i = j + 1
+        return runs
+
+    @staticmethod
+    def _range_min(vals: np.ndarray, lo: np.ndarray,
+                   hi: np.ndarray) -> np.ndarray:
+        """min(vals[lo[i]:hi[i]]) per element (sentinel I64_MAX for
+        empty ranges) — a log sparse table, so the hazard check stays
+        O(n log n) on dense chunks instead of a per-candidate probe."""
+        n = int(vals.size)
+        out = np.full(lo.shape, np.iinfo(np.int64).max, np.int64)
+        width = hi - lo
+        m = width > 0
+        if n == 0 or not bool(m.any()):
+            return out
+        levels = [vals.astype(np.int64)]
+        while (1 << len(levels)) <= int(width.max()):
+            half = 1 << (len(levels) - 1)
+            prev = levels[-1]
+            nxt = prev.copy()
+            if n > half:
+                nxt[:n - half] = np.minimum(prev[:n - half], prev[half:])
+            levels.append(nxt)
+        j = np.zeros(lo.shape, np.int64)
+        j[m] = np.floor(np.log2(width[m])).astype(np.int64)
+        for lev in np.unique(j[m]):
+            sel = m & (j == lev)
+            t = levels[int(lev)]
+            a = lo[sel]
+            b = hi[sel] - (1 << int(lev))
+            out[sel] = np.minimum(t[a], t[np.maximum(b, a)])
+        return out
+
+    def _orphan_hazard(self, ts_s, order, a: int, b: int,
+                       l_top) -> bool:
+        """Exact-``reach`` start-side collision under the ACTUAL arrival
+        order: tuple p orphans iff a row starting exactly at
+        ``p + reach`` exists at p's arrival with nothing else in reach —
+        i.e. p's exact partner arrived first AND no tuple in
+        ``[p - reach, p + reach)`` (whose row would touch p) nor the
+        live top row (``p <= l_top + reach`` — p >= f_top, so reach is
+        touch) precedes p.
+
+        Cost model: dense ms streams have an exact partner for nearly
+        EVERY tuple, so the check must not walk candidates one by one.
+        An O(n) prefilter settles almost all of them — a sorted
+        NEIGHBOR inside the reach window that arrived earlier makes p
+        safe, and on mostly-in-order traffic (the late fraction sits
+        among earlier-arrived in-order tuples) that covers everything.
+        Survivors go through an exact per-candidate probe; a
+        pathological candidate count (fully shuffled arrival) switches
+        to the O(n log n) sparse-table evaluation instead."""
+        r = self.reach
+        seg = ts_s[a:b]
+        n = seg.size
+        oa = order[a:b].astype(np.int64)
+        safe = np.zeros(n, bool)
+        if n > 1:
+            prev_in = np.concatenate(([False], np.diff(seg) <= r))
+            prev_early = np.concatenate(([False], oa[:-1] < oa[1:]))
+            nxt_in = np.concatenate((np.diff(seg) < r, [False]))
+            nxt_early = np.concatenate((oa[1:] < oa[:-1], [False]))
+            safe = (prev_in & prev_early) | (nxt_in & nxt_early)
+        if l_top is not None:
+            safe |= seg <= l_top + r       # the live top row touches p
+        ci = np.flatnonzero(~safe)
+        if ci.size == 0:
+            return False
+        pv = seg[ci] + r
+        p_lo = np.searchsorted(seg, pv, side="left")
+        has = (p_lo < n) & (seg[np.minimum(p_lo, n - 1)] == pv)
+        ci, p_lo = ci[has], p_lo[has]
+        if ci.size == 0:
+            return False
+        if ci.size > 4096:
+            # adversarially shuffled arrival: evaluate exactly, shared
+            # sparse table over the arrival ranks
+            p_hi = np.searchsorted(seg, seg + r, side="right")
+            pl_f = np.searchsorted(seg, seg + r, side="left")
+            w_lo = np.searchsorted(seg, seg - r, side="left")
+            partner_min = self._range_min(oa, pl_f, p_hi)
+            window_min = self._range_min(oa, w_lo, pl_f)
+            hazard = np.zeros(n, bool)
+            hazard[ci] = True
+            hazard &= (partner_min < oa) & (window_min >= oa)
+            return bool(hazard.any())
+        for k, i in enumerate(ci):
+            t = int(seg[i])
+            lo_p = int(p_lo[k])
+            hi_p = int(np.searchsorted(seg, t + r, side="right"))
+            if int(oa[lo_p:hi_p].min()) > int(oa[i]):
+                continue                   # partner row not yet open
+            w = int(np.searchsorted(seg, t - r, side="left"))
+            if w < lo_p and int(oa[w:lo_p].min()) < int(oa[i]):
+                continue                   # an in-reach row precedes p
+            return True
+        return False
+
+    # -- mirror maintenance ------------------------------------------------
+    def note_chunk(self, ts_sorted: np.ndarray) -> None:
+        """Exact host replay of the chain-kernel walk
+        (:func:`build_context_chunk`) over one sorted chunk run."""
+        ts = np.asarray(ts_sorted, np.int64)
+        n = int(ts.size)
+        if n == 0:
+            return
+        g, cap, M = self.gap, self.cap, self.MAX_SEGMENTS
+        kf, kl = self.first, self.last
+        cont = bool(kf.size) and int(ts[0]) <= int(kl[-1]) + g
+        if cont and cap is not None:
+            cont = int(ts[0]) - int(kf[-1]) <= cap
+        brk = np.flatnonzero(np.diff(ts) > g) + 1
+        anchor = int(kf[-1]) if cont else int(ts[0])
+        segs = []
+        cur = 0
+        bi = 0
+        while cur < n and len(segs) < M:
+            while bi < brk.size and int(brk[bi]) <= cur:
+                bi += 1
+            nb = int(brk[bi]) if bi < brk.size else n
+            capi = n if cap is None else int(
+                np.searchsorted(ts, anchor + cap, side="right"))
+            nxt = max(min(nb, capi, n), cur + 1)
+            segs.append((cur, nxt))
+            anchor = int(ts[min(nxt, n - 1)])
+            cur = nxt
+        seg_first = [int(ts[s]) for s, _ in segs]
+        seg_last = [int(ts[e - 1]) for _, e in segs]
+        start = 0
+        if cont and segs:
+            kl[-1] = max(int(kl[-1]), seg_last[0])
+            start = 1
+        if len(segs) > start:
+            self.first = np.concatenate([kf, seg_first[start:]])
+            self.last = np.concatenate([kl, seg_last[start:]])
+
+    def note_scan(self, tss: np.ndarray) -> None:
+        """A per-tuple scan ran: rows with ``first <= scanned max +
+        reach`` become unknown (their firsts may drop, new rows may
+        appear below); ``U`` bounds every unknown row's last."""
+        if tss.size == 0:
+            return
+        mx = int(np.max(tss))
+        v = mx + self.reach
+        moved = self.first <= v
+        u = mx if self.stale_u is None else max(self.stale_u, mx)
+        if bool(moved.any()):
+            u = max(u, int(self.last[moved].max()))
+            keep = ~moved
+            self.first = self.first[keep]
+            self.last = self.last[keep]
+        self.stale_u = u
+
+    def invalidate(self, met) -> None:
+        """Host-opaque state change (device-resident ingest, checkpoint
+        restore): every row whose edges could sit at/below ``met``
+        becomes unknown."""
+        if met is None and self.first.size == 0 \
+                and self.stale_u is None:
+            return
+        u = int(met) if met is not None else 0
+        if self.first.size:
+            u = max(u, int(self.last.max()))
+        if self.stale_u is not None:
+            u = max(u, self.stale_u)
+        self.first = np.empty(0, np.int64)
+        self.last = np.empty(0, np.int64)
+        self.stale_u = u
+
+    def sweep(self, wm: int) -> None:
+        """Mirror the device sweep: certified trigger rule per known
+        row; the stale region clears once every unknown row has
+        provably completed."""
+        if self.first.size:
+            keep = self.last + self.reach >= wm
+            self.first = self.first[keep]
+            self.last = self.last[keep]
+        if self.stale_u is not None and self.stale_u + self.reach < wm:
+            self.stale_u = None
 
 
 def build_context_apply(aggs: tuple[DeviceAggregateSpec, ...],

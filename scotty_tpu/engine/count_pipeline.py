@@ -101,10 +101,13 @@ class CountRowState(NamedTuple):
 
 
 class CountStreamPipeline(FusedPipelineDriver):
-    """Fused count-measure benchmark pipeline (count tumbling windows,
-    optionally mixed with time tumbling/sliding windows), in-order and
-    out-of-order. One XLA dispatch per watermark interval; no host sync
-    anywhere in the steady state."""
+    """Fused count-measure benchmark pipeline (count tumbling AND
+    sliding windows — rank ranges answer arbitrary ``[a, b)``, so the
+    slide cadence is just a denser trigger grid — optionally mixed with
+    time tumbling/sliding windows), in-order and out-of-order down to
+    sub-period lateness (``max_lateness < wm_period`` rides a partial
+    oldest stratum, ISSUE 11). One XLA dispatch per watermark interval;
+    no host sync anywhere in the steady state."""
 
     _uses_device_metrics = True
 
@@ -136,19 +139,38 @@ class CountStreamPipeline(FusedPipelineDriver):
         count_windows, time_windows = [], []
         for w in self.windows:
             if w.measure == WindowMeasure.Count:
-                if not isinstance(w, TumblingWindow):
+                if isinstance(w, SlidingWindow):
+                    # sliding count windows: the rank-range layout
+                    # already answers arbitrary [a, b) partial ranges,
+                    # so the slide cadence is just a denser trigger
+                    # enumeration (ISSUE 11). The kind tag stays
+                    # explicit: SlidingWindow(c, c) keeps the sliding
+                    # walk's end <= cend+2 guard, it does NOT collapse
+                    # into the tumbling enumeration.
+                    count_windows.append((int(w.size), int(w.slide), "s"))
+                elif isinstance(w, TumblingWindow):
+                    count_windows.append((int(w.size), int(w.size), "t"))
+                else:
                     raise NotImplementedError(
-                        "count pipeline: count-tumbling windows only")
-                count_windows.append(w)
+                        "count pipeline: count-measure windows must be "
+                        "rank-range realizable — CountTumbling "
+                        "(TumblingWindow) and CountSliding "
+                        "(SlidingWindow, the sliding-count entry point) "
+                        f"are supported; {type(w).__name__} is not "
+                        "(count-measure sessions/bands ride the host "
+                        "SlicingWindowOperator)")
             elif isinstance(w, (TumblingWindow, SlidingWindow)):
                 time_windows.append(w)
             else:
                 raise NotImplementedError(
                     f"count pipeline: {type(w).__name__} has no rank-range "
-                    "realization")
+                    "realization (supported: CountTumbling/CountSliding "
+                    "rank ranges, optionally mixed with time-measure "
+                    "Tumbling/Sliding grids)")
         if not count_windows:
             raise NotImplementedError(
-                "count pipeline: needs >= 1 count-measure window (use "
+                "count pipeline: needs >= 1 count-measure window — "
+                "CountTumbling(size) or CountSliding(size,slide) — (use "
                 "AlignedStreamPipeline for pure time grids)")
         specs = [a.device_spec() for a in self.aggregations]
         if any(s is None or s.is_sparse for s in specs):
@@ -164,32 +186,41 @@ class CountStreamPipeline(FusedPipelineDriver):
                 "1000); the batch operator covers trickle rates")
         SR = u * P
         lateness = self.max_lateness
-        q = lateness // P                      # late reach in intervals
         L_req = int(SR * self.out_of_order_pct)
-        if L_req and q < 1:
+        if L_req and lateness < 1:
             raise NotImplementedError(
-                "count pipeline: out-of-order needs max_lateness >= the "
-                "watermark period (sub-interval lateness rides the batch "
-                "operator)")
-        span = q * P                           # late rows per interval
-                                               # (<= lateness: stratified
-                                               # lates are never older
-                                               # than the contract allows)
-        E = -(-L_req // span) if L_req else 0  # late appends per row
-        L = E * span
-        q = q if E else 0
-        self.R_total = SR + L                  # steady-state (i >= q)
-        self.SR, self.L, self.E, self.q, self.u = SR, L, E, q, u
+                "count pipeline: out-of-order needs max_lateness >= 1 ms "
+                "(the stratified late model spreads the late load over "
+                "the lateness span)")
+        # Late span = the FULL lateness contract in ms rows (ISSUE 11:
+        # previously floored to whole watermark periods, which rejected
+        # max_lateness < wm_period outright). The span splits into
+        # q_full whole-period strata plus one PARTIAL oldest stratum of
+        # ``rem`` rows — its append is a masked block write, and every
+        # closed form below counts bands per row instead of whole
+        # periods. Relaxed retention (rem != 0) is surfaced through the
+        # gated ``count_lateness_relaxed_rows`` counter.
+        sc = lateness if L_req else 0          # late span in ms rows
+        E = -(-L_req // sc) if L_req else 0    # late appends per row
+        L = E * sc
+        sc = sc if E else 0
+        q_full = sc // P                       # whole-period strata
+        rem = sc % P                           # partial-stratum rows
+        qc = q_full + (1 if rem else 0)        # strata per interval
+        self.R_total = SR + L                  # steady-state (i >= qc)
+        self.SR, self.L, self.E, self.u = SR, L, E, u
+        self.q, self.q_full, self.rem, self.sc = qc, q_full, rem, sc
+        q = qc
         self.tuples_per_interval = self.R_total
         self.n_late = L
-        cap = u + E * q                        # exact row capacity
+        cap = u + E * qc                       # exact row capacity
 
         # Row-window coverage: deepest ms any trigger can reach below the
         # watermark — count windows reach c_max + R_total ranks
         # (≈ that many / u ms), time windows reach t_max ms, late appends
         # reach `lateness` ms. W is a multiple of P so an interval's row
         # block never straddles the ring seam.
-        c_max = max(int(w.size) for w in count_windows)
+        c_max = max(c for (c, _, _) in count_windows)
         t_max = max([int(w.size) for w in time_windows], default=0)
         need = max(t_max, -(-(c_max + self.R_total) // u)) \
             + (lateness if E else 0) + 2 * P
@@ -198,9 +229,14 @@ class CountStreamPipeline(FusedPipelineDriver):
         self.row_capacity = cap
 
         # -- trigger layout: count windows first, then the time grid ------
-        count_layout = [(int(w.size), self.R_total // int(w.size) + 2)
-                        for w in count_windows]
-        Tc = sum(k for _, k in count_layout)
+        # tumbling: the end-grid walk (size == slide); sliding: the
+        # start-grid walk needs head-room for the reference's negative
+        # leading starts (guarded out by starts >= 0)
+        count_layout = [
+            (c, s, (self.R_total // c + 2) if kind == "t"
+             else ((self.R_total + c) // s + 3), kind)
+            for (c, s, kind) in count_windows]
+        Tc = sum(k for _, _, k, _ in count_layout)
         if time_windows:
             make_time_triggers, Tt = build_trigger_grid(time_windows, P)
         else:
@@ -224,19 +260,24 @@ class CountStreamPipeline(FusedPipelineDriver):
 
         # -- closed-form arrival accounting --------------------------------
         def late_of(k):
-            """Late lanes of interval k (early intervals have fewer prior
-            rows to stratify over)."""
-            return E * P * jnp.minimum(jnp.maximum(k, 0), q) if E else 0
+            """Late lanes of interval k = E per live band row; interval
+            k's band is [kP - sc, kP) clipped at the stream start, so
+            its row count is min(sc, kP) (early intervals have fewer
+            prior rows to stratify over)."""
+            return E * jnp.minimum(jnp.maximum(k, 0) * P, sc) if E else 0
 
         def arrived_before(k):
-            """Total arrivals of intervals [0, k)."""
+            """Total arrivals of intervals [0, k): the in-order pace
+            plus E * sum_{j<k} min(sc, jP) — a triangular ramp over the
+            first q_full intervals, then sc per interval."""
             k = jnp.maximum(k, 0)
             if not E:
                 return k * SR
-            m = jnp.minimum(k, q)
-            tri = m * (m - 1) // 2
-            full = q * jnp.maximum(k - q, 0)
-            return k * SR + E * P * (tri + full)
+            n = jnp.maximum(k - 1, 0)
+            m = jnp.minimum(n, q_full)
+            tri = m * (m + 1) // 2
+            extra = sc * jnp.maximum(n - q_full, 0)
+            return k * SR + E * (P * tri + extra)
 
         def c_cut(e, N_i):
             """Arrival-cut rank of time edge ``e`` (see module docstring):
@@ -286,19 +327,20 @@ class CountStreamPipeline(FusedPipelineDriver):
                 if E:
                     for a in range(1, q + 1):
                         ok = base - a * P >= 0
+                        rows_lo = P - rem if (rem and a == q) else 0
                         ages = (jnp.int64(a) * P - 1
                                 - jnp.arange(P, dtype=jnp.int64))
-                        m = ok & (ages > 0)
+                        m = ok & (ages > 0) \
+                            & (jnp.arange(P) >= rows_lo)
                         dm = _dev.record_late_ages(dm, ages, m,
                                                    weight=jnp.int64(E))
                         dm = dm._replace(
                             late=dm.late + E * jnp.sum(m.astype(jnp.int64)))
                         n_late_rows = n_late_rows \
-                            + jnp.where(ok, jnp.int64(P), 0)
+                            + jnp.where(ok, jnp.int64(P - rows_lo), 0)
                 dm = dm._replace(
                     ingested=dm.ingested + jnp.int64(SR)
-                    + (E * P * jnp.minimum(jnp.maximum(i, 0), q)
-                       if E else 0),
+                    + late_of(i),
                     slices_touched=dm.slices_touched + jnp.int64(P)
                     + n_late_rows)
 
@@ -316,10 +358,14 @@ class CountStreamPipeline(FusedPipelineDriver):
                     (slot, jnp.int32(0)))
 
             # 2. late appends: one fixed-column [P, E] block per age
+            # (the PARTIAL oldest stratum — rem != 0, a == q — masks its
+            # leading P - rem rows: they sit below the lateness span)
             if E:
                 for a in range(1, q + 1):
                     tgt = base - a * P
                     ok = tgt >= 0
+                    rows_lo = P - rem if (rem and a == q) else 0
+                    rmask = ok & (jnp.arange(P) >= rows_lo)
                     slot_a = jnp.mod(jnp.maximum(tgt, 0),
                                      W).astype(jnp.int32)
                     lv = gen_late(key, i, a)                 # [P, E]
@@ -327,7 +373,8 @@ class CountStreamPipeline(FusedPipelineDriver):
                     cur = jax.lax.dynamic_slice(rows, (slot_a, col),
                                                 (P, E))
                     rows = jax.lax.dynamic_update_slice(
-                        rows, jnp.where(ok, lv, cur), (slot_a, col))
+                        rows, jnp.where(rmask[:, None], lv, cur),
+                        (slot_a, col))
                     for ai, sp in enumerate(specs):
                         wdt = row_aggs[ai].shape[1]
                         cur_a = jax.lax.dynamic_slice(
@@ -338,19 +385,25 @@ class CountStreamPipeline(FusedPipelineDriver):
                         else:
                             comb = red[sp.kind](cur_a, upd)
                         row_aggs[ai] = jax.lax.dynamic_update_slice(
-                            row_aggs[ai], jnp.where(ok, comb, cur_a),
+                            row_aggs[ai],
+                            jnp.where(rmask[:, None], comb, cur_a),
                             (slot_a, jnp.int32(0)))
 
             # 3. per-row counts of the retained window, in ms order —
-            # closed form: row of ms m holds u + E*clip(i - m//P, 0, q)
-            # (0 for m < 0)
+            # closed form: row of ms m holds u + E x (elapsed bands
+            # containing m), where row m sits in the late band of
+            # intervals (m/P, (m+sc)/P] — whole periods plus the
+            # partial oldest stratum (0 for m < 0)
             shift = rowstart_slot(base + P)
             ms = (base + P - W) + jnp.arange(W, dtype=jnp.int64)  # ms order
             kk = ms // P
-            cnt_row = jnp.where(
-                ms >= 0,
-                u + (E * jnp.clip(i - kk, 0, q) if E else 0),
-                0).astype(jnp.int64)
+            if E:
+                bands = jnp.clip(
+                    jnp.minimum(i, (ms + sc) // P) - kk, 0, q)
+                cnt_row = jnp.where(ms >= 0, u + E * bands,
+                                    0).astype(jnp.int64)
+            else:
+                cnt_row = jnp.where(ms >= 0, u, 0).astype(jnp.int64)
             prefix = jnp.concatenate(
                 [jnp.zeros((1,), jnp.int64), jnp.cumsum(cnt_row)])
             base_rank = N_i - prefix[-1]       # global rank of ms-order 0
@@ -363,13 +416,40 @@ class CountStreamPipeline(FusedPipelineDriver):
 
             # -- triggers --------------------------------------------------
             ws_parts, we_parts, ok_parts, cw_parts = [], [], [], []
-            for (c, maxk) in count_layout:
-                last_start = (N_prev // c) * c
-                ends = last_start + c * (1 + jnp.arange(maxk,
-                                                        dtype=jnp.int64))
-                ok = ends <= N_i + 1           # the reference's cend+1
-                ws_parts.append(ends - c)
+            wr_parts = []                # rank-range end basis per row
+            for (c, s, maxk, kind) in count_layout:
+                if kind == "t":
+                    # tumbling: end-grid walk (TumblingWindow.java:34-39
+                    # over counts)
+                    last_start = (N_prev // c) * c
+                    ends = last_start + c * (1 + jnp.arange(
+                        maxk, dtype=jnp.int64))
+                    ok = ends <= N_i + 1       # the reference's cend+1
+                    ws = ends - c
+                    we_rank = ends
+                else:
+                    # sliding: start-grid walk (SlidingWindow.java:50-57
+                    # over counts, via trigger_arrays(last_count,
+                    # cend+1)): starts on the slide grid with
+                    # end > last_count, guarded start >= 0 and
+                    # end <= (cend+1)+1 — the doubled "+1" is the
+                    # reference's sliding end <= wm+1 quirk applied to
+                    # the count bound. Values are SLICE-GRANULAR when
+                    # size % slide != 0: count cuts land only on the
+                    # slide grid, so the reference aggregates the whole
+                    # slices inside the window — ranks [ws, ws +
+                    # (size // slide) * slide) — matching the simulator
+                    # AND the engine (pinned by the differential
+                    # tests); the reported bounds keep the true end.
+                    first_start = ((N_prev - c) // s + 1) * s
+                    ws = first_start + s * jnp.arange(maxk,
+                                                      dtype=jnp.int64)
+                    ends = ws + c
+                    ok = (ws >= 0) & (ends <= N_i + 2)
+                    we_rank = ws + (c // s) * s
+                ws_parts.append(ws)
                 we_parts.append(ends)
+                wr_parts.append(we_rank)
                 ok_parts.append(ok)
                 cw_parts.append(jnp.ones((maxk,), bool))
             if make_time_triggers is not None:
@@ -401,8 +481,10 @@ class CountStreamPipeline(FusedPipelineDriver):
                 min_ts = jnp.min(jnp.where(t_valid, ws, ec.I64_MAX))
                 r0 = c_cut(min_ts, N_i)
                 mstar = r0
-                for (c, _) in count_layout:
-                    cand = ((r0 + u) // c) * c
+                for (_, s, _, _) in count_layout:
+                    # the count cut cadence is the window's slide (the
+                    # engine's count_periods take w.slide for sliding)
+                    cand = ((r0 + u) // s) * s
                     mstar = jnp.maximum(mstar,
                                         jnp.where(cand > r0, cand, r0))
                 min_count = jnp.minimum(
@@ -413,7 +495,20 @@ class CountStreamPipeline(FusedPipelineDriver):
                 a_rank = jnp.where(
                     shadow & t_valid & (ws == min_ts),
                     jnp.maximum(a_rank, mstar), a_rank)
-            b_rank = jnp.where(is_count, jnp.minimum(we, N_i),
+            # count rows answer rank ranges with the reference's slice
+            # containment: while the stream has NOT advanced past the
+            # window end (N_i <= we) the OPEN boundary slice's extent
+            # fits inside the window and every retained rank below we
+            # counts (b = N_i — also the tumbling cend+1 partial); once
+            # N_i > we the boundary slice sticks out and only whole
+            # slices aggregate (b = the slide-grid floor; for tumbling
+            # the floor IS the end, reproducing min(we, N_i)). Time
+            # rows answer the arrival cut of the true end.
+            we_rank = jnp.concatenate(
+                wr_parts + ([jnp.zeros((Tt,), jnp.int64)]
+                            if make_time_triggers is not None else []))
+            b_rank = jnp.where(is_count,
+                               jnp.where(N_i <= we, N_i, we_rank),
                                c_cut(we, N_i))
             b_rank = jnp.maximum(b_rank, a_rank)
             cnt = jnp.where(tmask, b_rank - a_rank, 0)
@@ -514,12 +609,20 @@ class CountStreamPipeline(FusedPipelineDriver):
         return self.state.overflow
 
     def _interval_tuples(self, i: int) -> int:
-        """Telemetry: intervals before the late reach warms up (i < q)
-        carry only the in-order stream plus the partial late strata."""
+        """Telemetry: intervals before the late reach warms up carry
+        only the in-order stream plus the partial late strata (interval
+        i's band spans min(sc, i*P) rows)."""
+        late_i = self.E * min(i * self.wm_period_ms, self.sc)
         if self.obs is not None and self.L:
-            self.obs.counter(_obs.LATE_TUPLES).inc(
-                self.E * min(i, self.q) * self.wm_period_ms)
-        return self.SR + self.E * min(i, self.q) * self.wm_period_ms
+            self.obs.counter(_obs.LATE_TUPLES).inc(late_i)
+            if self.rem and i >= self.q:
+                # sub-period lateness relaxation active (ISSUE 11):
+                # the partial oldest stratum carried `rem` rows this
+                # interval — gated so a silent flip into/out of the
+                # relaxed retention model fails `obs diff`
+                self.obs.counter(
+                    _obs.COUNT_LATENESS_RELAXED_ROWS).inc(self.rem)
+        return self.SR + late_i
 
     def check_overflow(self) -> None:
         import jax
@@ -560,6 +663,7 @@ class CountStreamPipeline(FusedPipelineDriver):
         if self._root is None:
             self._root = jax.random.PRNGKey(self.seed)
         P, u, E, q = self.wm_period_ms, self.u, self.E, self.q
+        rem = self.rem
         key = self._interval_key(i)
         base = np.int64(i) * P
         vin = np.asarray(jax.random.uniform(
@@ -572,9 +676,13 @@ class CountStreamPipeline(FusedPipelineDriver):
                 lv = np.asarray(jax.random.uniform(
                     ka, (P, E), dtype=jnp.float32)) * self.value_scale
                 lo = int(base) - a * P
-                parts_v.append(lv.reshape(-1))
-                parts_t.append(lo + np.repeat(np.arange(P, dtype=np.int64),
-                                              E))
+                # the partial oldest stratum keeps only the tail rows
+                # inside the lateness span (the fused step masks the
+                # same rows)
+                rows_lo = P - rem if (rem and a == q) else 0
+                parts_v.append(lv[rows_lo:].reshape(-1))
+                parts_t.append(lo + np.repeat(
+                    np.arange(rows_lo, P, dtype=np.int64), E))
         parts_v.append(vin.reshape(-1))
         parts_t.append(ts_in)
         return (np.concatenate(parts_v).astype(np.float32),

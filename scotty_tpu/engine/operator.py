@@ -709,6 +709,8 @@ class TpuWindowOperator(WindowOperator):
         else:
             self._session_states = []
         if self._ctx_windows:
+            from . import context as ectx
+
             if not self._session_windows:
                 self._emit_cap = self.config.trigger_pad(1024)
             specs = [w.device_context_spec() for w in self._ctx_windows]
@@ -719,6 +721,19 @@ class TpuWindowOperator(WindowOperator):
             self._ctx_specs = tuple(specs)
             self._ctx_chain = tuple(
                 sp.inorder_chain_params() is not None for sp in specs)
+            # speculative chunked batching (ISSUE 11): specs certifying
+            # SpeculationCert get a host planner that sorts OOO chunks,
+            # proves per interaction component that the vectorized chain
+            # kernel reproduces the arrival-order scan, and falls back
+            # to the scan only for the components it cannot prove
+            self._ctx_planners = tuple(
+                ectx.SpeculativePlanner(sp)
+                if (sp.inorder_chain_params() is not None
+                    and sp.speculation_params() is not None) else None
+                for sp in specs)
+            self._ctx_spec_stats = {"speculative_tuples": 0,
+                                    "fallback_tuples": 0,
+                                    "fallback_runs": 0}
             # clear_delay participates in the GC bound (mirroring
             # Window.clear_delay / WindowManager.java:121-127): retention
             # beyond what orphan_reach already grants is applied as a
@@ -733,6 +748,8 @@ class TpuWindowOperator(WindowOperator):
                 for _ in specs]
         else:
             self._ctx_states = []
+            self._ctx_planners = ()
+            self._ctx_spec_stats = {}
         # per-watermark emission order among context windows follows their
         # REGISTRATION order (the simulator iterates contexts in that
         # order, WindowManager.java:98-118)
@@ -934,6 +951,21 @@ class TpuWindowOperator(WindowOperator):
             inorder = bool((bt[:-1] <= bt[1:]).all()) \
                 and (met_pre is None or int(bt[0]) >= met_pre)
             self._feed_contexts(batch_v[:take], bt, inorder=inorder)
+
+        if not self._has_grid:
+            # pure-session/context workloads: no slice buffer to feed,
+            # so skip the grid path's full ts-sort (it was ~15% of a
+            # speculative context batch) and update the host clock
+            # mirrors straight from the arrival arrays
+            if take:
+                mx = int(batch_t[:take].max())
+                mn = int(batch_t[:take].min())
+                self._host_met = mx if self._host_met is None \
+                    else max(self._host_met, mx)
+                self._host_min_ts = mn if self._host_min_ts is None \
+                    else min(self._host_min_ts, mn)
+                self._host_count += take
+            return
 
         if mixed and take:
             # arrival-order cut calculus: maintains the open-slice mirror on
@@ -1193,33 +1225,94 @@ class TpuWindowOperator(WindowOperator):
                     self._session_states[i] = kern(
                         self._session_states[i], pt, pv, m)
 
-    def _feed_contexts(self, vals: np.ndarray, tss: np.ndarray,
-                       inorder: bool = False) -> None:
-        """Apply this batch to every generic context window's active
-        arrays, in arrival order, one fused device dispatch per chunk:
-        the vectorized chain kernel for sorted in-order chunks when the
-        spec certifies it (inorder_chain_params — O(B) total work), the
-        per-tuple scan otherwise. The tail chunk pads to a small
+    def _ctx_dispatch(self, i: int, cv: np.ndarray, ct: np.ndarray,
+                      chunk: bool) -> None:
+        """One padded device dispatch for context window ``i``: the
+        vectorized chain kernel (``chunk=True``, sorted input) or the
+        per-tuple scan (arrival-order input). Pads to a small
         power-of-two bucket, NOT the full batch size — the scan is
         sequential per lane, so a trickle flush at batch_size-length
         would pay thousands of wasted device steps (the kernels retrace
         per padded length; bucketing bounds the variants)."""
         B = self.config.batch_size
-        for lo in range(0, tss.size, B):
-            ct, cv = tss[lo:lo + B], vals[lo:lo + B]
-            k = ct.size
-            L = B if k == B else min(B, 1 << max(6, (k - 1).bit_length()))
-            pt = np.full((L,), ct[-1], np.int64)
-            pv = np.zeros((L,), np.float32)
-            pt[:k], pv[:k] = ct, cv
-            m = np.zeros((L,), bool)
-            m[:k] = True
-            for i, kern in enumerate(self._ctx_applies):
+        k = ct.size
+        if k == 0:
+            return
+        L = B if k == B else min(B, 1 << max(6, (k - 1).bit_length()))
+        pt = np.full((L,), ct[-1], np.int64)
+        pv = np.zeros((L,), np.float32)
+        pt[:k], pv[:k] = ct, cv
+        m = np.zeros((L,), bool)
+        m[:k] = True
+        if chunk:
+            kern = _context_chunk_kernel(
+                self._spec.aggs, self._ctx_specs[i],
+                self.config.capacity, L)
+        else:
+            kern = self._ctx_applies[i]
+        self._ctx_states[i] = kern(self._ctx_states[i], pt, pv, m)
+
+    def _feed_contexts(self, vals: np.ndarray, tss: np.ndarray,
+                       inorder: bool = False) -> None:
+        """Apply this batch to every generic context window's active
+        arrays, preserving arrival-order semantics.
+
+        Per window: sorted in-order chunks take the vectorized chain
+        kernel when the spec certifies it (inorder_chain_params — O(B)
+        total work). OUT-OF-ORDER chunks of specs additionally
+        certifying ``speculation_params`` go through the speculative
+        planner (ISSUE 11): the chunk is sorted, segmented where
+        ``decide`` provably cannot interact across the cut, safe
+        segment runs execute as single chain-kernel dispatches, and
+        only the segments the safety proof rejects replay through the
+        per-tuple scan (in exact arrival order) — counted in the gated
+        ``ctx_speculative_*`` telemetry. Everything else stays on the
+        sequential scan."""
+        from ..obs import (CTX_SPECULATIVE_FALLBACK_TUPLES,
+                           CTX_SPECULATIVE_FALLBACKS,
+                           CTX_SPECULATIVE_TUPLES)
+
+        B = self.config.batch_size
+        for i in range(len(self._ctx_states)):
+            planner = self._ctx_planners[i]
+            for lo in range(0, tss.size, B):
+                ct, cv = tss[lo:lo + B], vals[lo:lo + B]
                 if inorder and self._ctx_chain[i]:
-                    kern = _context_chunk_kernel(
-                        self._spec.aggs, self._ctx_specs[i],
-                        self.config.capacity, L)
-                self._ctx_states[i] = kern(self._ctx_states[i], pt, pv, m)
+                    self._ctx_dispatch(i, cv, ct, chunk=True)
+                    if planner is not None:
+                        planner.note_chunk(ct)
+                        self._ctx_spec_stats["speculative_tuples"] += \
+                            ct.size
+                        if self.obs is not None:
+                            self.obs.counter(
+                                CTX_SPECULATIVE_TUPLES).inc(ct.size)
+                    continue
+                if planner is None:
+                    self._ctx_dispatch(i, cv, ct, chunk=False)
+                    continue
+                for kind, idx in planner.plan(ct):
+                    if kind == "chunk":
+                        self._ctx_dispatch(i, cv[idx], ct[idx],
+                                           chunk=True)
+                        planner.note_chunk(ct[idx])
+                        self._ctx_spec_stats["speculative_tuples"] += \
+                            idx.size
+                        if self.obs is not None:
+                            self.obs.counter(
+                                CTX_SPECULATIVE_TUPLES).inc(idx.size)
+                    else:
+                        self._ctx_dispatch(i, cv[idx], ct[idx],
+                                           chunk=False)
+                        planner.note_scan(ct[idx])
+                        self._ctx_spec_stats["fallback_tuples"] += \
+                            idx.size
+                        self._ctx_spec_stats["fallback_runs"] += 1
+                        if self.obs is not None:
+                            self.obs.counter(
+                                CTX_SPECULATIVE_FALLBACK_TUPLES).inc(
+                                    idx.size)
+                            self.obs.counter(
+                                CTX_SPECULATIVE_FALLBACKS).inc()
 
     def _pick_inorder_kernel(self, ts_lo: int, ts_hi: int):
         """Scatter-free dense kernel when the batch's slice-run count is
@@ -1442,6 +1535,13 @@ class TpuWindowOperator(WindowOperator):
                     self.config.capacity, B)
                 self._ctx_states[i] = kern(self._ctx_states[i], ts, vals,
                                            valid)
+                if self._ctx_planners[i] is not None:
+                    # device-resident timestamps are host-opaque: the
+                    # speculative bounds mirror cannot replay the chain
+                    # walk, so the affected region goes conservatively
+                    # unknown (later host OOO chunks re-prove safety
+                    # only above it)
+                    self._ctx_planners[i].invalidate(ts_max)
             if not self._has_grid:
                 if self.obs is not None:        # pure-context ingest done
                     self.obs.counter(_obs.INGEST_TUPLES).inc(n)
@@ -1732,6 +1832,10 @@ class TpuWindowOperator(WindowOperator):
                     self._ctx_states[i], wm,
                     gc_bound - np.int64(self._ctx_gc_slack[i]))
                 self._ctx_states[i] = new_s
+                if self._ctx_planners[i] is not None:
+                    # the planner's bounds mirror prunes on the same
+                    # certified trigger rule the device sweep applies
+                    self._ctx_planners[i].sweep(watermark_ts)
             outs.append((m_d, e_s, e_e, e_c, e_p))
         return outs
 

@@ -1502,6 +1502,11 @@ class AlignedStreamPipeline(FusedPipelineDriver):
         self._step_impl = step_impl
         self._gen_rows = gen_rows
         self._gen_lanes = gen_lanes
+        #: the generator the ACTIVE step closes over (legacy anchor cells
+        #: trace gen_rows_legacy) — the bench's generator-share probe
+        #: times exactly this stream cost (ISSUE 11; a separate jit, the
+        #: pinned step HLO is untouched)
+        self._gen_active = gen_rows_legacy if legacy else gen_rows
         self.set_rows_per_chunk(self._heuristic_d)
         self._root = None
         self.state = None

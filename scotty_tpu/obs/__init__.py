@@ -121,6 +121,23 @@ OVERFLOWS = "overflows"
 SILENT_INTERVALS = "silent_intervals"
 EMIT_LATENCY_MS = "emit_latency_ms"
 
+# speculative generic-context batching contract (ISSUE 11 —
+# engine/context.py SpeculativePlanner; host counters moved per chunk
+# run by TpuWindowOperator._feed_contexts): tuples through the
+# vectorized chunk path, tuples the safety proof sent back to the
+# per-tuple scan, and how many fallback runs fired — a silent
+# regression to the scan shows up as the gated fallback counters
+# appearing/growing even when wall time still looks plausible
+CTX_SPECULATIVE_TUPLES = "ctx_speculative_tuples"
+CTX_SPECULATIVE_FALLBACK_TUPLES = "ctx_speculative_fallback_tuples"
+CTX_SPECULATIVE_FALLBACKS = "ctx_speculative_fallbacks"
+
+# sliding-count lateness relaxation (ISSUE 11 — count_pipeline.py):
+# rows carried by the sub-period (max_lateness < wm_period) stratified
+# late model; gated so a config silently flipping into (or out of) the
+# relaxed retention model cannot pass as clean
+COUNT_LATENESS_RELAXED_ROWS = "count_lateness_relaxed_rows"
+
 # shaper contract (ISSUE 5 — scotty_tpu.shaper; counters/gauges folded
 # at the existing drain points, documented in README/docs/API.md)
 SHAPER_REORDERED_TUPLES = "shaper_reordered_tuples"

@@ -65,6 +65,23 @@ DEFAULT_THRESHOLDS = {
         "resilience_poison_records": {"direction": "lower", "default": 0},
         "resilience_source_retries": {"direction": "lower", "default": 0},
         "resilience_stall_events": {"direction": "lower", "default": 0},
+        # speculative generic-context contract (ISSUE 11): the chunked
+        # fast path silently degrading to the per-tuple scan is a >100x
+        # throughput cliff that wall-clock alone can hide in short
+        # cells — fallback tuples/runs appearing (or growing >10%) on
+        # the same seeded stream gate. Lazily created ("default": 0
+        # covers the appearing case, like the resilience set).
+        "ctx_speculative_fallback_tuples": {"direction": "lower",
+                                            "default": 0,
+                                            "rel_tol": 0.10},
+        "ctx_speculative_fallbacks": {"direction": "lower", "default": 0,
+                                      "rel_tol": 0.10},
+        # sliding-count lateness relaxation (ISSUE 11): the sub-period
+        # retention model flipping on (or its carried rows growing) on
+        # an unchanged config means the lateness/stratification inputs
+        # changed — surfaced rather than silently absorbed.
+        "count_lateness_relaxed_rows": {"direction": "lower",
+                                        "default": 0, "rel_tol": 0.10},
         # shaper contract (ISSUE 5): a candidate whose shaper started
         # losing late residues (slack overflow) or holding tuples past
         # the end-of-run drain must not pass as clean; reordered-tuple

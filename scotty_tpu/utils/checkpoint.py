@@ -330,6 +330,12 @@ def _restore_meta(op, meta: dict) -> None:
         # past capacity and die on the fatal overflow the policy exists
         # to prevent (post-restart supervision). One deliberate sync.
         op._pol_refresh()
+    for pl in getattr(op, "_ctx_planners", ()) or ():
+        # a restore rewinds host clocks under the speculative bounds
+        # mirror's feet — everything at/below the restored stream head
+        # goes conservatively unknown (ISSUE 11)
+        if pl is not None:
+            pl.invalidate(op._host_met)
 
 
 def save_engine_operator(op, path: str) -> None:

@@ -218,6 +218,116 @@ def test_rejects_unsupported_specs():
                             [QuantileAggregation(0.5)])
 
 
+def test_unsupported_error_names_rank_range_classes():
+    """ISSUE 11 satellite: the rejection messages name the rank-range
+    classes the pipeline DOES support and the sliding-count entry
+    point, instead of a bare refusal."""
+    from scotty_tpu import SessionWindow
+
+    with pytest.raises(NotImplementedError) as ei:
+        CountStreamPipeline([SessionWindow(Count, 10)], [SumAggregation()])
+    msg = str(ei.value)
+    assert "CountTumbling" in msg and "CountSliding" in msg
+    with pytest.raises(NotImplementedError) as ei:
+        CountStreamPipeline([TumblingWindow(Time, 100)], [SumAggregation()])
+    assert "CountSliding" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# sliding count-measure windows (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("size,slide", [(20, 5), (13, 5), (8, 8)])
+def test_count_sliding_inorder_vs_simulator(size, slide):
+    """Sliding count windows at several overlap ratios (divisible,
+    non-divisible, slide == size — which must keep the SLIDING walk's
+    end <= cend+2 guard, not collapse into tumbling) vs the reference
+    simulator."""
+    agg = SumAggregation()
+    W = [SlidingWindow(Count, size, slide)]
+    p, got = run_pipeline(W, agg, 2000, 0.0, 5)
+    assert_same(oracle_windows(make_sim(W, agg, 100), p, agg, 5), got)
+
+
+def test_count_sliding_tumbling_mix_inorder_vs_simulator():
+    agg = SumAggregation()
+    W = [SlidingWindow(Count, 20, 5), TumblingWindow(Count, 7)]
+    p, got = run_pipeline(W, agg, 2000, 0.0, 5)
+    assert_same(oracle_windows(make_sim(W, agg, 100), p, agg, 5), got)
+
+
+def test_count_sliding_time_mix_inorder_vs_simulator():
+    agg = SumAggregation()
+    W = [SlidingWindow(Count, 15, 5), TumblingWindow(Time, 50)]
+    p, got = run_pipeline(W, agg, 2000, 0.0, 5)
+    assert_same(oracle_windows(make_sim(W, agg, 100), p, agg, 5), got)
+
+
+@pytest.mark.parametrize("agg", [SumAggregation(), MaxAggregation()])
+def test_count_sliding_ooo_vs_engine(agg):
+    """The OOO arm: sliding rank ranges answered from the stratified
+    late rows, vs the engine's record-merge rank semantics."""
+    W = [SlidingWindow(Count, 20, 5)]
+    p, got = run_pipeline(W, agg, 2000, 0.3, 5)
+    assert_same(oracle_windows(make_dev(W, agg, 100), p, agg, 5), got)
+
+
+# ---------------------------------------------------------------------------
+# max_lateness >= wm_period relaxation (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def test_count_ooo_sub_period_lateness_vs_engine():
+    """max_lateness < wm_period used to be rejected outright; the
+    partial-stratum late model now carries it — vs the engine's record
+    merge on the same materialized stream."""
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 7)]
+    p, got = run_pipeline(W, agg, 2000, 0.25, 5, lateness=40)
+    assert p.rem == 40 and p.q == 1 and p.q_full == 0
+    assert_same(oracle_windows(make_dev(W, agg, 40), p, agg, 5), got)
+
+
+def test_count_sliding_ooo_sub_period_lateness_vs_engine():
+    agg = SumAggregation()
+    W = [SlidingWindow(Count, 20, 5)]
+    p, got = run_pipeline(W, agg, 2000, 0.25, 5, lateness=60)
+    assert p.rem == 60
+    assert_same(oracle_windows(make_dev(W, agg, 60), p, agg, 5), got)
+
+
+def test_count_ooo_fractional_period_lateness_vs_engine():
+    """Lateness between one and two periods (q_full=1 + a partial
+    oldest stratum) — the mixed whole/partial band accounting."""
+    agg = SumAggregation()
+    W = [TumblingWindow(Count, 11)]
+    p, got = run_pipeline(W, agg, 2000, 0.2, 6, lateness=150)
+    assert p.q_full == 1 and p.rem == 50 and p.q == 2
+    assert_same(oracle_windows(make_dev(W, agg, 150), p, agg, 6), got)
+
+
+def test_relaxed_lateness_counter_gated():
+    """The relaxed retention model surfaces through the gated
+    count_lateness_relaxed_rows counter (obs diff DEFAULT_THRESHOLDS)."""
+    from scotty_tpu import obs as _obs
+
+    agg = SumAggregation()
+    p = CountStreamPipeline([TumblingWindow(Count, 7)], [agg],
+                            throughput=2000, wm_period_ms=100,
+                            max_lateness=40, seed=1, out_of_order_pct=0.2)
+    o = _obs.Observability()
+    p.reset()
+    p.set_observability(o)
+    list(p.run(3))
+    p.check_overflow()
+    assert o.registry.counter(
+        _obs.COUNT_LATENESS_RELAXED_ROWS).value > 0
+    from scotty_tpu.obs.diff import DEFAULT_THRESHOLDS
+
+    assert _obs.COUNT_LATENESS_RELAXED_ROWS in DEFAULT_THRESHOLDS["metrics"]
+
+
 def test_no_overflow_on_contract_streams():
     """The row-window retention model covers every in-contract trigger:
     the overflow flag stays clear over a multi-interval run."""

@@ -1,0 +1,396 @@
+"""Speculative chunked batching for the generic-context path (ISSUE 11).
+
+The contract under test: for specs certifying
+``DeviceContextSpec.speculation_params``, feeding an OUT-OF-ORDER chunk
+through ``TpuWindowOperator`` produces exactly the emissions the
+per-tuple arrival-order scan produces — the planner batches only the
+segments it can prove, and every segmentation-boundary hazard (exact-gap
+orphan collisions, components touching non-top rows, stale-mirror
+regions after a fallback, capped order-dependence) must either be
+batched correctly or detected and routed to the scan.
+
+Oracles: the scan-only twin (``_ctx_planners`` forced off — the r5
+behavior), the tuned session engine (for plain sessions), and the host
+simulator through ``GenericSessionWindow``'s reference context.
+"""
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    CappedSessionWindow,
+    GenericSessionWindow,
+    SessionWindow,
+    SlicingWindowOperator,
+    SumAggregation,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig, TpuWindowOperator
+from scotty_tpu.engine.context import (
+    CappedSessionDecider,
+    SessionDecider,
+    SpeculationCert,
+    SpeculativePlanner,
+)
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=512, batch_size=1024, annex_capacity=512,
+                   min_trigger_pad=32)
+
+
+def _drive(window, batches, wms, speculative=True, lateness=10_000,
+           config=CFG):
+    """Feed arrival-order batches + watermarks; return emissions and the
+    operator (for stats). ``speculative=False`` forces the scan-only
+    r5 path as the differential baseline."""
+    op = TpuWindowOperator(config=config)
+    op.add_window_assigner(window)
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(lateness)
+    out = []
+    for (vals, ts), wm in zip(batches, wms):
+        if not op._built:
+            op._build()
+        if not speculative:
+            op._ctx_planners = tuple(None for _ in op._ctx_planners)
+        op.process_elements(np.asarray(vals, np.float32),
+                            np.asarray(ts, np.int64))
+        op._flush()       # each staged batch is its own launch boundary
+        if wm is not None:
+            for w in op.process_watermark(wm):
+                out.append((w.start, w.end,
+                            round(float(w.agg_values[0]), 2)
+                            if w.has_value() else None))
+    op.check_overflow()
+    return out, op
+
+
+def _chaos_batches(seed, n_batches=8, n=300, gap_ms=400, span=280,
+                   late_pct=0.25, back=120):
+    """Arrival-order chaos: paced bursts separated by silent spans (so
+    sessions actually close), a late fraction displaced back by up to
+    ``back`` ms (so batches arrive OOO and reach into prior bursts)."""
+    rng = np.random.default_rng(seed)
+    batches, wms = [], []
+    for i in range(n_batches):
+        base = i * gap_ms
+        ts = np.sort(rng.integers(base, base + span,
+                                  size=n)).astype(np.int64)
+        late = rng.random(n) < late_pct
+        ts = np.where(late,
+                      np.maximum(ts - rng.integers(0, back, size=n), 0),
+                      ts)
+        vals = rng.integers(1, 60, size=n).astype(np.float32)
+        batches.append((vals, ts))
+        wms.append(base + gap_ms)
+    return batches, wms
+
+
+# ---------------------------------------------------------------------------
+# differential: speculative == scan == tuned == simulator
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23, 41])
+def test_speculative_equals_scan_chaos(seed):
+    """Chaos OOO streams through GenericSessionWindow: the speculative
+    plan must emit exactly what the per-tuple scan emits, while actually
+    batching the bulk of the stream."""
+    batches, wms = _chaos_batches(seed)
+    fast, op_f = _drive(GenericSessionWindow(Time, 60), batches, wms)
+    slow, _ = _drive(GenericSessionWindow(Time, 60), batches, wms,
+                     speculative=False)
+    assert fast == slow
+    st = op_f._ctx_spec_stats
+    total = st["speculative_tuples"] + st["fallback_tuples"]
+    assert total == sum(len(v) for v, _ in batches)
+    # the whole point: the fast path carries the bulk of the stream
+    # (the occasional wholesale-conservative batch is fine — the gated
+    # counters and the recorded cell's fallback rate police the rest)
+    assert st["speculative_tuples"] >= 0.7 * total, st
+
+
+@pytest.mark.parametrize("seed", [3, 19])
+def test_speculative_matches_tuned_sessions_and_simulator(seed):
+    """GenericSessionWindow ≡ SessionWindow semantics: the generic
+    speculative path, the tuned session engine and the host simulator
+    agree on chaos OOO streams (the three-way oracle)."""
+    batches, wms = _chaos_batches(seed, n_batches=6, n=120)
+    fast, _ = _drive(GenericSessionWindow(Time, 60), batches, wms)
+    tuned, _ = _drive(SessionWindow(Time, 60), batches, wms)
+    assert fast == tuned
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(GenericSessionWindow(Time, 60))
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(10_000)
+    got = []
+    for (vals, ts), wm in zip(batches, wms):
+        for v, t in zip(vals, ts):
+            sim.process_element(float(v), int(t))
+        for w in sim.process_watermark(wm):
+            got.append((w.get_start(), w.get_end()))
+    assert [(s, e) for (s, e, _) in fast] == got
+
+
+def test_capped_ooo_equals_scan():
+    """Capped specs are NOT order-free: internally-OOO components must
+    fall back, and the results must still equal the scan twin."""
+    batches, wms = _chaos_batches(11, n_batches=6, n=150)
+    fast, op_f = _drive(CappedSessionWindow(Time, 60, 200), batches, wms)
+    slow, _ = _drive(CappedSessionWindow(Time, 60, 200), batches, wms,
+                     speculative=False)
+    assert fast == slow
+    assert op_f._ctx_spec_stats["fallback_runs"] > 0
+
+
+def test_capped_sorted_components_batch():
+    """OOO only ACROSS isolated components, sorted within: capped specs
+    may batch those (the certified chain on each stretch)."""
+    # two bursts > gap apart, delivered burst-2-first (arrival OOO),
+    # each internally sorted
+    b1 = (np.arange(10, dtype=np.float32) + 1,
+          np.arange(1000, 1100, 10, dtype=np.int64))
+    b2 = (np.arange(10, dtype=np.float32) + 1,
+          np.arange(2000, 2100, 10, dtype=np.int64))
+    vals = np.concatenate([b2[0], b1[0]])
+    ts = np.concatenate([b2[1], b1[1]])
+    fast, op_f = _drive(CappedSessionWindow(Time, 60, 500),
+                        [(vals, ts)], [4000])
+    slow, _ = _drive(CappedSessionWindow(Time, 60, 500),
+                     [(vals, ts)], [4000], speculative=False)
+    assert fast == slow and len(fast) == 2
+    st = op_f._ctx_spec_stats
+    assert st["speculative_tuples"] == 20 and st["fallback_tuples"] == 0
+
+
+# ---------------------------------------------------------------------------
+# segmentation boundary cases
+# ---------------------------------------------------------------------------
+
+
+def test_exact_gap_orphan_hazard_detected():
+    """The exact-gap start-side collision: a tuple whose only reach is a
+    row starting exactly ``gap`` later, with the row's seed arriving
+    FIRST, orphans under arrival order but would merge under sorted
+    order — the planner must detect it and fall back, keeping the
+    scan's (reference) semantics."""
+    g = 50
+    # arrival: 400 first, then 350 (== 400 - g, exact), isolated pair
+    vals = np.asarray([1.0, 2.0], np.float32)
+    ts = np.asarray([400, 350], np.int64)
+    fast, op_f = _drive(GenericSessionWindow(Time, g),
+                        [(vals, ts)], [1000])
+    slow, _ = _drive(GenericSessionWindow(Time, g),
+                     [(vals, ts)], [1000], speculative=False)
+    assert fast == slow
+    # arrival-order semantics: 350 orphans, window [400, 450) sums 1.0
+    assert fast == [(400, 450, 1.0)]
+    assert op_f._ctx_spec_stats["fallback_tuples"] == 2
+
+
+def test_exact_gap_with_in_reach_precedent_batches():
+    """Same exact-gap pair, but an in-reach tuple precedes the exposed
+    one — no orphan is possible, so the planner may batch, and sorted
+    application matches arrival order."""
+    g = 50
+    vals = np.asarray([1.0, 4.0, 2.0], np.float32)
+    ts = np.asarray([400, 380, 350], np.int64)   # 380 precedes 350
+    fast, op_f = _drive(GenericSessionWindow(Time, g),
+                        [(vals, ts)], [1000])
+    slow, _ = _drive(GenericSessionWindow(Time, g),
+                     [(vals, ts)], [1000], speculative=False)
+    assert fast == slow == [(350, 450, 7.0)]
+    assert op_f._ctx_spec_stats["fallback_tuples"] == 0
+
+
+def test_component_touching_non_top_row_falls_back():
+    """A late component landing in reach of a NON-top live row cannot
+    take the chunk kernel (it only continues the top row): planner must
+    scan it, and results must match the scan twin."""
+    g = 60
+    b1 = (np.full(5, 1.0, np.float32),
+          np.asarray([1000, 1010, 1020, 1030, 1040], np.int64))
+    b2 = (np.full(5, 1.0, np.float32),
+          np.asarray([2000, 2010, 2020, 2030, 2040], np.int64))
+    # late burst extending the FIRST (now non-top) session's end
+    b3 = (np.full(3, 1.0, np.float32),
+          np.asarray([1080, 1090, 1100], np.int64))
+    batches = [b1, b2, (np.concatenate([b2[0], b3[0]]),
+                        np.concatenate([b2[1] + 500, b3[1]]))]
+    wms = [None, None, 5000]
+    fast, op_f = _drive(GenericSessionWindow(Time, g), batches, wms)
+    slow, _ = _drive(GenericSessionWindow(Time, g), batches, wms,
+                     speculative=False)
+    assert fast == slow
+    assert op_f._ctx_spec_stats["fallback_tuples"] >= 3
+
+
+def test_two_components_through_wide_top_row_fall_back():
+    """Two sorted components more than ``gap`` apart can still interact
+    THROUGH a wide live top row (both fold inside it): the planner must
+    not batch either."""
+    g = 30
+    # a wide session [1000, 1500] built in-order
+    b1_ts = np.arange(1000, 1501, 25, dtype=np.int64)
+    b1 = (np.full(b1_ts.size, 1.0, np.float32), b1_ts)
+    # OOO chunk: two inside-the-span bursts > gap apart
+    b2 = (np.asarray([2.0, 2.0, 3.0, 3.0], np.float32),
+          np.asarray([1300, 1310, 1100, 1110], np.int64))
+    batches, wms = [b1, b2], [None, 2000]
+    fast, op_f = _drive(GenericSessionWindow(Time, g), batches, wms)
+    slow, _ = _drive(GenericSessionWindow(Time, g), batches, wms,
+                     speculative=False)
+    assert fast == slow and len(fast) == 1
+    assert op_f._ctx_spec_stats["fallback_tuples"] >= 4
+
+
+def test_stale_mirror_recovers_after_fallback():
+    """After a scan fallback the bounds mirror goes stale below U; later
+    in-order traffic keeps batching above it, and once the watermark
+    passes U + reach the stale region clears (speculation resumes for
+    everything)."""
+    g = 50
+    win = GenericSessionWindow(Time, g)
+    op = TpuWindowOperator(config=CFG)
+    op.add_window_assigner(win)
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(10_000)
+    # exact-gap hazard pair → fallback → stale region
+    op.process_elements(np.asarray([1.0, 1.0], np.float32),
+                        np.asarray([400, 350], np.int64))
+    op._flush()
+    pl = op._ctx_planners[0]
+    assert pl.stale_u is not None
+    # far-above in-order traffic still batches
+    ts = np.arange(2000, 2400, 10, dtype=np.int64)
+    op.process_elements(np.full(ts.size, 1.0, np.float32), ts)
+    op._flush()
+    assert op._ctx_spec_stats["speculative_tuples"] == ts.size
+    # watermark past U + reach clears the stale region
+    op.process_watermark(3000)
+    assert pl.stale_u is None
+    op.check_overflow()
+
+
+def test_device_ingest_invalidates_mirror():
+    """Device-resident chunks are host-opaque: the planner mirror must
+    go conservatively unknown, and later host OOO chunks must still be
+    correct (falling back under the stale region)."""
+    import jax
+
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=512, batch_size=64, annex_capacity=512,
+        min_trigger_pad=32))
+    op.add_window_assigner(CappedSessionWindow(Time, 50, 10_000))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(10_000)
+    ts = np.arange(0, 640, 10, dtype=np.int64)
+    op.ingest_device_batch(jax.device_put(np.ones(64, np.float32)),
+                           jax.device_put(ts), 0, 630)
+    assert op._ctx_planners[0].stale_u is not None
+    # host OOO chunk below the unknown region → scan, still correct
+    op.process_elements(np.asarray([5.0, 5.0], np.float32),
+                        np.asarray([700, 650], np.int64))
+    op._flush()
+    out = [(w.start, w.end, float(w.agg_values[0]))
+           for w in op.process_watermark(2000) if w.has_value()]
+    op.check_overflow()
+    assert out == [(0, 750, 74.0)]
+
+
+def test_checkpoint_restore_invalidates_mirror(tmp_path):
+    """A restore rewinds host clocks under the mirror: every planner
+    must go conservatively unknown (restored row bounds are opaque)."""
+    from scotty_tpu.utils.checkpoint import (restore_engine_operator,
+                                             save_engine_operator)
+
+    def mk():
+        op = TpuWindowOperator(config=CFG)
+        op.add_window_assigner(GenericSessionWindow(Time, 50))
+        op.add_aggregation(SumAggregation())
+        op.set_max_lateness(10_000)
+        return op
+
+    op = mk()
+    ts = np.arange(0, 400, 10, dtype=np.int64)
+    op.process_elements(np.full(ts.size, 1.0, np.float32), ts)
+    # context states are host-opaque to the snapshot: the restored
+    # twin's planner must not trust a mirror it never rebuilt
+    save_engine_operator(op, str(tmp_path / "ck"))
+    twin = mk()
+    twin.process_element(1.0, 5)          # build
+    restore_engine_operator(twin, str(tmp_path / "ck"))
+    assert twin._ctx_planners[0].stale_u is not None
+
+
+# ---------------------------------------------------------------------------
+# planner unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_counters_gated():
+    """The fallback counters are wired into the obs-diff default gate
+    (a silent regression to the per-tuple scan must fail `obs diff`)."""
+    from scotty_tpu import obs as _obs
+    from scotty_tpu.obs.diff import DEFAULT_THRESHOLDS
+
+    m = DEFAULT_THRESHOLDS["metrics"]
+    assert _obs.CTX_SPECULATIVE_FALLBACK_TUPLES in m
+    assert _obs.CTX_SPECULATIVE_FALLBACKS in m
+    assert m[_obs.CTX_SPECULATIVE_FALLBACK_TUPLES]["default"] == 0
+
+
+def test_planner_requires_certifications():
+    class NoCert(SessionDecider):
+        def speculation_params(self):
+            return None
+
+    with pytest.raises(ValueError):
+        SpeculativePlanner(NoCert(10))
+
+    class BadReach(SessionDecider):
+        def speculation_params(self):
+            return SpeculationCert(reach=self.gap + 1, order_free=True)
+
+    with pytest.raises(ValueError):
+        SpeculativePlanner(BadReach(10))
+
+
+def test_planner_component_cuts_and_coalescing():
+    pl = SpeculativePlanner(SessionDecider(10))
+    # three isolated components, all safe → ONE coalesced chunk run
+    ts = np.asarray([100, 105, 300, 305, 500, 505], np.int64)
+    runs = pl.plan(ts)
+    assert [k for k, _ in runs] == ["chunk"]
+    assert runs[0][1].size == 6
+    pl.note_chunk(ts)
+    np.testing.assert_array_equal(pl.first, [100, 300, 500])
+    np.testing.assert_array_equal(pl.last, [105, 305, 505])
+    # sweep prunes by the certified trigger rule (last + reach < wm)
+    pl.sweep(320)
+    np.testing.assert_array_equal(pl.first, [500])
+
+
+def test_planner_capped_mirror_tracks_cap_splits():
+    """The host chain walk must mirror the device kernel's span-cap
+    splits (anchor + cap searchsorted)."""
+    pl = SpeculativePlanner(CappedSessionDecider(10, 25))
+    ts = np.arange(0, 60, 5, dtype=np.int64)      # one dense run, span 55
+    pl.note_chunk(ts)
+    # chain: [0,25] (cap), [30,55] — splits at anchor+cap boundaries
+    np.testing.assert_array_equal(pl.first, [0, 30])
+    np.testing.assert_array_equal(pl.last, [25, 55])
+
+
+def test_planner_scan_staleness_bounds():
+    pl = SpeculativePlanner(SessionDecider(10))
+    pl.note_chunk(np.asarray([100, 200, 300], np.int64))
+    pl.note_scan(np.asarray([205], np.int64))      # V = 215: row 300 known
+    np.testing.assert_array_equal(pl.first, [300])
+    assert pl.stale_u == 205
+    # component just above U but within reach → unsafe
+    runs = pl.plan(np.asarray([212, 214], np.int64))
+    assert [k for k, _ in runs] == ["scan"]
+    # component beyond U + reach and inside the known top → safe
+    runs = pl.plan(np.asarray([301, 300], np.int64))
+    assert [k for k, _ in runs] == ["chunk"]
