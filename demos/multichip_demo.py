@@ -1,9 +1,11 @@
 """Multi-chip demo: keyed slice buffers sharded over a device mesh + a
 global-window cross-shard combine — the TPU-native replacement for the
 reference's host-engine key partitioning (SURVEY.md §2.8) — plus the
-ISSUE 10 mesh engine: shard_map execution, hot-key detection, and a
-rebalance at a checkpoint boundary. Runs anywhere via a virtual
-8-device CPU mesh."""
+ISSUE 10 mesh engine (shard_map execution, hot-key detection, a
+rebalance at a checkpoint boundary) and the ISSUE 13 multi-tenant mesh
+service: queries registered MID-STREAM against the sharded step with
+zero retraces, answered per key and globally, then a live 8→4 reshard.
+Runs anywhere via a virtual 8-device CPU mesh."""
 
 import os
 
@@ -75,6 +77,38 @@ def main():
     print(f"rebalance at checkpoint boundary: moved={stats['moved']} "
           f"imbalance {stats['imbalance_before']:.2f} -> "
           f"{stats['imbalance_after']:.2f}")
+
+    # -- ISSUE 13: one multi-tenant service — register queries mid-stream,
+    # answer them per key AND globally, then reshard the mesh live ------
+    from scotty_tpu import SlidingWindow
+    from scotty_tpu.mesh_serving import MeshQueryService
+    from scotty_tpu.serving import QueryAdmission
+
+    svc = MeshQueryService(
+        [SumAggregation()], slice_grid=500, max_window_size=4000,
+        n_keys=64, n_shards=8, throughput=64_000, wm_period_ms=1000,
+        max_lateness=1000, seed=7, config=cfg,
+        admission=QueryAdmission(max_queries=16, per_tenant_quota=8,
+                                 per_shard_quota=8),
+        windows=[TumblingWindow(WindowMeasure.Time, 1000)])
+    svc.run(2, collect=False)         # stream flows before the query
+    svc.sync()
+    svc.mark_warm()
+    h = svc.register(SlidingWindow(WindowMeasure.Time, 2000, 500),
+                     tenant="acme")   # MID-STREAM: one replicated row
+    out = svc.run(1)[0]               # write, zero retraces
+    g = svc.global_rows_by_slot(out).get(h.slot, [])
+    k = svc.key_rows_by_slot(out, 5).get(h.slot, [])
+    print(f"mesh-serving: tenant acme (home shard "
+          f"{svc.tenant_shard('acme')}) sees {len(g)} global + "
+          f"{len(k)} key-5 windows, retraces_since_warm="
+          f"{svc.retraces_since_warm}")
+    row = svc.reshard(4, sup, pos=svc.interval)
+    out = svc.run(1)[0]
+    print(f"live reshard {row['from']}->{row['to']} in "
+          f"{row['wall_ms']:.0f} ms; query still answering "
+          f"{len(svc.global_rows_by_slot(out).get(h.slot, []))} global "
+          f"windows at {svc.n_shards} shards")
 
 
 if __name__ == "__main__":

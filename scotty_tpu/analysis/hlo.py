@@ -142,6 +142,39 @@ def _mesh_lowered():
                          jax.device_put(np.int64(0)))
 
 
+def _mesh_serving_lowered():
+    """Canonical fused mesh-serving step (ISSUE 13): 16 keys over the
+    8-device virtual mesh with an 8x4 query-slot table REPLICATED in
+    the donated carry — the shard_map per-shard program, trigger rows
+    read from table data, and the per-query psum global fold. Same
+    8-device precondition as the mesh pin."""
+    import jax
+    import numpy as np
+
+    from scotty_tpu import SumAggregation
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.pipeline import SlotGeometry
+    from scotty_tpu.mesh_serving import MeshServingPipeline
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            "the mesh_serving pin lowers over an 8-device mesh; run "
+            "under XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(set before anything initializes a JAX backend)")
+    p = MeshServingPipeline(
+        [SumAggregation()],
+        query_slots=SlotGeometry(n_slots=8, triggers_per_slot=4,
+                                 slice_grid=50, max_size=400),
+        n_keys=16, n_shards=8,
+        config=EngineConfig(capacity=1 << 10, batch_size=32,
+                            annex_capacity=32, min_trigger_pad=32),
+        throughput=16 * 2000, wm_period_ms=100, max_lateness=100, seed=5,
+        gc_every=10 ** 9)
+    p.reset()
+    return p._step.lower(p.state, p._qstate, p._interval_key(0),
+                         jax.device_put(np.int64(0)))
+
+
 #: the pinned step configs; insertion order is the report order
 CANONICAL_STEPS = {
     "aligned": _aligned_lowered,
@@ -149,6 +182,7 @@ CANONICAL_STEPS = {
     "count": _count_lowered,
     "context": _context_lowered,
     "mesh": _mesh_lowered,
+    "mesh_serving": _mesh_serving_lowered,
 }
 
 

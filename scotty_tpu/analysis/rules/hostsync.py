@@ -42,6 +42,11 @@ DRAIN_POINT_FUNCTIONS = frozenset({
     # per-shard occupancy/overflow reads — each documented as riding the
     # same drain cadence as check_overflow
     "query_global", "lowered_global", "shard_occupancy",
+    # mesh-serving control path (ISSUE 13): the per-key row-gather fetch
+    # behind key_rows_by_slot (a device gather BEFORE the fetch, so
+    # sampling keys never pulls the full [K, T] block) — documented as
+    # riding the same drain cadence as lowered_global
+    "per_key_columns",
 })
 
 _SYNC_ATTRS = ("device_get", "block_until_ready", "item")
@@ -70,7 +75,8 @@ class HostSyncBan(Rule):
            "packages — syncs belong at documented drain points only")
     include = ("scotty_tpu/engine", "scotty_tpu/parallel",
                "scotty_tpu/shaper", "scotty_tpu/serving",
-               "scotty_tpu/core", "scotty_tpu/mesh")
+               "scotty_tpu/core", "scotty_tpu/mesh",
+               "scotty_tpu/mesh_serving")
 
     def check(self, src: SourceFile):
         for node in src.walk:

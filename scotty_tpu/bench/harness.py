@@ -206,6 +206,12 @@ class BenchmarkConfig:
     #: twin run migrates keys at a sync boundary and emissions must
     #: bit-match the unmoved twin)
     mesh_rebalance: bool = True
+    #: QueryChurnMesh cell (ISSUE 13): ``[[interval, shards], ...]`` —
+    #: live reshard to ``shards`` before the named TIMED interval runs
+    #: (a checkpoint-boundary operation under the cell's Supervisor);
+    #: the superset oracle replays the same schedule so the global psum
+    #: folds stay bit-comparable. Empty = no reshard.
+    mesh_reshard_schedule: List[list] = field(default_factory=list)
     #: delivery guarantee for connector-backed cells (ISSUE 8; the
     #: runner's --delivery flag overrides): "at_least_once" (the
     #: benchmarked default — no ledger) or "exactly_once" (a
@@ -249,6 +255,7 @@ class BenchmarkConfig:
             delivery=raw.get("delivery", "at_least_once"),
             n_shards=raw.get("nShards", 0),
             mesh_rebalance=raw.get("meshRebalance", True),
+            mesh_reshard_schedule=raw.get("meshReshardSchedule", []),
         )
 
 

@@ -415,6 +415,10 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if engine == "QueryChurn":
         return run_query_churn_cell(cfg, window_spec, agg_name, obs=obs)
 
+    if engine == "QueryChurnMesh":
+        return run_query_churn_mesh_cell(cfg, window_spec, agg_name,
+                                         obs=obs)
+
     raise ValueError(f"unknown engine {engine!r}")
 
 
@@ -636,6 +640,226 @@ def run_query_churn_cell(cfg: BenchmarkConfig, window_spec: str,
         for i, cmds in enumerate(schedule) for cmd in cmds]
     res.churn_seed = int(cfg.seed)
     finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
+    return res
+
+
+def run_query_churn_mesh_cell(cfg: BenchmarkConfig, window_spec: str,
+                              agg_name: str,
+                              obs: Optional[_obs.Observability] = None
+                              ) -> BenchResult:
+    """Mesh-serving churn cell (ISSUE 13): the seeded churn schedule
+    registers/cancels >= ``churnOps`` windows MID-STREAM against a
+    :class:`scotty_tpu.mesh_serving.MeshQueryService` — ``nKeys``
+    logical keys over ``nShards`` device shards — while
+    ``meshReshardSchedule`` drives live checkpoint-boundary reshards
+    under a Supervisor with an exactly-once TransactionalSink tagging
+    every per-query global emission ``(epoch, seq)``.
+
+    Recorded contract:
+
+    * ``serving_retraces_after_warmup`` — trace-counter-reconciled
+      steady-state retraces (the zero-retrace acceptance), with the
+      compiles a reshard's genuinely-new mesh forces itemized apart as
+      ``reshard_retraces``;
+    * ``reshard_timeline`` — each live reshard's from/to/interval/wall;
+    * ``oracle_match`` — unless ``churnOracle`` is off, every live
+      query's emissions (psum-folded global AND sampled per-key rows)
+      bit-compared against an always-active superset service replaying
+      the SAME reshard schedule (equal shard-count phases make the psum
+      reduction trees identical, so equality is exact);
+    * ``delivery_tags_unique`` — no ``(epoch, seq)`` tag delivered
+      twice across the whole churned, resharded run;
+    * aggregate throughput over the churn loop, reshard wall time
+      excluded and reported separately (``platform``/``host_cores``
+      recorded — the >=6x mesh scaling number stays a TPU-box cert per
+      the PR 5/7/10 discipline).
+    """
+    import os as _os
+    import tempfile
+
+    import jax
+
+    from ..delivery import EXACTLY_ONCE, TransactionalSink
+    from ..engine import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+    from ..mesh_serving import MeshQueryService
+    from ..resilience import ManualClock, Supervisor
+    from ..serving import QueryAdmission, replay_schedule
+    from ..serving.cache import pad_pow2
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    P = cfg.watermark_period_ms
+    g = AlignedStreamPipeline.slice_grid(windows, P)
+    max_size = max([4 * P] + [int(w.size) for w in windows])
+    pool = _churn_pool(windows, g, P, max_size)
+    lanes = max(P // int(getattr(w, "slide", w.size)) + 2
+                for w in pool + windows)
+    n_shards = cfg.n_shards or len(jax.devices())
+    K = int(cfg.n_keys)
+    econf = EngineConfig(capacity=cfg.capacity, annex_capacity=8,
+                         min_trigger_pad=32)
+    n_timed = max(4, cfg.runtime_s)
+    schedule, n_ops, n_regs = _churn_schedule(cfg, pool, n_timed,
+                                              len(windows))
+    warmup = max_size // P + 2
+    reshard_at = {int(i): int(m) for i, m in cfg.mesh_reshard_schedule}
+    for m in reshard_at.values():
+        if K % m:
+            raise ValueError(
+                f"meshReshardSchedule: nKeys {K} is not a multiple of "
+                f"shard count {m}")
+
+    def build(max_queries: int, min_slots: int) -> MeshQueryService:
+        return MeshQueryService(
+            [make_aggregation(agg_name)], slice_grid=g,
+            max_window_size=max_size, n_keys=K, n_shards=n_shards,
+            throughput=cfg.throughput, wm_period_ms=P,
+            max_lateness=cfg.max_lateness, seed=cfg.seed, config=econf,
+            admission=QueryAdmission(max_queries=max_queries),
+            windows=windows, min_slots=min_slots,
+            min_trigger_lanes=pad_pow2(lanes, 4))
+
+    sample_keys = sorted({0, K // 3, K - 1})
+
+    svc = build(cfg.churn_max_active,
+                pad_pow2(cfg.churn_max_active, 8))
+    svc.run(warmup, collect=False)
+    svc.sync()
+    svc.mark_warm()
+    if obs is not None:
+        svc.set_observability(obs)
+        obs.registry.reset_clock()
+    # TemporaryDirectory, not mkdtemp: at 64 K keys each committed
+    # bundle is 100s of MB, and the live + oracle reshards commit
+    # several — cleanup() runs on the success path below and the
+    # finalizer reclaims the error path, so repeated bench runs cannot
+    # fill /tmp with checkpoint bundles
+    tmpdir = tempfile.TemporaryDirectory(prefix="mesh_churn_ck_")
+    tmp = tmpdir.name
+    sup = Supervisor(_os.path.join(tmp, "ck"), clock=ManualClock(),
+                     seed=cfg.seed, obs=obs)
+    tags: list = []
+    sink = TransactionalSink(mode=EXACTLY_ONCE, obs=obs,
+                             deliver=lambda it, e, s: tags.append((e, s)))
+    sup.sink = sink
+
+    handles: dict = {}
+    per_interval = []          # (slot_map, global rows, sampled key rows)
+    reshard_wall_s = 0.0
+    t0 = time.perf_counter()
+    for i, cmds in enumerate(schedule):
+        if i in reshard_at and svc.n_shards != reshard_at[i]:
+            row = svc.reshard(reshard_at[i], sup, pos=svc.interval)
+            reshard_wall_s += row["wall_ms"] / 1e3
+        replay_schedule(svc, cmds, handles)
+        out = svc.run(1)[0]
+        g_rows = svc.global_rows_by_slot(out)
+        k_rows = {k: svc.key_rows_by_slot(out, k) for k in sample_keys}
+        slot_map = {rid: h.slot for rid, h in handles.items()}
+        per_interval.append((slot_map, g_rows, k_rows))
+        for rid in sorted(slot_map):
+            sink.emit((i, rid,
+                       tuple(map(tuple, g_rows.get(slot_map[rid], ())))))
+    svc.sync()
+    wall = time.perf_counter() - t0 - reshard_wall_s
+    svc.check_overflow()
+    retraces = svc.retraces_since_warm
+    n_tuples = n_timed * svc.pipeline.tuples_per_interval
+    if obs is not None:
+        obs.registry.stop_clock()
+        svc.set_observability(None)
+
+    # drained emit-latency samples on the live churned query set
+    lats = []
+    t_lat = time.perf_counter()
+    for _ in range(LATENCY_SAMPLES_MAX):
+        svc.sync()
+        t1 = time.perf_counter()
+        out = svc.run(1)[0]
+        svc.pipeline.lowered_global(out)
+        lats.append((time.perf_counter() - t1) * 1e3)
+        if (len(lats) >= LATENCY_SAMPLES_MIN
+                and time.perf_counter() - t_lat > LATENCY_BUDGET_S):
+            break
+    svc.check_overflow()
+    emitted = sum(sum(len(rows) for rows in gr.values())
+                  for (_sm, gr, _kr) in per_interval)
+
+    oracle_match = None
+    if cfg.churn_oracle:
+        # superset oracle: every scheduled registration active from the
+        # start, replaying the SAME reshard schedule (equal shard-count
+        # phases => identical psum trees => exact equality demanded)
+        oracle = build(n_regs + len(windows) + 1,
+                       pad_pow2(n_regs + len(windows), 8))
+        ohandles: dict = {}
+        for cmds in schedule:
+            for cmd in cmds:
+                if cmd[0] == "register":
+                    _, rid, w, tenant = cmd
+                    ohandles[rid] = oracle.register(w, tenant=tenant)
+        oracle.run(warmup, collect=False)
+        oracle.sync()
+        osup = Supervisor(_os.path.join(tmp, "ock"), clock=ManualClock(),
+                          seed=cfg.seed)
+        oracle_match = True
+        for i in range(n_timed):
+            if i in reshard_at and oracle.n_shards != reshard_at[i]:
+                oracle.reshard(reshard_at[i], osup, pos=oracle.interval)
+            out = oracle.run(1)[0]
+            og = oracle.global_rows_by_slot(out)
+            okr = {k: oracle.key_rows_by_slot(out, k)
+                   for k in sample_keys}
+            slot_map, g_rows, k_rows = per_interval[i]
+            for rid, slot in slot_map.items():
+                oslot = ohandles[rid].slot
+                if g_rows.get(slot) != og.get(oslot):
+                    oracle_match = False
+                    break
+                for k in sample_keys:
+                    if k_rows[k].get(slot) != okr[k].get(oslot):
+                        oracle_match = False
+                        break
+                if not oracle_match:
+                    break
+            if not oracle_match:
+                break
+        oracle.check_overflow()
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=float(np.percentile(lats, 99)) if lats else 0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    res.n_lat_samples = len(lats)
+    res.p50_emit_ms = float(np.percentile(lats, 50)) if lats else 0.0
+    res.emit_ms_device = wall / n_timed * 1e3
+    stats = svc.stats()
+    res.serving_retraces_after_warmup = int(retraces)
+    res.reshard_retraces = int(stats["reshard_retraces"])
+    res.reshard_timeline = list(svc.reshard_timeline)
+    res.reshard_wall_s = round(reshard_wall_s, 3)
+    res.serving_registered = int(stats.get("serving_registered", 0))
+    res.serving_cancelled = int(stats.get("serving_cancelled", 0))
+    res.serving_rejected = int(stats.get("serving_rejected", 0))
+    res.serving_cache_hits = int(stats.get("serving_cache_hits", 0))
+    res.churn_ops = int(n_ops)
+    res.n_keys = K
+    res.n_shards = int(n_shards)
+    res.platform = jax.devices()[0].platform
+    res.host_cores = _os.cpu_count()
+    res.delivery_mode = EXACTLY_ONCE
+    res.delivery_tags_unique = bool(len(tags) == len(set(tags)))
+    res.delivery_snapshot = sink.snapshot()
+    if oracle_match is not None:
+        res.oracle_match = bool(oracle_match)
+    res.churn_schedule = [
+        ([i, "r", cmd[1], str(cmd[2]), cmd[3]] if cmd[0] == "register"
+         else [i, "c", cmd[1]])
+        for i, cmds in enumerate(schedule) for cmd in cmds]
+    res.churn_seed = int(cfg.seed)
+    finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
+    tmpdir.cleanup()
     return res
 
 
@@ -2488,7 +2712,9 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                               "delivery_overhead_pct_median",
                               "n_keys", "n_shards", "host_cores",
                               "tuples_per_sec_1shard", "scaling_ratio",
-                              "per_shard_occupancy", "rebalance_match"):
+                              "per_shard_occupancy", "rebalance_match",
+                              "reshard_retraces", "reshard_timeline",
+                              "reshard_wall_s", "delivery_tags_unique"):
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)

@@ -1,0 +1,32 @@
+"""Multi-tenant mesh serving + elastic reshard (ISSUE 13).
+
+One service from millions of keys to millions of queries: the dynamic
+query-serving layer (scotty_tpu.serving, PR 6) fused into the
+mesh-sharded keyed step (scotty_tpu.mesh, PR 10), with the shard count
+itself elastic at checkpoint boundaries.
+
+* :class:`MeshServingPipeline` — the fused ``shard_map`` step whose
+  window set is a replicated :class:`~scotty_tpu.engine.pipeline.
+  QuerySlots` table in the donated carry; per-key AND psum-folded
+  global answers per query, zero steady-state retraces.
+* :class:`MeshQueryService` — the control plane: shard-aware admission
+  with tenant home-shard affinity, generation-checked handles, the
+  query table checkpointed atomically alongside mesh state, and
+  :meth:`~MeshQueryService.reshard` — grow/shrink the shard count
+  mid-stream through one atomic verified bundle.
+* :func:`run_supervised_mesh` — the supervised exactly-once driver the
+  crash-point fuzzer certifies and the demo/bench reuse.
+"""
+
+from .pipeline import MeshServingPipeline
+from .service import MeshQueryService, tenant_home_shard
+from .supervised import apply_churn, run_supervised_mesh, shards_scheduled
+
+__all__ = [
+    "MeshServingPipeline",
+    "MeshQueryService",
+    "tenant_home_shard",
+    "run_supervised_mesh",
+    "apply_churn",
+    "shards_scheduled",
+]

@@ -1,0 +1,609 @@
+"""MeshServingPipeline: the dynamic-query serving step under shard_map.
+
+The fusion ISSUE 13 names: PR 6's serving machinery (a ``[Q]``
+window-parameter table + active mask carried in the jitted step's
+donated state, trigger rows enumerated from table DATA so register/
+cancel never retraces) composed with PR 10's mesh execution (keys
+sharded over the mesh axis, donated carries, in-executable psum global
+folds, routing-table row attribution, shard-count-portable canonical
+checkpoints). One step answers every active query twice per interval:
+
+* **per key** — the per-shard vmapped range query over that shard's
+  ``K // n_shards`` rows, exactly the MeshKeyedPipeline contract but
+  with the trigger rows read from the carried
+  :class:`~scotty_tpu.engine.pipeline.QuerySlots`;
+* **global** — all-keys window totals folded with ``psum``/``pmin``/
+  ``pmax`` INSIDE the executable (the ``parallel/global_op.py`` seam,
+  ``mesh/engine.py`` ``query_global``'s in-step twin).
+
+Carry layout: ``{"buf": SliceBufferState[K, ...], "keys": i32[K]}``
+sharded over the key axis, plus the :class:`QuerySlots` table
+REPLICATED across shards (``PartitionSpec()``) — every shard reads the
+same query set, so a register/cancel is ONE replicated row write
+through the shared jitted writer, and the whole carry (buf, keys, AND
+table) is donated: steady state moves zero extra bytes for the table.
+
+The engine state is query-set independent (the keyed generator fills
+every slice row regardless), so a query registered mid-stream
+immediately answers windows over slices ingested before it existed —
+shared slicing at mesh scale, the property the always-active
+superset-replay oracle (tests/test_mesh_serving.py) rests on.
+
+Elasticity contract: :meth:`save` writes the canonical LOGICAL-key-order
+snapshot (``utils/checkpoint.py save_mesh_state``), so a bundle saved
+under N shards restores under M (the reshard path
+:class:`~scotty_tpu.mesh_serving.service.MeshQueryService` drives at
+checkpoint boundaries); the generated stream is a pure function of
+``(seed, interval, logical key)``, so 8-shard, 4-shard, post-reshard and
+post-rebalance runs all BIT-MATCH.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.aggregates import AggregateFunction
+from ..engine.config import EngineConfig
+from ..engine.pipeline import (
+    FusedPipelineDriver,
+    QuerySlots,
+    SlotGeometry,
+    build_slot_trigger_grid,
+)
+from ..mesh.engine import _mesh_token, _shard_map, make_row_permuter
+from ..mesh.routing import RoutingTable
+
+#: jitted (step, gc) per (geometry, aggs, shapes, mesh, trace-cell id)
+#: — a service's reshard walk (8→4→8) re-enters warm buckets without
+#: retracing; the cell id isolates services so one service's trace
+#: accounting can never observe another's executions. BOUNDED, unlike
+#: the mesh kernel caches it parallels: the per-service keying means a
+#: long-lived process churning services would otherwise accumulate
+#: compiled shard_map executables forever (eviction only drops the
+#: warm-re-entry shortcut — live pipelines hold their own step refs)
+_SERVING_STEP_CACHE: dict = {}
+_SERVING_STEP_CACHE_CAP = 64
+
+
+def _cache_put(key, value) -> None:
+    _SERVING_STEP_CACHE[key] = value
+    while len(_SERVING_STEP_CACHE) > _SERVING_STEP_CACHE_CAP:
+        _SERVING_STEP_CACHE.pop(next(iter(_SERVING_STEP_CACHE)))
+
+
+class MeshServingPipeline(FusedPipelineDriver):
+    """Fused mesh pipeline whose window set is the carried query table
+    (module docstring). Constructed by
+    :class:`~scotty_tpu.mesh_serving.service.MeshQueryService`; direct
+    construction is the differential tests' oracle path.
+    """
+
+    def __init__(self, aggregations: Sequence[AggregateFunction], *,
+                 query_slots: SlotGeometry, n_keys: int,
+                 n_shards: Optional[int] = None,
+                 config: Optional[EngineConfig] = None,
+                 throughput: int = 64_000_000, wm_period_ms: int = 1000,
+                 max_lateness: int = 1000, seed: int = 0,
+                 gc_every: int = 8, max_chunk_elems: int = 1 << 24,
+                 value_scale: float = 10_000.0, mesh=None,
+                 axis: str = "keys", trace_cell: Optional[list] = None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..engine import core as ec
+        from ..engine.pipeline import draw_uniform16
+
+        if mesh is not None:
+            n_shards = mesh.devices.size
+        elif n_shards is None:
+            n_shards = len(jax.devices())
+        if mesh is None:
+            from ..parallel import make_mesh
+
+            mesh = make_mesh(axis, n_devices=n_shards)
+        self.mesh, self.axis = mesh, axis
+        self.n_shards = int(n_shards)
+        self.config = config or EngineConfig()
+        self.aggregations = list(aggregations)
+        self.n_keys = K = int(n_keys)
+        self.routing = RoutingTable(K, self.n_shards)
+        self.wm_period_ms = P_ms = int(wm_period_ms)
+        self.max_lateness = int(max_lateness)
+        self.gc_every = gc_every
+        self.seed = seed
+        self.value_scale = float(value_scale)
+        #: shared mutable jit-trace counter (cell[0]): the serving layer
+        #: reads it ACROSS reshard-rebuilt pipelines, so it is a cell the
+        #: step closures capture, not a per-pipeline attribute
+        self._trace_cell = trace_cell if trace_cell is not None else [0]
+
+        g = int(query_slots.slice_grid)
+        if P_ms % g:
+            raise ValueError(
+                f"SlotGeometry.slice_grid {g} must divide wm_period_ms "
+                f"{P_ms}")
+        self._query_slots = query_slots
+        self._qs_host = None
+        # GC retention is the ADMISSION bound, not any live window's
+        # size: slices must survive long enough for any query registered
+        # later (the shared-slicing property)
+        self.max_fixed = int(query_slots.max_size)
+
+        aggs = tuple(a.device_spec() for a in self.aggregations)
+        if any(a is None for a in aggs):
+            raise NotImplementedError(
+                "mesh serving pipeline: device-realizable aggregations "
+                "only")
+        per_key = throughput // K
+        R = per_key * g // 1000
+        if R < 1:
+            raise ValueError(
+                f"throughput {throughput} too low: <1 tuple/slice/key at "
+                f"{K} keys on a {g} ms grid")
+        S = P_ms // g
+        self.grid, self.R, self.S = g, R, S
+        self.tuples_per_interval = K * S * R
+
+        spec = ec.EngineSpec(periods=(g,), bands=(), count_periods=(),
+                             aggs=aggs)
+        self.spec = spec
+        C, A = self.config.capacity, self.config.annex_capacity
+        self._query1 = ec.build_query(spec, C, A)
+        self._gc1 = ec.build_gc(spec, C, A)
+
+        # chunking bounds the [Kl, S, Rc, width] lift temporary per shard
+        max_width = max(1 if a.is_sparse else a.width for a in aggs)
+        n_chunks = 1
+        while (K * S * (R // n_chunks) * max_width) > max_chunk_elems \
+                and n_chunks < R:
+            n_chunks += 1
+        while R % n_chunks:
+            n_chunks += 1
+        self._n_chunks, self._rc = n_chunks, R // n_chunks
+
+        sharding = NamedSharding(mesh, P(axis))
+        self._sharding = sharding
+        self._qs_sharding = NamedSharding(mesh, P())
+        self._permute_fn = None
+        self._write_slot_fn = None
+        self._root = None
+        self.state = None
+        self._qstate = None
+        self._interval = 0
+
+        self._build_step()
+
+        def init_buf():
+            one = ec.init_state(spec, C, A)
+            buf = jax.tree.map(
+                lambda x: jnp.broadcast_to(x, (K,) + x.shape), one)
+            kids = jnp.asarray(self.routing.key_at, jnp.int32)
+            return jax.device_put({"buf": buf, "keys": kids}, sharding)
+
+        self._init_buf = init_buf
+        # draw_uniform16 is closed over by _build_step via gen_chunk;
+        # keep a handle for the host replay face
+        self._draw = draw_uniform16
+
+    # -- the fused step (cached per geometry bucket + mesh) -----------------
+    def _build_step(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..engine.pipeline import draw_uniform16
+
+        geometry = self._query_slots
+        aggs = self.spec.aggs
+        K, S, R = self.n_keys, self.S, self.R
+        g, P_ms = self.grid, self.wm_period_ms
+        C = self.config.capacity
+        n_chunks, Rc = self._n_chunks, self._rc
+        value_scale = self.value_scale
+        query1 = self._query1
+        gc1 = self._gc1
+        first_lw = max(0, P_ms - self.max_lateness)
+        cell = self._trace_cell
+
+        cache_key = (
+            (geometry.n_slots, geometry.triggers_per_slot,
+             geometry.slice_grid, geometry.max_size),
+            tuple(ag.token for ag in aggs), K,
+            C, self.config.annex_capacity, R, S, g, P_ms,
+            self.max_lateness, value_scale, n_chunks, Rc,
+            _mesh_token(self.mesh, self.axis), id(cell))
+        hit = _SERVING_STEP_CACHE.get(cache_key)
+        make_triggers, self.T = build_slot_trigger_grid(geometry, P_ms)
+        self._make_triggers = make_triggers
+        #: whether this bucket's executable was already warm — the
+        #: reshard retrace accounting reads it: a fresh closure traces
+        #: exactly once on its first call, a cached one never does
+        self._step_was_cached = hit is not None
+        if hit is not None:
+            self._step, self._gc_fn = hit
+            return
+
+        red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
+        coll = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                "max": jax.lax.pmax}
+        shard_map = _shard_map()
+        a_name = self.axis
+        mesh = self.mesh
+
+        def gen_chunk(kg, kids):
+            """[Kl, S, Rc] values for one chunk, threefry keyed by the
+            LOGICAL key id — identical under any shard count, routing,
+            rebalance or reshard (the invariance every differential and
+            the reshard contract rest on; same keying discipline as
+            MeshKeyedPipeline)."""
+            keys_k = jax.vmap(lambda kid: jax.random.fold_in(
+                kg, kid.astype(jnp.uint32)))(kids)
+            return jax.vmap(
+                lambda k: draw_uniform16(k, (S, Rc), value_scale))(keys_k)
+
+        def shard_body(state, qs, key, interval_idx):
+            # host-side trace counter: this body runs once per jit
+            # TRACE (the serving layer's zero-retrace contract reads
+            # it); no traced ops — the emitted HLO is unchanged
+            cell[0] += 1
+            buf, kids = state["buf"], state["keys"]
+            Kl = kids.shape[0]
+            base = interval_idx * P_ms
+
+            def body(parts_c, c):
+                vals = gen_chunk(jax.random.fold_in(key, c), kids)
+                flat = vals.reshape(-1)
+                new_parts = []
+                for aspec, acc in zip(aggs, parts_c):
+                    if aspec.is_sparse:
+                        col, v = aspec.lift_sparse(flat)
+                        row_id = jnp.arange(Kl * S * Rc,
+                                            dtype=jnp.int32) // Rc
+                        fi = row_id * aspec.width + col.astype(jnp.int32)
+                        tgt = jnp.full((Kl * S * aspec.width,),
+                                       aspec.identity, jnp.float32)
+                        if aspec.kind == "sum":
+                            tgt = tgt.at[fi].add(v)
+                        elif aspec.kind == "min":
+                            tgt = tgt.at[fi].min(v)
+                        else:
+                            tgt = tgt.at[fi].max(v)
+                        upd = tgt.reshape(Kl, S, aspec.width)
+                    else:
+                        lifted = aspec.lift_dense(flat) \
+                            .reshape(Kl, S, Rc, -1)
+                        upd = red[aspec.kind](lifted, axis=2)
+                    if aspec.kind == "sum":
+                        new_parts.append(acc + upd)
+                    elif aspec.kind == "min":
+                        new_parts.append(jnp.minimum(acc, upd))
+                    else:
+                        new_parts.append(jnp.maximum(acc, upd))
+                return tuple(new_parts), None
+
+            init = tuple(jnp.full((Kl, S, ag.width), ag.identity,
+                                  jnp.float32) for ag in aggs)
+            parts, _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+
+            row_starts = base + g * jnp.arange(S, dtype=jnp.int64)
+            n = buf.n_slices                                  # [Kl] i32
+
+            def app1(b, rows, nn):
+                idx = (nn,) + (jnp.int32(0),) * (b.ndim - 1)
+                return jax.lax.dynamic_update_slice(
+                    b, rows.astype(b.dtype), idx)
+
+            app = jax.vmap(app1)
+            rs_k = jnp.broadcast_to(row_starts, (Kl, S))
+            buf = buf._replace(
+                starts=app(buf.starts, rs_k, n),
+                ends=app(buf.ends, rs_k + g, n),
+                t_first=app(buf.t_first, rs_k, n),
+                t_last=app(buf.t_last, rs_k + (g - 1), n),
+                c_start=app(buf.c_start, buf.current_count[:, None]
+                            + R * jnp.arange(S, dtype=jnp.int64)[None, :],
+                            n),
+                counts=app(buf.counts, jnp.full((Kl, S), R, jnp.int64),
+                           n),
+                partials=tuple(app(p, pr, n)
+                               for p, pr in zip(buf.partials, parts)),
+                n_slices=n + S,
+                max_event_time=jnp.maximum(
+                    buf.max_event_time, rs_k[:, -1] + (g - 1)),
+                current_count=buf.current_count + S * R,
+                overflow=buf.overflow | (n + S > C),
+            )
+            last_wm = jnp.where(interval_idx > 0, base, jnp.int64(first_lw))
+            # trigger rows are TABLE DATA: registering or cancelling a
+            # query changes qs, never this program — the zero-retrace
+            # property, now replicated across every shard
+            ws, we, tmask = make_triggers(qs, last_wm, base + P_ms)
+            cnt, results = jax.vmap(
+                query1, in_axes=(0, None, None, None, None))(
+                buf, ws, we, tmask, jnp.zeros_like(tmask))
+            # the cross-shard fold: all-keys window totals per query
+            # trigger row INSIDE the executable (psum over ICI on a real
+            # mesh) — the global_op.py seam serving the dynamic set
+            gcnt = jax.lax.psum(jnp.sum(cnt, axis=0), a_name)
+            gparts = tuple(
+                coll[ag.kind](red[ag.kind](r, axis=0), a_name)
+                for ag, r in zip(aggs, results))
+            return ({"buf": buf, "keys": kids}, qs,
+                    (ws, we, cnt, results, gcnt, gparts))
+
+        Pa = P(a_name)
+        state_spec = {"buf": Pa, "keys": Pa}
+        qs_spec = QuerySlots(P(), P(), P(), P())
+        hit = (
+            jax.jit(shard_map(
+                shard_body, mesh=mesh,
+                in_specs=(state_spec, qs_spec, P(), P()),
+                out_specs=(state_spec, qs_spec,
+                           (P(), P(), Pa, Pa, P(), P()))),
+                donate_argnums=(0, 1)),
+            jax.jit(shard_map(
+                lambda st, b: {"buf": jax.vmap(
+                    gc1, in_axes=(0, None))(st["buf"], b),
+                    "keys": st["keys"]},
+                mesh=mesh, in_specs=(state_spec, P()),
+                out_specs=state_spec),
+                donate_argnums=0),
+        )
+        _cache_put(cache_key, hit)
+        self._step, self._gc_fn = hit
+
+    @property
+    def _trace_count(self) -> int:
+        return self._trace_cell[0]
+
+    # -- driver hooks -------------------------------------------------------
+    def _init_pipeline_state(self) -> None:
+        self.state = self._init_buf()
+        self._qstate = self._upload_qs(self._qs_host)
+
+    def _upload_qs(self, rows: Optional[dict]):
+        import jax
+        import jax.numpy as jnp
+
+        Q = self._query_slots.n_slots
+        if rows is None:
+            kinds = np.zeros((Q,), np.int32)
+            grids = np.ones((Q,), np.int64)
+            sizes = np.ones((Q,), np.int64)
+            active = np.zeros((Q,), bool)
+        else:
+            kinds = np.asarray(rows["kinds"], np.int32)
+            grids = np.asarray(rows["grids"], np.int64)
+            sizes = np.asarray(rows["sizes"], np.int64)
+            active = np.asarray(rows["active"], bool)
+            if kinds.shape != (Q,):
+                raise ValueError(
+                    f"query-table rows have {kinds.shape[0]} slots, "
+                    f"geometry expects {Q}")
+        # REPLICATED across the mesh: every shard reads the same table
+        dev = jax.device_put(
+            (jnp.asarray(kinds), jnp.asarray(grids), jnp.asarray(sizes),
+             jnp.asarray(active)), self._qs_sharding)
+        return QuerySlots(*dev)
+
+    def _step_interval(self, key, i: int):
+        import jax
+
+        iv = jax.device_put(np.int64(i))
+        self.state, self._qstate, res = self._step(
+            self.state, self._qstate, key, iv)
+        return res
+
+    def _gc(self, bound) -> None:
+        self.state = self._gc_fn(self.state, bound)
+
+    def _sync_anchor(self):
+        return self.state["buf"].n_slices[0]
+
+    def check_overflow(self) -> None:
+        import jax
+
+        if bool(np.any(jax.device_get(self.state["buf"].overflow))):
+            raise RuntimeError(
+                "slice buffer overflow on some key shard: raise capacity "
+                "or gc more often")
+
+    # -- the control path (one shared jitted row writer) --------------------
+    def set_query_rows(self, rows: Optional[dict]) -> None:
+        """Bind the HOST mirror of the query table (held by reference —
+        the serving layer's QueryTable rows). ``reset()`` and checkpoint
+        restores re-upload from this mirror, so a restore replays the
+        exact active query set at the new shard count."""
+        self._qs_host = rows
+        if getattr(self, "_pipeline_ready", False):
+            self._qstate = self._upload_qs(rows)
+
+    def write_query_slot(self, slot: int, kind: int, grid: int, size: int,
+                         active: bool) -> None:
+        """One replicated row write — the register/cancel hot path
+        routed through the mesh control path. Slot and parameters are
+        traced arguments, so every write (any slot, any window, any
+        tenant) reuses ONE compiled executable; the table is donated and
+        updated in place on every shard's replica."""
+        import jax
+
+        if self._qstate is None:
+            self.reset()
+        if self._write_slot_fn is None:
+            qs_sh = jax.tree.map(lambda _: self._qs_sharding, self._qstate)
+
+            def w(qs, i, kind, grid, size, act):
+                return QuerySlots(
+                    kinds=qs.kinds.at[i].set(kind),
+                    grids=qs.grids.at[i].set(grid),
+                    sizes=qs.sizes.at[i].set(size),
+                    active=qs.active.at[i].set(act))
+
+            self._write_slot_fn = jax.jit(w, donate_argnums=0,
+                                          out_shardings=qs_sh)
+        self._qstate = self._write_slot_fn(
+            self._qstate, np.int32(slot), np.int32(kind), np.int64(grid),
+            np.int64(size), np.bool_(active))
+
+    def set_slot_geometry(self, geometry: SlotGeometry) -> None:
+        """Rebuild the step at a new slot-grid bucket (a counted retrace
+        unless the bucket is already warm in the module cache). The
+        carried slice state is untouched — its shapes are independent of
+        the query set — so a rebucket continues the stream exactly."""
+        if int(geometry.slice_grid) != self.grid:
+            raise ValueError(
+                f"slot-geometry slice grid {geometry.slice_grid} != the "
+                f"pipeline's aligned grid {self.grid}: the slice grid is "
+                "state-shaping and cannot change at a rebucket")
+        if int(geometry.max_size) != self.max_fixed:
+            raise ValueError(
+                "SlotGeometry.max_size is the GC retention bound and "
+                "cannot change at a rebucket")
+        self._query_slots = geometry
+        self._build_step()
+
+    def compiled_step(self):
+        """(step, gc, make_triggers, T, geometry) — what the serving
+        compile cache stores per bucket."""
+        return (self._step, self._gc_fn, self._make_triggers, self.T,
+                self._query_slots)
+
+    def adopt_compiled_step(self, entry) -> None:
+        """Re-enter a previously compiled bucket (cache hit): swap the
+        jitted step back in without building a fresh closure — reuses
+        the warm executable, traces nothing."""
+        step, gc_fn, make_triggers, T, geometry = entry
+        if int(geometry.slice_grid) != self.grid:
+            raise ValueError("cached bucket was built for a different "
+                             "slice grid")
+        self._step = step
+        self._gc_fn = gc_fn
+        self._make_triggers = make_triggers
+        self.T = T
+        self._query_slots = geometry
+
+    # -- rebalance (checkpoint boundaries only) -----------------------------
+    def rebalance(self, swaps: Sequence[Tuple[int, int]]) -> None:
+        """Permute the carried rows to a swapped routing table (the
+        MeshKeyedPipeline contract: one jitted gather, logical-key-id
+        generation makes subsequent emissions bit-identical). Call at
+        checkpoint boundaries only — concurrent with query churn is fine
+        (the table is replicated, not row-permuted)."""
+        if not swaps:
+            return
+        if self.state is None:
+            raise RuntimeError("pipeline not started")
+        new_table = self.routing.swapped(list(swaps))
+        perm = new_table.permutation_from(self.routing)
+        if self._permute_fn is None:
+            self._permute_fn = make_row_permuter(self.state,
+                                                 self._sharding)
+        self.state = self._permute_fn(self.state, perm)
+        self.routing = new_table
+
+    # -- checkpoint (canonical logical order; shard-count-portable) --------
+    def save(self, path: str) -> None:
+        from ..utils.checkpoint import save_mesh_state
+
+        if self.state is None or self._root is None:
+            raise ValueError("pipeline not started; nothing to checkpoint")
+        save_mesh_state(self.state["buf"], self.routing, path, {
+            "pipeline": type(self).__name__,
+            "interval": int(self._interval), "seed": int(self.seed),
+            "root": np.asarray(self._root).tolist(),
+        })
+
+    def restore(self, path: str, verify: bool = True) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..utils.checkpoint import load_mesh_state
+
+        self.reset()
+        tree, meta = load_mesh_state(path, self.state["buf"], self.routing,
+                                     verify=verify)
+        if int(self.seed) != meta["seed"]:
+            raise ValueError("seed mismatch: the restored stream would "
+                             "differ")
+        self.state = jax.device_put(
+            {"buf": tree, "keys": jnp.asarray(self.routing.key_at,
+                                              jnp.int32)},
+            self._sharding)
+        self._interval = meta["interval"]
+        self._root = jnp.asarray(np.asarray(meta["root"], np.uint32))
+
+    # -- host replay + result attribution ----------------------------------
+    def materialize_interval(self, i: int, key_idx: int):
+        """Regenerate LOGICAL key ``key_idx``'s interval-i stream on host
+        (testing): (vals f32, ts i64) — bit-identical to the device
+        generator under any shard count, routing, or reshard."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._root is None:
+            self._root = jax.random.PRNGKey(self.seed)
+        key = self._interval_key(i)
+        vals_all, ts_all = [], []
+        row_starts = i * self.wm_period_ms \
+            + self.grid * np.arange(self.S, dtype=np.int64)
+        for c in range(self._n_chunks):
+            kk = jax.random.fold_in(
+                jax.random.fold_in(key, jnp.int64(c)),
+                jnp.uint32(key_idx))
+            vals = np.asarray(jax.device_get(self._draw(
+                kk, (self.S, self._rc), self.value_scale)))
+            vals_all.append(vals.reshape(-1))
+            ts_all.append(np.broadcast_to(
+                row_starts[:, None], (self.S, self._rc)).reshape(-1))
+        return np.concatenate(vals_all), np.concatenate(ts_all)
+
+    def per_key_columns(self, interval_out, key_idx: int):
+        """One LOGICAL key's trigger columns ``(ws, we, cnt, [per-agg
+        lowered [T]])`` — a device row-gather BEFORE the fetch, so
+        sampling a few keys of a 64 K-key cell never pulls the full
+        ``[K, T]`` result block to host."""
+        import jax
+
+        ws_d, we_d, cnt_d, results_d = interval_out[:4]
+        r = int(self.routing.row_of[key_idx])
+        ws, we, cnt_k, res_k = jax.device_get(
+            (ws_d, we_d, cnt_d[r], [res[r] for res in results_d]))
+        lowered = [np.asarray(agg.device_spec().lower(rk, cnt_k))
+                   for agg, rk in zip(self.aggregations, res_k)]
+        return ws, we, cnt_k, lowered
+
+    def lowered_results_for_key(self, interval_out, key_idx: int) -> list:
+        """Non-empty window rows for one LOGICAL key (row attribution
+        through the routing table)."""
+        ws, we, cnt_k, lowered = self.per_key_columns(interval_out,
+                                                     key_idx)
+        rows = []
+        for i in range(ws.shape[0]):
+            if cnt_k[i] > 0:
+                rows.append((int(ws[i]), int(we[i]), int(cnt_k[i]),
+                             [lw[i] for lw in lowered]))
+        return rows
+
+    def lowered_global(self, interval_out):
+        """The interval's cross-shard global fold columns ``(ws, we,
+        gcnt, [per-agg lowered [T]])`` — the psum seam's host face, one
+        tiny ``[T]`` fetch per interval."""
+        import jax
+
+        ws, we = jax.device_get(interval_out[:2])
+        gcnt, gparts = jax.device_get(interval_out[4:6])
+        lowered = [np.asarray(agg.device_spec().lower(gp, gcnt))
+                   for agg, gp in zip(self.aggregations, gparts)]
+        return ws, we, gcnt, lowered
+
+    def shard_occupancy(self) -> np.ndarray:
+        """Per-shard mean live-slice occupancy (drain-point read)."""
+        import jax
+
+        n = np.asarray(jax.device_get(self.state["buf"].n_slices)).reshape(
+            self.n_shards, self.routing.rows_per_shard)
+        return n.astype(np.float64).mean(axis=1) / float(
+            self.config.capacity)

@@ -1,0 +1,172 @@
+"""Supervised exactly-once driving of a MeshQueryService.
+
+The loop every reshard story runs through — the crash-point fuzzer
+(tests/test_mesh_serving_crash.py), the churn bench's delivery audit
+and the multichip demo all drive THIS function, so the recovery path
+the fuzzer certifies is the path production uses:
+
+* per interval: apply the scheduled churn (register / cancel-one
+  commands resolved against the AUTHORITATIVE table, so a replayed
+  restart resolves them identically), run one fused step, and hand each
+  active slot's psum-folded global rows to the
+  :class:`~scotty_tpu.delivery.sink.TransactionalSink` — every emission
+  ``(epoch, seq)``-tagged, replay duplicates suppressed exactly;
+* at scheduled boundaries: commit an atomic checkpoint (mesh state in
+  canonical logical order + query table + sink ledger, one manifest,
+  one rename) and/or reshard to the scheduled shard count;
+* on any failure: ``Supervisor.handle_failure`` (backoff, postmortem,
+  give-up budget), then rebuild the service AT THE SHARD COUNT
+  SCHEDULED FOR THE RESUME INTERVAL — a crash just after an 8→4
+  reshard restores at 4 shards from the canonical bundle, the
+  restore-at-M path exercised by every armed fault.
+
+Determinism contract: the stream is a pure function of
+``(seed, interval, logical key)``, churn commands are resolved against
+restored table state (lowest matching slot), and emissions are ordered
+by slot — so a recovered run's delivered output bit-matches an
+uninterrupted one, which is exactly what the crash-point sweep asserts
+at every instrumented site.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..delivery.sink import TransactionalSink
+from ..resilience.supervisor import Supervisor, SupervisorGaveUp
+
+
+def shards_scheduled(reshard_at: Dict[int, int], initial: int,
+                     interval: int) -> int:
+    """The shard count in force at ``interval`` under the schedule:
+    the last reshard at or before it (the restart loop rebuilds at
+    this count — restore-at-M)."""
+    cur = initial
+    for i in sorted(reshard_at):
+        if i <= interval:
+            cur = reshard_at[i]
+    return cur
+
+
+def apply_churn(service, commands: Sequence) -> None:
+    """Apply one interval's churn against the authoritative table.
+
+    Commands: ``("register", window, tenant)`` /
+    ``("cancel_one", tenant)`` — cancel-one resolves to the LOWEST
+    active slot registered to the tenant, so a restart that restored
+    the table replays the same resolution. Registrations shed by
+    admission are quietly counted by the service; structural refusals
+    (ServingUnsupported) propagate to the supervised edge; a cancel
+    with no matching slot is a no-op (its register was shed)."""
+    for cmd in commands:
+        if cmd[0] == "register":
+            _, window, tenant = cmd
+            service.register(window, tenant=tenant)
+        elif cmd[0] == "cancel_one":
+            _, tenant = cmd
+            for slot, h in sorted(service.active_handles().items()):
+                if h.tenant == tenant:
+                    service.cancel(h)
+                    break
+        else:
+            raise ValueError(f"unknown churn command {cmd[0]!r}")
+
+
+def run_supervised_mesh(make_service: Callable[[int], object],
+                        n_intervals: int,
+                        supervisor: Supervisor,
+                        sink: Optional[TransactionalSink] = None,
+                        churn: Optional[Dict[int, Sequence]] = None,
+                        reshard_at: Optional[Dict[int, int]] = None,
+                        initial_shards: Optional[int] = None,
+                        checkpoint_every: int = 2) -> List:
+    """Drive ``make_service(n_shards)`` for ``n_intervals`` under
+    supervision with transactional delivery (module docstring). Returns
+    every item actually delivered downstream across all restarts — the
+    consumer's exact view. Items are
+    ``(interval, slot, gen, global_rows)`` per active slot per
+    interval; the sink tags each ``(epoch, seq)`` and the loop audits
+    that no tag is ever delivered twice."""
+    import jax
+
+    churn = churn or {}
+    reshard_at = dict(reshard_at or {})
+    if initial_shards is None:
+        initial_shards = len(jax.devices())
+    sink = sink or TransactionalSink()
+    if supervisor.sink is None:
+        supervisor.sink = sink
+    delivered: List = []
+    tags: set = set()
+
+    def deliver(item, epoch, seq):
+        if (epoch, seq) in tags:
+            raise AssertionError(
+                f"duplicate delivery tag (epoch={epoch}, seq={seq}): "
+                "the exactly-once contract broke")
+        tags.add((epoch, seq))
+        delivered.append(item)
+
+    prev_deliver = sink.deliver
+    sink.deliver = deliver
+    try:
+        while True:
+            try:
+                # construction and restore are INSIDE the supervised
+                # edge: a fault at a seed-register flight site or a torn
+                # bundle read recovers like any mid-stream crash
+                ckpt = supervisor.latest_checkpoint()
+                if ckpt is not None:
+                    d, _off = ckpt
+                else:
+                    d = None
+                # the resume interval decides the rebuild shard count
+                # BEFORE the service exists: read the committed bundle's
+                # meta — the restore-at-M half of the reshard contract
+                resume = 0
+                if d is not None:
+                    import json
+                    import os
+
+                    with open(os.path.join(d, "meta.json")) as f:
+                        resume = int(json.load(f).get("interval", 0))
+                svc = make_service(
+                    shards_scheduled(reshard_at, initial_shards, resume))
+                if d is not None:
+                    svc.restore(d, verify=False)   # walk just verified
+                    sink.restore(d)
+                else:
+                    sink.restore(None)
+                i = svc.interval
+                while i < n_intervals:
+                    if i in reshard_at \
+                            and svc.n_shards != reshard_at[i]:
+                        svc.reshard(reshard_at[i], supervisor, pos=i)
+                    if i in churn:
+                        apply_churn(svc, churn[i])
+                    out = svc.run(1)[0]
+                    rows = svc.global_rows_by_slot(out)
+                    gens = svc.table.gens
+                    items = [
+                        (i, slot, int(gens[slot]),
+                         tuple(map(tuple, rows.get(slot, ()))))
+                        for slot in sorted(svc.active_handles())]
+                    for item in items:
+                        sink.emit(item)
+                    i += 1
+                    if i % checkpoint_every == 0 or i == n_intervals:
+                        svc.check_overflow()
+                        supervisor.commit_checkpoint(i, svc.save)
+                return delivered
+            except SupervisorGaveUp:
+                raise
+            except AssertionError:
+                # the duplicate-tag audit's verdict, NOT a transient
+                # crash: recovering would let the sink's suppression
+                # horizon absorb the duplicate on replay and report the
+                # very violation the audit exists to catch as green
+                raise
+            except Exception as e:        # noqa: BLE001 — supervised edge
+                supervisor.handle_failure(e)   # raises at budget
+    finally:
+        sink.deliver = prev_deliver

@@ -197,6 +197,17 @@ MESH_HOT_KEYS = "mesh_hot_keys"
 MESH_KEYS_MOVED = "mesh_keys_moved"
 MESH_SHARD_IMBALANCE = "mesh_shard_imbalance"
 
+# mesh-serving contract (ISSUE 13 — scotty_tpu.mesh_serving: the
+# multi-tenant serving layer fused into the mesh step, plus elastic
+# reshard at checkpoint boundaries. mesh_reshards and
+# mesh_reshard_retraces APPEARING gate the default ``obs diff`` on mesh
+# cells — a steady-state serving run must neither silently reshard nor
+# recompile. serving_tenant_other is the top-k gauge rollup's remainder
+# bucket (the per-tenant gauge cardinality cap))
+MESH_RESHARDS = "mesh_reshards"
+MESH_RESHARD_RETRACES = "mesh_reshard_retraces"
+SERVING_TENANT_OTHER = "serving_tenant_other"
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -287,6 +298,13 @@ METRIC_HELP = {
     MESH_KEYS_MOVED: "keys migrated between shards by rebalances",
     MESH_SHARD_IMBALANCE:
         "hottest-shard load / mean shard load (gauge, drain-point read)",
+    MESH_RESHARDS:
+        "elastic shard-count changes applied at checkpoint boundaries",
+    MESH_RESHARD_RETRACES:
+        "serving-step compiles attributable to a reshard's new mesh "
+        "(itemized apart from steady-state serving_retraces)",
+    SERVING_TENANT_OTHER:
+        "active queries of tenants outside the top-k gauge rollup",
     CKPT_INTEGRITY_FAILURES:
         "checkpoint generations that failed digest verification",
     CKPT_LINEAGE_FALLBACKS:
@@ -499,6 +517,7 @@ __all__ = [
     "SERVING_REGISTERED", "SERVING_CANCELLED", "SERVING_REJECTED",
     "SERVING_RETRACES", "SERVING_CACHE_HITS", "SERVING_CACHE_MISSES",
     "SERVING_CACHE_EVICTIONS", "SERVING_ACTIVE_QUERIES",
+    "MESH_RESHARDS", "MESH_RESHARD_RETRACES", "SERVING_TENANT_OTHER",
     "RESILIENCE_SHED_TUPLES", "RESILIENCE_GROW_EVENTS",
     "RESILIENCE_CHECKPOINTS", "RESILIENCE_RESTARTS",
     "DELIVERY_EMITTED", "DELIVERY_DUPLICATES_SUPPRESSED",

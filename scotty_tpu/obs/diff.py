@@ -115,6 +115,14 @@ DEFAULT_THRESHOLDS = {
         # gates the appearing case, like the resilience set).
         "mesh_rebalances": {"direction": "lower", "default": 0},
         "mesh_hot_keys": {"direction": "lower", "default": 0},
+        # mesh-serving contract (ISSUE 13): an elastic reshard firing (or
+        # a reshard-attributed recompile) between two exports of the same
+        # mesh-serving workload gates — a steady-state cell neither
+        # changes shard count nor recompiles its fused step. Lazily
+        # created ("default": 0 gates the appearing case); steady-state
+        # churn recompiles stay gated by serving_retraces above.
+        "mesh_reshards": {"direction": "lower", "default": 0},
+        "mesh_reshard_retraces": {"direction": "lower", "default": 0},
         # delivery / checkpoint-integrity contract (ISSUE 8): replayed
         # duplicates reaching the suppression horizon, or checkpoint
         # generations failing digest verification, appearing between two

@@ -126,6 +126,17 @@ QUERY_REBUCKET = "query_rebucket"
 # when and why keys migrated
 MESH_HOT_KEY = "mesh_hot_key"
 MESH_REBALANCE = "mesh_rebalance"
+# mesh-serving events (ISSUE 13, scotty_tpu.mesh_serving): an elastic
+# shard-count change at a checkpoint boundary (name = "N->M", value =
+# new shard count), and the shard-aware query control path — register/
+# cancel routed through the mesh control plane (register: name =
+# tenant:window; cancel: name = tenant:slot<n>; value = the tenant's
+# affinity home shard) — so a reshard-triage postmortem shows exactly
+# which tenants were churning across which shards when the mesh
+# changed shape
+MESH_RESHARD = "mesh_reshard"
+MESH_QUERY_REGISTER = "mesh_query_register"
+MESH_QUERY_CANCEL = "mesh_query_cancel"
 # exactly-once delivery + checkpoint-integrity events (ISSUE 8,
 # scotty_tpu.delivery + the supervisor lineage): a sink delivery (value =
 # seq — fired BEFORE the downstream handoff, so a fuzzer crash at this

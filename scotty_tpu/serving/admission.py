@@ -47,24 +47,38 @@ class QueryAdmission:
     per_tenant_quota: int = 0
     on_reject: str = "fail"
     reject_callback: Optional[Callable] = None
+    #: shard-aware admission (ISSUE 13, mesh serving): caps the active
+    #: queries whose tenants share one affinity home shard (0 =
+    #: unlimited). Single-device services never pass a shard count, so
+    #: the cap is inert there.
+    per_shard_quota: int = 0
 
     def __post_init__(self):
         if self.max_queries < 1:
             raise ValueError("QueryAdmission.max_queries must be >= 1")
         if self.per_tenant_quota < 0:
             raise ValueError("QueryAdmission.per_tenant_quota must be >= 0")
+        if self.per_shard_quota < 0:
+            raise ValueError("QueryAdmission.per_shard_quota must be >= 0")
         if self.on_reject not in ("fail", "shed"):
             raise ValueError(
                 f"unknown on_reject {self.on_reject!r}: expected 'fail' or "
                 "'shed' (the resilience overflow-policy vocabulary)")
 
-    def check(self, n_active: int, tenant_active: int,
-              tenant: str) -> Optional[str]:
-        """``None`` when admissible, else the rejection reason."""
+    def check(self, n_active: int, tenant_active: int, tenant: str,
+              shard_active: Optional[int] = None) -> Optional[str]:
+        """``None`` when admissible, else the rejection reason.
+
+        ``shard_active`` is the active-query count on the registering
+        tenant's affinity home shard — passed only by shard-aware
+        callers (the mesh serving layer)."""
         if n_active >= self.max_queries:
             return "capacity"
         if self.per_tenant_quota and tenant_active >= self.per_tenant_quota:
             return "quota"
+        if self.per_shard_quota and shard_active is not None \
+                and shard_active >= self.per_shard_quota:
+            return "shard"
         return None
 
     def reject_message(self, reason: str, tenant: str) -> str:
@@ -72,6 +86,11 @@ class QueryAdmission:
             return (f"query capacity exhausted: {self.max_queries} active "
                     "queries (QueryAdmission.max_queries) — cancel queries "
                     "or raise the cap")
+        if reason == "shard":
+            return (f"tenant {tenant!r}'s affinity home shard is at its "
+                    f"quota of {self.per_shard_quota} active queries "
+                    "(QueryAdmission.per_shard_quota) — reshard, rebalance "
+                    "tenants, or raise the cap")
         return (f"tenant {tenant!r} is at its quota of "
                 f"{self.per_tenant_quota} active queries "
                 "(QueryAdmission.per_tenant_quota)")

@@ -62,6 +62,62 @@ def _tenant_metric(tenant: str) -> str:
     return "serving_tenant_active_" + _TENANT_RE.sub("_", tenant)
 
 
+def lanes_for(kind: int, grid: int, wm_period_ms: int) -> int:
+    """Trigger lanes one admitted window needs per watermark interval —
+    the ONE lane calculus both serving layers (single-device and mesh)
+    size their slot grids with, so a sizing fix can never drift between
+    them."""
+    from ..engine.pipeline import QUERY_KIND_SLIDING
+
+    return wm_period_ms // int(grid) \
+        + (2 if kind == QUERY_KIND_SLIDING else 1)
+
+
+def check_trigger_budget(geometry: SlotGeometry, max_triggers: int) -> None:
+    """Refuse a slot grid whose trigger rows exceed the engine budget —
+    shared by both serving layers (same drift rationale as
+    :func:`lanes_for`)."""
+    T = geometry.n_slots * geometry.triggers_per_slot
+    if T > max_triggers:
+        raise ValueError(
+            f"slot grid {geometry.n_slots} x {geometry.triggers_per_slot}"
+            f" = {T} trigger rows exceeds EngineConfig.max_triggers="
+            f"{max_triggers}: raise max_triggers, coarsen "
+            "the slice grid, or cap the query count lower")
+
+
+def emit_tenant_gauges(obs, rollup: dict, gauged: set,
+                       top_k: int) -> set:
+    """Per-tenant active-query gauges with bounded cardinality (ISSUE 13
+    satellite): ``serving_tenant_active_<t>`` used to mint one gauge per
+    tenant name forever — at mesh-service tenant counts that bloats
+    ``/metrics`` and every ``obs diff`` input. Only the ``top_k``
+    tenants by active count keep named gauges; the remainder folds into
+    one ``serving_tenant_other`` rollup. Ties break by tenant name so
+    the emitted set is deterministic.
+
+    ``gauged`` is the caller's set of currently-named tenant metrics;
+    tenants that fall out of the top-k (or cancel their last query) are
+    zeroed — never left stuck at a stale nonzero value — and the new
+    named set is returned. Shared by the single-device and mesh serving
+    layers, so the zero-on-last-cancel behavior cannot drift between
+    them."""
+    if obs is None:
+        return gauged
+    ranked = sorted(rollup.items(), key=lambda kv: (-kv[1], kv[0]))
+    named = ranked[:max(0, int(top_k))]
+    other = sum(n for _, n in ranked[len(named):])
+    for tenant, n in named:
+        obs.gauge(_tenant_metric(tenant)).set(n)
+    obs.gauge(_obs.SERVING_TENANT_OTHER).set(other)
+    new_gauged = {t for t, _ in named}
+    # a tenant whose last query was cancelled — or that the rollup
+    # displaced — must read 0, not its final nonzero value forever
+    for tenant in gauged - new_gauged:
+        obs.gauge(_tenant_metric(tenant)).set(0)
+    return new_gauged
+
+
 class QueryService:
     """Register/cancel windows against a shared-slice serving pipeline.
 
@@ -85,6 +141,7 @@ class QueryService:
                  min_slots: int = 8,
                  min_trigger_lanes: int = 8,
                  cache_capacity: int = 8,
+                 tenant_gauge_top_k: int = 32,
                  obs=None,
                  **pipeline_kwargs):
         self.config = config or EngineConfig()
@@ -96,6 +153,10 @@ class QueryService:
         self.min_slots = int(min_slots)
         self.min_trigger_lanes = int(min_trigger_lanes)
         self.cache = GeometryCache(cache_capacity)
+        #: named per-tenant gauge budget: only the top-k tenants by
+        #: active count keep serving_tenant_active_<t> gauges, the rest
+        #: fold into serving_tenant_other (cardinality cap, ISSUE 13)
+        self.tenant_gauge_top_k = int(tenant_gauge_top_k)
         self._counters = {}
         self._gauged_tenants: set = set()
         #: jit traces already attributed to serving_retraces (the first
@@ -139,10 +200,7 @@ class QueryService:
 
     # -- geometry ----------------------------------------------------------
     def _lanes_for(self, kind: int, grid: int) -> int:
-        from ..engine.pipeline import QUERY_KIND_SLIDING
-
-        return self.wm_period_ms // int(grid) \
-            + (2 if kind == QUERY_KIND_SLIDING else 1)
+        return lanes_for(kind, grid, self.wm_period_ms)
 
     def _bucket_key(self, geometry: SlotGeometry) -> BucketKey:
         return BucketKey(
@@ -155,13 +213,7 @@ class QueryService:
             engine_config=self.config, wm_period_ms=self.wm_period_ms)
 
     def _check_trigger_budget(self, geometry: SlotGeometry) -> None:
-        T = geometry.n_slots * geometry.triggers_per_slot
-        if T > self.config.max_triggers:
-            raise ValueError(
-                f"slot grid {geometry.n_slots} x {geometry.triggers_per_slot}"
-                f" = {T} trigger rows exceeds EngineConfig.max_triggers="
-                f"{self.config.max_triggers}: raise max_triggers, coarsen "
-                "the slice grid, or cap the query count lower")
+        check_trigger_budget(geometry, self.config.max_triggers)
 
     @property
     def geometry(self) -> SlotGeometry:
@@ -177,14 +229,9 @@ class QueryService:
         if self.obs is None:
             return
         self.obs.gauge(_obs.SERVING_ACTIVE_QUERIES).set(self.table.n_active)
-        rollup = self.table.tenant_rollup()
-        for tenant, n in rollup.items():
-            self.obs.gauge(_tenant_metric(tenant)).set(n)
-        # a tenant whose last query was cancelled must read 0, not its
-        # final nonzero value forever
-        for tenant in self._gauged_tenants - set(rollup):
-            self.obs.gauge(_tenant_metric(tenant)).set(0)
-        self._gauged_tenants = set(rollup)
+        self._gauged_tenants = emit_tenant_gauges(
+            self.obs, self.table.tenant_rollup(), self._gauged_tenants,
+            self.tenant_gauge_top_k)
 
     def _flight(self, kind: str, name: str, value: float = 0.0) -> None:
         if self.obs is not None:

@@ -1,0 +1,396 @@
+"""Multi-tenant mesh serving + elastic reshard (ISSUE 13): the fused
+shard_map serving step, churn differentials against an always-active
+superset oracle, cancel→re-register slot recycling with generation
+checks across a reshard, shard-aware admission under tenant affinity,
+and the supervised exactly-once loop — all on the conftest-provided
+virtual 8-device CPU mesh."""
+
+import os
+
+import numpy as np
+import pytest
+
+from scotty_tpu import (
+    SlicingWindowOperator,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu import obs as _obs
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.pipeline import SlotGeometry
+from scotty_tpu.mesh_serving import (
+    MeshQueryService,
+    MeshServingPipeline,
+    run_supervised_mesh,
+    tenant_home_shard,
+)
+from scotty_tpu.serving import QueryAdmission, QueryRejected
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=64, annex_capacity=8, min_trigger_pad=32)
+
+
+def make_service(shards=8, max_queries=8, seed=3, obs=None, quota=0,
+                 shard_quota=0, on_reject="fail", windows=(),
+                 trace_cell=None, n_keys=16):
+    return MeshQueryService(
+        [SumAggregation()], slice_grid=500, max_window_size=4000,
+        n_keys=n_keys, n_shards=shards, throughput=n_keys * 1000,
+        wm_period_ms=1000, max_lateness=1000, seed=seed, config=CFG,
+        admission=QueryAdmission(max_queries=max_queries,
+                                 per_tenant_quota=quota,
+                                 per_shard_quota=shard_quota,
+                                 on_reject=on_reject),
+        windows=list(windows), obs=obs, trace_cell=trace_cell)
+
+
+# ---------------------------------------------------------------------------
+# The fused serving step
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_per_key_matches_host_simulator_and_global_fold():
+    """Per-key rows of a mid-stream-registered query bit-follow a host
+    simulator replay of that key's materialized stream, and the psum
+    global fold equals the per-key column sum — with ZERO retraces
+    across the register (one row write, table data)."""
+    geom = SlotGeometry(n_slots=8, triggers_per_slot=4, slice_grid=500,
+                        max_size=4000)
+    p = MeshServingPipeline(
+        [SumAggregation()], query_slots=geom, n_keys=16, n_shards=8,
+        config=CFG, throughput=16 * 1000, wm_period_ms=1000,
+        max_lateness=1000, seed=5)
+    p.reset()
+    p.write_query_slot(0, 0, 1000, 1000, True)       # Tumbling(1000)
+    p.run(2, collect=False)
+    p.sync()
+    traces = p._trace_count
+    # register Sliding(2000, 500) MID-STREAM: answers over slices
+    # ingested before it existed (shared slicing at mesh scale)
+    p.write_query_slot(1, 1, 500, 2000, True)
+    outs = p.run(2)
+    assert p._trace_count == traces                  # zero retraces
+
+    sim = SlicingWindowOperator()
+    sim.add_window_assigner(TumblingWindow(Time, 1000))
+    sim.add_window_assigner(SlidingWindow(Time, 2000, 500))
+    sim.add_aggregation(SumAggregation())
+    sim.set_max_lateness(1000)
+    key = 7
+    for i in range(2):
+        vals, ts = p.materialize_interval(i, key)
+        sim.process_elements(vals, ts)
+        sim.process_watermark((i + 1) * 1000)
+    for j, i in enumerate((2, 3)):
+        vals, ts = p.materialize_interval(i, key)
+        sim.process_elements(vals, ts)
+        want = {}
+        for w in sim.process_watermark((i + 1) * 1000):
+            if w.has_value():
+                want[(w.get_start(), w.get_end())] = w.get_agg_values()
+        got = {(s, e): v for (s, e, c, v)
+               in p.lowered_results_for_key(outs[j], key)}
+        assert set(got) == set(want), (i, sorted(got), sorted(want))
+        for k2 in want:
+            for x, y in zip(want[k2], got[k2]):
+                assert abs(float(x) - float(y)) \
+                    <= 2e-4 * max(1.0, abs(float(x)))
+        # the in-executable psum fold == the per-key column sum
+        import jax
+
+        ws, we, cnt, _res, gcnt, _gp = jax.device_get(outs[j])
+        assert (gcnt == cnt.sum(axis=0)).all()
+    p.check_overflow()
+
+
+# ---------------------------------------------------------------------------
+# Churn differential: always-active superset oracle
+# ---------------------------------------------------------------------------
+
+
+def test_churn_bitmatches_always_active_superset():
+    """Queries registered/cancelled mid-stream answer BIT-IDENTICALLY
+    (global psum fold AND sampled per-key rows) to a superset service
+    that had every query active from the start — engine state is
+    query-set independent and per-trigger-row results are independent,
+    so exact f32 byte equality is demanded."""
+    svc = make_service(windows=[TumblingWindow(Time, 1000)])
+    sup = make_service(max_queries=16,
+                       windows=[TumblingWindow(Time, 1000)])
+    w_a = SlidingWindow(Time, 2000, 500)
+    w_b = TumblingWindow(Time, 500)
+    ha_o = sup.register(w_a, tenant="acme")
+    hb_o = sup.register(w_b, tenant="beta")
+    sup.run(1, collect=False)
+
+    svc.run(1, collect=False)
+    svc.sync()
+    svc.mark_warm()
+    ha = svc.register(w_a, tenant="acme")            # interval 1
+    keys = (0, 5, 15)
+    for i in (1, 2):
+        o_s, o_o = svc.run(1)[0], sup.run(1)[0]
+        assert svc.global_rows_by_slot(o_s)[ha.slot] \
+            == sup.global_rows_by_slot(o_o)[ha_o.slot]
+        for k in keys:
+            assert svc.key_rows_by_slot(o_s, k).get(ha.slot) \
+                == sup.key_rows_by_slot(o_o, k).get(ha_o.slot)
+    svc.cancel(ha)
+    hb = svc.register(w_b, tenant="beta")            # recycles ha's slot
+    assert hb.slot == ha.slot and hb.gen == ha.gen + 1
+    o_s, o_o = svc.run(1)[0], sup.run(1)[0]
+    assert svc.global_rows_by_slot(o_s).get(hb.slot) \
+        == sup.global_rows_by_slot(o_o).get(hb_o.slot)
+    # the cancelled query's rows are gone (masked), not stale
+    assert ha.slot not in svc.key_rows_by_slot(o_s, 5) \
+        or svc.key_rows_by_slot(o_s, 5)[hb.slot] \
+        == sup.key_rows_by_slot(o_o, 5)[hb_o.slot]
+    assert svc.retraces_since_warm == 0
+    svc.check_overflow(), sup.check_overflow()
+
+
+# ---------------------------------------------------------------------------
+# Elastic reshard
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_bitmatches_and_recycles_slots_with_generations(tmp_path):
+    """The 8→4→8 walk: emissions bit-match an un-resharded twin;
+    cancel→re-register across a reshard recycles the slot LIFO with the
+    generation bumped; a stale pre-reshard handle copy is rejected; the
+    reshard compiles are itemized apart from steady-state retraces."""
+    from scotty_tpu.resilience import ManualClock, Supervisor
+
+    svc = make_service(windows=[TumblingWindow(Time, 1000)])
+    twin = make_service(windows=[TumblingWindow(Time, 1000)])
+    h = svc.register(SlidingWindow(Time, 2000, 500), tenant="acme")
+    th = twin.register(SlidingWindow(Time, 2000, 500), tenant="acme")
+    svc.run(2, collect=False)
+    svc.sync()
+    svc.mark_warm()
+    sup = Supervisor(os.path.join(str(tmp_path), "ck"),
+                     clock=ManualClock(), seed=1)
+    twin.run(2, collect=False)
+
+    r = svc.reshard(4, sup, pos=svc.interval)
+    assert r["resharded"] and r["from"] == 8 and r["to"] == 4
+    assert svc.n_shards == 4 and svc.reshard_retraces == 1
+    o, t = svc.run(1)[0], twin.run(1)[0]
+    # per-key rows are shard-local state: EXACT across shard counts.
+    # The global fold's psum reduction tree changes with the shard
+    # count (4 vs 8 partials), so across-count comparisons are
+    # tolerance-equal — equal-count phases below go back to exact.
+    for k in (0, 9):
+        assert svc.key_rows_by_slot(o, k) == twin.key_rows_by_slot(t, k)
+    g_s, g_t = svc.global_rows_by_slot(o), twin.global_rows_by_slot(t)
+    assert set(g_s) == set(g_t)
+    for slot in g_s:
+        for (s1, e1, c1, v1), (s2, e2, c2, v2) in zip(g_s[slot],
+                                                      g_t[slot]):
+            assert (s1, e1, c1) == (s2, e2, c2)
+            np.testing.assert_allclose(np.float64(v1), np.float64(v2),
+                                       rtol=1e-6)
+
+    # churn ACROSS the reshard: cancel, then re-register — LIFO recycle,
+    # generation bumped, the pre-reshard stale copy is dead
+    stale = h
+    svc.cancel(h)
+    twin.cancel(th)
+    h2 = svc.register(TumblingWindow(Time, 500), tenant="beta")
+    th2 = twin.register(TumblingWindow(Time, 500), tenant="beta")
+    assert h2.slot == stale.slot and h2.gen == stale.gen + 1
+    with pytest.raises(ValueError, match="stale or unknown"):
+        svc.cancel(stale)
+
+    r = svc.reshard(8, sup, pos=svc.interval)
+    assert r["to"] == 8
+    # returning to 8 shards re-enters the warm bucket: no new compile
+    assert svc.reshard_retraces == 1
+    o, t = svc.run(1)[0], twin.run(1)[0]
+    assert svc.global_rows_by_slot(o) == twin.global_rows_by_slot(t)
+    assert svc.global_rows_by_slot(o)[h2.slot] \
+        == twin.global_rows_by_slot(t)[th2.slot]
+    assert svc.retraces_since_warm == 0
+    assert [row["to"] for row in svc.reshard_timeline] == [4, 8]
+    svc.check_overflow(), twin.check_overflow()
+
+
+def test_reshard_rejects_indivisible_shard_count(tmp_path):
+    from scotty_tpu.resilience import ManualClock, Supervisor
+
+    svc = make_service(windows=[TumblingWindow(Time, 1000)])
+    svc.run(1, collect=False)
+    sup = Supervisor(os.path.join(str(tmp_path), "ck"),
+                     clock=ManualClock(), seed=1)
+    with pytest.raises(ValueError, match="multiple of the shard count"):
+        svc.reshard(5, sup, pos=1)
+
+
+def test_checkpoint_restores_active_set_at_other_shard_count(tmp_path):
+    """The query table checkpoints atomically alongside mesh state: a
+    bundle saved under 8 shards restores into a FRESH 4-shard service,
+    replaying the exact active set (slots, generations, tenants) and
+    continuing the emission stream bit-identically."""
+    svc = make_service(windows=[TumblingWindow(Time, 1000)])
+    h = svc.register(SlidingWindow(Time, 2000, 1000), tenant="acme")
+    svc.run(3, collect=False)
+    svc.sync()
+    d = os.path.join(str(tmp_path), "snap")
+    svc.save(d)
+    cont = svc.run(1)[0]
+
+    fresh = make_service(shards=4)
+    fresh.restore(d)
+    assert fresh.table.n_active == 2
+    assert fresh.active_handles()[h.slot].tenant == "acme"
+    assert fresh.active_handles()[h.slot].gen == h.gen
+    out = fresh.run(1)[0]
+    # per-key rows are shard-local: exact across the 8→4 restore; the
+    # global psum tree differs with shard count (tolerance there)
+    for k in (0, 5, 15):
+        assert fresh.key_rows_by_slot(out, k) \
+            == svc.key_rows_by_slot(cont, k)
+    g_f, g_s = fresh.global_rows_by_slot(out), svc.global_rows_by_slot(cont)
+    assert set(g_f) == set(g_s)
+    for slot in g_f:
+        for (s1, e1, c1, v1), (s2, e2, c2, v2) in zip(g_f[slot],
+                                                      g_s[slot]):
+            assert (s1, e1, c1) == (s2, e2, c2)
+            np.testing.assert_allclose(np.float64(v1), np.float64(v2),
+                                       rtol=1e-6)
+    # generation continuity: the restored handle cancels cleanly
+    fresh.cancel(fresh.active_handles()[h.slot])
+    assert fresh.table.n_active == 1
+
+
+# ---------------------------------------------------------------------------
+# Shard-aware admission under tenant affinity
+# ---------------------------------------------------------------------------
+
+
+def _same_home_tenants(n: int, shards: int = 8):
+    """n distinct tenant names hashing to one affinity home shard."""
+    home = tenant_home_shard("t0", shards)
+    out, i = ["t0"], 1
+    while len(out) < n:
+        cand = f"t{i}"
+        if tenant_home_shard(cand, shards) == home:
+            out.append(cand)
+        i += 1
+    return out
+
+
+def test_admission_shard_quota_under_tenant_affinity():
+    """per_shard_quota caps the active queries any one affinity home
+    shard carries — tenants hashing to DIFFERENT shards are unaffected,
+    and the rejection names the shard reason."""
+    a, b = _same_home_tenants(2)
+    other = next(f"x{i}" for i in range(64)
+                 if tenant_home_shard(f"x{i}", 8)
+                 != tenant_home_shard(a, 8))
+    svc = make_service(max_queries=8, shard_quota=2)
+    svc.register(TumblingWindow(Time, 1000), tenant=a)
+    svc.register(TumblingWindow(Time, 500), tenant=b)
+    with pytest.raises(QueryRejected) as ei:
+        svc.register(TumblingWindow(Time, 2000), tenant=a)
+    assert ei.value.reason == "shard"
+    # a tenant on another home shard still admits
+    assert svc.register(TumblingWindow(Time, 1000), tenant=other)
+
+
+def test_admission_shed_and_quota_counted_on_mesh():
+    shed = []
+    svc = MeshQueryService(
+        [SumAggregation()], slice_grid=500, max_window_size=4000,
+        n_keys=16, n_shards=8, throughput=16_000, wm_period_ms=1000,
+        max_lateness=1000, seed=3, config=CFG,
+        admission=QueryAdmission(
+            max_queries=8, per_tenant_quota=1, per_shard_quota=0,
+            on_reject="shed",
+            reject_callback=lambda w, t, r: shed.append((t, r))))
+    assert svc.register(TumblingWindow(Time, 1000), tenant="acme")
+    assert svc.register(TumblingWindow(Time, 500), tenant="acme") is None
+    assert shed == [("acme", "quota")]
+    assert svc.stats()["serving_rejected"] == 1
+
+
+def test_mesh_tenant_gauges_ride_topk_rollup():
+    """The mesh service shares the capped-cardinality gauge helper:
+    top-k named gauges + serving_tenant_other, zero-on-cancel intact."""
+    obs = _obs.Observability()
+    svc = MeshQueryService(
+        [SumAggregation()], slice_grid=500, max_window_size=4000,
+        n_keys=16, n_shards=8, throughput=16_000, wm_period_ms=1000,
+        max_lateness=1000, seed=3, config=CFG,
+        admission=QueryAdmission(max_queries=8),
+        tenant_gauge_top_k=2, obs=obs)
+    h_a1 = svc.register(TumblingWindow(Time, 1000), tenant="alice")
+    svc.register(TumblingWindow(Time, 500), tenant="alice")
+    svc.register(TumblingWindow(Time, 1000), tenant="bob")
+    svc.register(TumblingWindow(Time, 2000), tenant="carol")
+    snap = obs.snapshot()
+    assert snap["serving_tenant_active_alice"] == 2
+    assert snap["serving_tenant_active_bob"] == 1
+    assert snap["serving_tenant_other"] == 1          # carol rolled up
+    svc.cancel(h_a1)
+    snap = obs.snapshot()
+    # alice dropped to 1 — ties break by name: alice+bob stay named
+    assert snap["serving_tenant_active_alice"] == 1
+    assert snap["serving_tenant_other"] == 1
+    kinds = {e["kind"] for e in obs.flight.events()} if obs.flight else ()
+
+
+# ---------------------------------------------------------------------------
+# Supervised exactly-once loop (crash-free determinism; the armed-fault
+# sweep lives in test_mesh_serving_crash.py)
+# ---------------------------------------------------------------------------
+
+
+def test_supervised_loop_is_deterministic_and_duplicate_free(tmp_path):
+    from scotty_tpu.delivery import EXACTLY_ONCE, TransactionalSink
+    from scotty_tpu.resilience import ManualClock, Supervisor
+
+    churn = {1: [("register", SlidingWindow(Time, 2000, 500), "acme")],
+             3: [("cancel_one", "acme"),
+                 ("register", TumblingWindow(Time, 500), "beta")]}
+    reshard_at = {2: 4, 4: 8}
+
+    def run(d):
+        sup = Supervisor(os.path.join(str(tmp_path), d),
+                         clock=ManualClock(), seed=1, max_restarts=4)
+        sink = TransactionalSink(mode=EXACTLY_ONCE)
+        return run_supervised_mesh(
+            lambda s: make_service(
+                shards=s, windows=[TumblingWindow(Time, 1000)]),
+            5, sup, sink=sink, churn=churn, reshard_at=reshard_at,
+            initial_shards=8, checkpoint_every=2)
+
+    a, b = run("a"), run("b")
+    assert a == b and len(a) > 0
+    # every (interval, slot, gen) triple delivered exactly once
+    ids = [(i, s, g) for (i, s, g, _rows) in a]
+    assert len(ids) == len(set(ids))
+
+
+def test_mesh_churn_bench_cell_smoke():
+    """run_query_churn_mesh_cell completes on a tiny geometry with the
+    full contract: zero steady-state retraces (trace-reconciled), the
+    8→4→8 reshard timeline, superset-oracle bit-match, unique delivery
+    tags."""
+    from scotty_tpu.bench.harness import BenchmarkConfig
+    from scotty_tpu.bench.runner import run_query_churn_mesh_cell
+
+    cfg = BenchmarkConfig(
+        name="mesh-churn-smoke", throughput=1 << 17, runtime_s=5,
+        capacity=64, n_keys=128, n_shards=8, watermark_period_ms=1000,
+        max_lateness=1000, churn_ops=40, churn_max_active=12,
+        churn_tenants=3, mesh_reshard_schedule=[[2, 4], [4, 8]])
+    r = run_query_churn_mesh_cell(cfg, "Sliding(2000,500)", "sum")
+    assert r.tuples_per_sec > 0
+    assert r.oracle_match and r.delivery_tags_unique
+    assert r.serving_retraces_after_warmup == 0
+    assert r.churn_ops >= 40
+    assert [row["to"] for row in r.reshard_timeline] == [4, 8]
+    assert r.n_keys == 128 and r.n_shards == 8

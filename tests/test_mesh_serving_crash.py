@@ -1,0 +1,117 @@
+"""Crash-point sweep over the reshard commit path (ISSUE 13 satellite):
+the PR 8 fuzzer discipline applied to the mesh-serving supervised loop —
+arm a fault at EVERY instrumented site (each flight-event emit point:
+registers, cancels, sink emissions, epoch commits, the mesh_reshard
+event itself; plus every fsio write/fsync/replace inside checkpoint →
+reshard → restore, with torn/short/ENOSPC variants), crash a fresh run
+there, recover under the Supervisor — rebuilding AT THE SHARD COUNT
+SCHEDULED FOR THE RESUME INTERVAL, the restore-at-M path — and require
+the delivered output bit-match the uninterrupted oracle with no
+duplicate ``(epoch, seq)`` tags (the loop's deliver hook raises on any
+tag seen twice, so a duplicate fails the armed run itself)."""
+
+import os
+
+import pytest
+
+from scotty_tpu import (SlidingWindow, SumAggregation, TumblingWindow,
+                        WindowMeasure)
+from scotty_tpu import obs as _obs
+from scotty_tpu.delivery import EXACTLY_ONCE, TransactionalSink
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.mesh_serving import MeshQueryService, run_supervised_mesh
+from scotty_tpu.resilience import ManualClock, Supervisor
+from scotty_tpu.resilience.chaos import CrashPlan, crash_point_sweep
+from scotty_tpu.serving import QueryAdmission
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=64, annex_capacity=8, min_trigger_pad=32)
+
+#: one shared trace cell across every per-site environment: the sweep
+#: builds a fresh service per armed run, and sharing the cell shares the
+#: warm step executables (the cell's identity keys the step cache) — the
+#: sweep certifies delivery, not retrace accounting
+_CELL = [0]
+
+#: churn + reshard plan: registers before the first commit, a cancel+
+#: re-register straddling the reshard, 8→4 at interval 1 — so the swept
+#: sites cover churned-table commits, the reshard commit itself, and
+#: post-reshard emissions
+_CHURN = {0: [("register", SlidingWindow(Time, 2000, 500), "acme")],
+          2: [("cancel_one", "acme"),
+              ("register", TumblingWindow(Time, 500), "beta")]}
+_RESHARD = {1: 4}
+_N = 3
+
+
+def _make_env_factory(tmp_path):
+    counter = [0]
+
+    def make_env():
+        counter[0] += 1
+        d = os.path.join(str(tmp_path), f"env{counter[0]}")
+        os.makedirs(d, exist_ok=True)
+        obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=4096))
+
+        def make_service(shards):
+            return MeshQueryService(
+                [SumAggregation()], slice_grid=500, max_window_size=4000,
+                n_keys=16, n_shards=shards, throughput=16_000,
+                wm_period_ms=1000, max_lateness=1000, seed=3, config=CFG,
+                admission=QueryAdmission(max_queries=8),
+                windows=[TumblingWindow(Time, 1000)], obs=obs,
+                trace_cell=_CELL)
+
+        def run():
+            sup = Supervisor(os.path.join(d, "ck"), clock=ManualClock(),
+                             obs=obs, max_restarts=8, seed=11)
+            sink = TransactionalSink(mode=EXACTLY_ONCE, obs=obs)
+            return run_supervised_mesh(
+                make_service, _N, sup, sink=sink, churn=_CHURN,
+                reshard_at=_RESHARD, initial_shards=8,
+                checkpoint_every=2)
+
+        return obs, run
+
+    return make_env
+
+
+def _assert_green(report, min_sites):
+    assert report.sites >= min_sites
+    assert report.fired == report.ran
+    assert report.oracle_len > 0
+    assert report.failures == [], (
+        f"{len(report.failures)} of {report.ran} crash sites broke "
+        f"exactly-once delivery across the reshard commit path — "
+        f"first: {report.failures[0]}")
+
+
+def test_enumeration_covers_reshard_commit_sites(tmp_path):
+    """The site list spans the whole reshard story: the mesh_reshard
+    flight event, the shard-aware query control events, sink emissions,
+    and every committed byte of the bundle (state npz, routing sidecar,
+    query table, ledger, manifest, pointer) with fault variants."""
+    make_env = _make_env_factory(tmp_path)
+    obs, run = make_env()
+    sites = CrashPlan().record(obs, run)
+    assert len(sites) >= 60
+    flight_kinds = {s.kind for s in sites if s.domain == "flight"}
+    assert {"mesh_reshard", "mesh_query_register", "emit",
+            "epoch_commit", "checkpoint"} <= flight_kinds
+    fs_names = {s.name for s in sites if s.domain == "fs"}
+    assert "mesh_state.npz" in fs_names
+    assert "routing.json" in fs_names
+    assert "MANIFEST.json" in fs_names
+    assert "ledger.json" in fs_names
+    assert any(n.startswith("query_table.json") for n in fs_names)
+    fs_faults = {s.fault for s in sites
+                 if s.domain == "fs" and s.kind == "write"}
+    assert fs_faults == {"crash", "torn", "short", "enospc"}
+
+
+def test_reshard_commit_path_every_site_exactly_once(tmp_path):
+    """The headline sweep: EVERY enumerated site across checkpoint →
+    reshard → restore-at-M-shards, recovered output bit-identical to
+    the uninterrupted oracle, zero duplicate (epoch, seq) tags."""
+    report = crash_point_sweep(_make_env_factory(tmp_path))
+    _assert_green(report, min_sites=60)

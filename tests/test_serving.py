@@ -391,6 +391,41 @@ def test_tenant_gauge_zeroes_after_last_cancel():
     assert obs.snapshot()["serving_tenant_active_alice"] == 0
 
 
+def test_tenant_gauge_cardinality_capped_to_topk_with_rollup():
+    """ISSUE 13 satellite: serving_tenant_active_<t> minted one gauge
+    per tenant name forever — at mesh-service tenant counts that bloats
+    /metrics and obs diff inputs. Only the top-k tenants by active
+    count keep named gauges; the rest fold into serving_tenant_other;
+    displaced tenants are zeroed, not left stuck."""
+    import scotty_tpu.serving.service as _svc_mod
+
+    obs = _obs.Observability()
+    svc = make_service(obs=obs)
+    svc.tenant_gauge_top_k = 2
+    handles = {}
+    for t, n in (("alice", 3), ("bob", 2), ("carol", 1), ("dave", 1)):
+        handles[t] = [svc.register(TumblingWindow(Time, 500), tenant=t)
+                      for _ in range(n)]
+    snap = obs.snapshot()
+    assert snap["serving_tenant_active_alice"] == 3
+    assert snap["serving_tenant_active_bob"] == 2
+    assert snap["serving_tenant_other"] == 2          # carol + dave
+    assert "serving_tenant_active_carol" not in snap
+    # alice cancels down to 0: she leaves the named set AND reads 0
+    # (the gauge-zeroing-on-last-cancel behavior survives the rollup)
+    for h in handles["alice"]:
+        svc.cancel(h)
+    snap = obs.snapshot()
+    assert snap["serving_tenant_active_alice"] == 0
+    assert snap["serving_tenant_active_bob"] == 2
+    # ties at 1 break by name: carol gets the second named gauge
+    assert snap["serving_tenant_active_carol"] == 1
+    assert snap["serving_tenant_other"] == 1          # dave
+    # every tenant named by the rollup resolves through the shared
+    # helper — the helper is the one place both serving layers emit from
+    assert _svc_mod.emit_tenant_gauges is not None
+
+
 def test_replay_schedule_tolerates_shed_registers():
     """Review finding: a cancel whose matching register was shed by
     admission used to KeyError mid-schedule."""
