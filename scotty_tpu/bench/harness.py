@@ -20,6 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import latency as _late
 from ..core.aggregates import (
     BUILTIN_AGGREGATIONS,
     AggregateFunction,
@@ -433,6 +434,21 @@ def latency_stats(lats) -> dict:
             "tail_unattributed": diverged and stalls == 0}
 
 
+def first_emit_stats(res: "BenchResult", fe_lats) -> None:
+    """Fold drained first-emit samples (watermark-eligibility → first
+    delivered window, ISSUE 14 — the ROADMAP item 4 bench dimension)
+    onto the result row: ``first_emit_p50_ms`` / ``first_emit_p99_ms``
+    / ``first_emit_samples``. Cells that measured nothing embed only
+    the zero sample count — a 0.0 percentile must never pose as a
+    measured latency (and a baseline of 0.0 would turn the first real
+    measurement into a false ``obs diff`` regression)."""
+    res.first_emit_samples = len(fe_lats)
+    if fe_lats:
+        arr = np.asarray(fe_lats, np.float64)
+        res.first_emit_p50_ms = float(np.percentile(arr, 50))
+        res.first_emit_p99_ms = float(np.percentile(arr, 99))
+
+
 def finalize_observability(res: "BenchResult", obs, lats, emitted: int,
                            n_tuples: Optional[int] = None) -> None:
     """Shared cell epilogue: fold the sampled emit latencies and emission
@@ -605,6 +621,14 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
     if obs is not None:
         # rates (*_per_s) measure the stream region, not generation/compile
         obs.registry.reset_clock()
+    tracer = None
+    fe_lats: List[float] = []
+    if obs is not None:
+        # first-emit probes (ISSUE 14): sampling-off tracer — the
+        # operator seams stay one attribute check, and only the sampled
+        # ticks below force a chain around their honest drained measure
+        tracer = obs.latency if obs.latency is not None \
+            else obs.attach_latency(sample_every=0)
 
     stats = ThroughputStatistics()
     n_emitted = 0
@@ -624,6 +648,7 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         nonlocal n_emitted, wm_count
         if engine == "TpuEngine":
             sample = wm_count % SAMPLE_EVERY == 0
+            lid = None
             if sample:
                 anchor = (op._state if op._state is not None
                           else op._session_states[0]
@@ -631,7 +656,13 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                 jax.device_get(                           # drain the queue
                     jax.tree.leaves(anchor)[0].ravel()[0])
                 t_wm = time.perf_counter()
+                if tracer is not None:
+                    lid = tracer.open(force=True)
             out = op.process_watermark_async(wm)
+            if lid is not None:
+                # the watermark dispatch returned: its windows are
+                # eligible; the sampled fetch below is their delivery
+                tracer.stamp(lid, _late.STAGE_ELIGIBILITY)
             if isinstance(out[0], str) and out[0] == "session":
                 ms = tuple(g[0] for g in out[1])   # per-window emit counts
                 pending_sessions.append(ms)
@@ -654,12 +685,26 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
             if sample:
                 stats.emit_latencies_ms.append(
                     (time.perf_counter() - t_wm) * 1e3)
+                if lid is not None:
+                    tracer.stamp(lid, _late.STAGE_EMIT)
+                    fin = tracer.finalize(lid)
+                    if fin is not None \
+                            and fin["first_emit_ms"] is not None:
+                        fe_lats.append(fin["first_emit_ms"])
         else:
             t_wm = time.perf_counter()
+            lid = tracer.open(force=True) if tracer is not None else None
+            if lid is not None:
+                tracer.stamp(lid, _late.STAGE_ELIGIBILITY)
             results = op.process_watermark(wm)
             n_emitted += sum(1 for r in results if r.has_value())
             stats.emit_latencies_ms.append(
                 (time.perf_counter() - t_wm) * 1e3)
+            if lid is not None:
+                tracer.stamp(lid, _late.STAGE_EMIT)
+                fin = tracer.finalize(lid)
+                if fin is not None and fin["first_emit_ms"] is not None:
+                    fe_lats.append(fin["first_emit_ms"])
         wm_count += 1
 
     t0 = time.perf_counter()
@@ -713,6 +758,7 @@ def run_benchmark(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         n_windows_emitted=n_emitted, n_tuples=n_tuples, wall_s=wall)
     for k, v in latency_stats(stats.emit_latencies_ms).items():
         setattr(res, k, v)
+    first_emit_stats(res, fe_lats)
     # engines without hook points (Simulator/Hybrid host paths) still
     # report harness-known ingest totals
     finalize_observability(res, obs, stats.emit_latencies_ms, n_emitted,

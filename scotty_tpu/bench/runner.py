@@ -32,11 +32,13 @@ from typing import List, Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import latency as _lat
 from ..utils import stdout_echo as _stdout
 from .harness import (
     BenchmarkConfig,
     BenchResult,
     finalize_observability,
+    first_emit_stats,
     latency_stats,
     make_aggregation,
     parse_window_spec,
@@ -146,6 +148,12 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         # attach AFTER warmup: warmup tuples must not pollute the counters,
         # and the rate denominator restarts so *_per_s reflects the
         # measured region, not compile/warmup wall time
+        if obs.latency is None:
+            # emission-latency lineage (ISSUE 14): every metrics-bearing
+            # cell traces sampled chains through the driver seams in the
+            # timed region, and the drained phase below force-samples
+            # its first-emit probes on the same tracer
+            obs.attach_latency()
         pipeline.set_observability(obs)
         obs.registry.reset_clock()
     timed_from = getattr(pipeline, "_interval", warmup)
@@ -182,14 +190,30 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
         obs.registry.stop_clock()
         pipeline.set_observability(None)
     lats = []
+    fe_lats = []
+    tracer = obs.latency if obs is not None else None
     t_lat = time.perf_counter()
     with _span("latency"):
         for _ in range(latency_samples):
             pipeline.sync()
             t1 = time.perf_counter()
+            # first-emit probe (ISSUE 14): a force-sampled chain around
+            # exactly this drained sample — dispatch at run(1),
+            # eligibility the moment the watermark-advancing dispatch
+            # returns, emit when the window payload is host-delivered;
+            # first_emit = eligibility -> emit, the Karimov-style
+            # number the whole-sample wall time (lats) only bounds
+            lid = tracer.open(force=True) if tracer is not None else None
             out = pipeline.run(1)[0]
+            if lid is not None:
+                tracer.stamp(lid, _lat.STAGE_ELIGIBILITY)
             jax.device_get(emit_payload(out[2], out[3]))
             lats.append((time.perf_counter() - t1) * 1e3)
+            if lid is not None:
+                tracer.stamp(lid, _lat.STAGE_EMIT)
+                fin = tracer.finalize(lid)
+                if fin is not None and fin["first_emit_ms"] is not None:
+                    fe_lats.append(fin["first_emit_ms"])
             if (len(lats) >= LATENCY_SAMPLES_MIN
                     and time.perf_counter() - t_lat > latency_budget_s):
                 break
@@ -212,6 +236,7 @@ def _run_pipeline_cell(pipeline, cfg: BenchmarkConfig, window_spec: str,
     # stall can never masquerade as an engine latency
     for k, v in latency_stats(lats).items():
         setattr(res, k, v)
+    first_emit_stats(res, fe_lats)
     finalize_observability(res, obs, lats, emitted)
     # tunnel-independent emit latency (VERDICT r3 item 9): the fused step
     # computes an interval's window results within the same device program
@@ -401,6 +426,10 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
 
     if engine == "RingFed":
         return run_ring_fed_cell(cfg, window_spec, agg_name, obs=obs)
+
+    if engine == "LatencyHeadline":
+        return run_latency_headline_cell(cfg, window_spec, agg_name,
+                                         obs=obs)
 
     if engine == "RingFedMesh":
         return run_ring_fed_mesh_cell(cfg, window_spec, agg_name, obs=obs)
@@ -1987,6 +2016,272 @@ def measure_delivery_overhead(seed: int = 0, n_records: int = 3000,
                     / a_times[len(a_times) // 2] - 1.0)
 
 
+def measure_latency_overhead(seed: int = 0, throughput: int = 4_000_000,
+                             intervals: int = 6, pairs: int = 16) -> float:
+    """Interleaved A/B of the SAMPLING-OFF latency tracer on the
+    aligned pipeline (ISSUE 14 acceptance: ≤ 2% median): per-pair
+    obs-without-tracer vs obs-with-``sample_every=0`` tracer wall time
+    over the same timed intervals — isolating exactly what every
+    steady-state interval pays for the seams (one attribute check per
+    hook, one declined ``open()`` per interval). Returns the median
+    overhead in PERCENT (negative = within noise)."""
+    from ..core.aggregates import SumAggregation
+    from ..core.windows import SlidingWindow, WindowMeasure
+    from ..engine import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+
+    windows = [SlidingWindow(WindowMeasure.Time, 8000, 1000)]
+
+    def build(with_tracer: bool):
+        p = AlignedStreamPipeline(
+            windows, [SumAggregation()],
+            config=EngineConfig(capacity=2048, annex_capacity=8,
+                                min_trigger_pad=32),
+            throughput=_round_throughput(
+                throughput, AlignedStreamPipeline.slice_grid(windows,
+                                                             1000)),
+            wm_period_ms=1000, max_lateness=0, seed=seed, gc_every=32)
+        obs = _obs.Observability()
+        if with_tracer:
+            obs.attach_latency(sample_every=0)
+        p.reset()
+        p.run(2, collect=False)
+        p.sync()
+        p.set_observability(obs)
+        return p
+
+    pa, pb = build(False), build(True)
+
+    def once(p) -> float:
+        t0 = time.perf_counter()
+        p.run(intervals, collect=False)
+        p.sync()
+        return time.perf_counter() - t0
+
+    once(pa), once(pb)                       # warm both step paths
+    a_times, b_times = [], []
+    for i in range(pairs):
+        # alternate within-pair order so slow drift (thermal, other
+        # tenants on a shared core) cancels instead of biasing one arm
+        if i % 2 == 0:
+            a_times.append(once(pa))
+            b_times.append(once(pb))
+        else:
+            b_times.append(once(pb))
+            a_times.append(once(pa))
+    pa.check_overflow()
+    pb.check_overflow()
+    a_times.sort()
+    b_times.sort()
+    return 100.0 * (b_times[len(b_times) // 2]
+                    / a_times[len(a_times) // 2] - 1.0)
+
+
+def run_latency_headline_cell(cfg: BenchmarkConfig, window_spec: str,
+                              agg_name: str,
+                              obs: Optional[_obs.Observability] = None
+                              ) -> BenchResult:
+    """Latency-headline cell (ISSUE 14): the full ingest→emission edge
+    at the headline window shape with the emission-latency tracer in
+    EXACT mode — host records through ``BatchAccumulator.offer_block``
+    → ``IngestRing`` → ``DeviceRingFeeder`` prefetch → the batch
+    operator, watermarks through the synchronous emit face, every
+    delivered window through a ``TransactionalSink`` — so each sampled
+    chain carries the complete stage decomposition (arrival →
+    ring_enqueue → ring_dequeue → dispatch → eligibility → drain →
+    emit → sink). Recorded per cell: ``first_emit_p50/p99_ms``,
+    ``latency_stages_ms`` (the stage decomposition),
+    ``latency_conservation_ok`` (per-chain stage sums vs end-to-end),
+    ``latency_overhead_pct_median`` (the sampling-off interleaved A/B
+    arm), and an ``oracle_match`` arm bit-comparing the operator's
+    emitted windows against the host simulator on the same stream."""
+    import jax
+
+    from ..delivery import TransactionalSink
+    from ..engine import EngineConfig, TpuWindowOperator
+    from ..ingest import LineRateFeed, RingConfig
+    from ..obs.latency import CONSERVATION_TOL_MS, LatencyTracer
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    B = cfg.batch_size
+    n_chunks = int(max(8, cfg.throughput * cfg.runtime_s // B))
+    span = max(1.0, cfg.runtime_s * 1000 / n_chunks)
+    off0 = max(w.clear_delay() for w in windows)
+    rng = np.random.default_rng(cfg.seed)
+    n_pools = min(n_chunks, 12)
+    pools = []
+    for _ in range(n_pools):
+        ts = np.sort(rng.integers(0, max(1, int(span)),
+                                  size=B)).astype(np.int64)
+        vals = (rng.random(B) * 10_000).astype(np.float32)
+        pools.append((vals, ts))
+
+    def chunk(i):
+        vals, ts = pools[i % n_pools]
+        lo = off0 + int(i * span)
+        return vals, ts + np.int64(lo), off0 + int((i + 1) * span)
+
+    if obs is None:
+        obs = _obs.Observability()
+    tracer = obs.attach_latency(
+        LatencyTracer(sample_every=1, exact_limit=1 << 30))
+    op = TpuWindowOperator(config=EngineConfig(
+        capacity=cfg.capacity, batch_size=B,
+        overflow_policy=cfg.overflow_policy))
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(make_aggregation(agg_name))
+    op.set_max_lateness(cfg.max_lateness)
+    # obs passed explicitly: the ring/feed stamps must be live from the
+    # first offered block (the operator's obs attaches post-warmup)
+    feed = LineRateFeed(op, ring=RingConfig(
+        depth=cfg.ring_depth or 8, block_size=cfg.ring_block_size or B),
+        obs=obs)
+
+    delivered = []
+    sink = TransactionalSink(deliver=lambda w, e, s: delivered.append(w),
+                             obs=obs)
+
+    warm_hi = 0
+    for i in (0, 1):
+        v, t, warm_hi = chunk(i)
+        feed.offer_block(v, t)
+    for w_out in op.process_watermark(warm_hi + 1):
+        pass                               # warm compile, discard output
+    op.set_observability(obs)
+    obs.registry.reset_clock()
+    # warmup offers pre-stamped through the live feed while the compile
+    # ran — the first measured chain must not inherit those
+    tracer.reset_pending()
+
+    next_wm = (warm_hi // cfg.watermark_period_ms + 2) \
+        * cfg.watermark_period_ms
+    chains = []
+    _finalize = tracer._finalize
+
+    def spy(chain):
+        out = _finalize(chain)
+        chains.append(out)
+        return out
+
+    tracer._finalize = spy
+    emitted = 0
+    t0 = time.perf_counter()
+    for i in range(2, n_chunks):
+        v, t, hi = chunk(i)
+        feed.offer_block(v, t)
+        while hi >= next_wm:
+            outs = op.process_watermark(next_wm)
+            for w_out in outs:
+                if w_out.has_value() and sink.emit(w_out):
+                    emitted += 1
+            next_wm += cfg.watermark_period_ms
+    feed.drain()
+    for w_out in op.process_watermark(next_wm):
+        if w_out.has_value() and sink.emit(w_out):
+            emitted += 1
+    op.check_overflow()                     # folds the parked chain too
+    wall = time.perf_counter() - t0
+    obs.registry.stop_clock()
+    op.set_observability(None)
+    tracer._finalize = _finalize
+    n_tuples = (n_chunks - 2) * B
+
+    # -- per-chain conservation + first-emit over the EXACT chain set ----
+    fe_lats = []
+    conserve_ok = True
+    worst_gap = 0.0
+    for c in chains:
+        gap = abs(sum(c["stages"].values()) - c["end_to_end_ms"])
+        worst_gap = max(worst_gap, gap)
+        if gap > CONSERVATION_TOL_MS:
+            conserve_ok = False
+        if c["first_emit_ms"] is not None:
+            fe_lats.append(c["first_emit_ms"])
+
+    # -- host-simulator oracle arm: a small replica of the stream class --
+    # (per-record Python feeding at the headline batch size would cost
+    # minutes; the differential claim needs the WINDOW CLASS and the
+    # emit path, not the record count)
+    from ..simulator import SlicingWindowOperator
+
+    P = cfg.watermark_period_ms
+    B_o = 1024
+    sim = SlicingWindowOperator()
+    for w in windows:
+        sim.add_window_assigner(w)
+    sim.add_aggregation(make_aggregation(agg_name))
+    sim.set_max_lateness(cfg.max_lateness)
+    op2 = TpuWindowOperator(config=EngineConfig(
+        capacity=cfg.capacity, batch_size=B_o,
+        overflow_policy=cfg.overflow_policy))
+    for w in windows:
+        op2.add_window_assigner(w)
+    op2.add_aggregation(make_aggregation(agg_name))
+    op2.set_max_lateness(cfg.max_lateness)
+    rng_o = np.random.default_rng(cfg.seed + 1)
+    span_o = max(1, P // 2)
+    n_o = 24                       # 12 watermark intervals of event time
+    wm2 = None
+    eng_rows, sim_rows = [], []
+    for i in range(n_o):
+        lo = off0 + i * span_o
+        t = np.sort(rng_o.integers(0, span_o, size=B_o)) + np.int64(lo)
+        # float32-exact integer values (the chaos-suite discipline):
+        # window sums stay far below 2^24, so the engine's f32
+        # accumulation and the simulator's float64 agree BIT-exactly
+        # in any summation order
+        v = rng_o.integers(0, 10, size=B_o).astype(np.float32)
+        for j in range(B_o):
+            sim.process_element(float(v[j]), int(t[j]))
+        op2.process_elements(v, t.astype(np.int64))
+        hi = lo + span_o
+        if wm2 is None:
+            wm2 = (off0 // P + 2) * P
+        while i >= 2 and hi >= wm2:
+            eng_rows += [(w.start, w.end, tuple(map(float, w.agg_values)))
+                         for w in op2.process_watermark(wm2)
+                         if w.has_value()]
+            sim_rows += [(w.start, w.end, tuple(map(float, w.agg_values)))
+                         for w in sim.process_watermark(wm2)
+                         if w.has_value()]
+            wm2 += P
+    op2.check_overflow()
+    oracle_match = sorted(eng_rows) == sorted(sim_rows) \
+        and len(eng_rows) > 0
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall,
+        p99_emit_ms=0.0,
+        n_windows_emitted=emitted, n_tuples=n_tuples, wall_s=wall)
+    # p99_emit_ms carries the DELIVERY number (eligibility -> sink),
+    # not end-to-end chain time — a chain's end-to-end includes the
+    # idle accumulation between watermarks (the 'eligibility' stage),
+    # which is cadence, not emission latency
+    for k, v in latency_stats(fe_lats).items():
+        setattr(res, k, v)
+    first_emit_stats(res, fe_lats)
+    snap = obs.snapshot()
+    from ..obs.latency import attribute
+
+    attr = attribute(snap)
+    res.latency_stages_ms = attr["stages"]
+    res.latency_conservation_ok = bool(
+        conserve_ok and attr["conservation_ok"])
+    res.latency_worst_chain_gap_ms = worst_gap
+    res.latency_chains = len(chains)
+    res.oracle_match = bool(oracle_match)
+    res.oracle_windows = len(eng_rows)
+    res.latency_owner_stage = attr.get("owner")
+    res.latency_overhead_pct_median = round(
+        measure_latency_overhead(seed=cfg.seed), 2)
+    res.platform = jax.devices()[0].platform
+    res.host_cores = os.cpu_count()
+    finalize_observability(res, obs, [], emitted, n_tuples=n_tuples)
+    return res
+
+
 def run_soak_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
                   obs: Optional[_obs.Observability] = None) -> BenchResult:
     """Soak cell (ISSUE 7): run the endurance harness at a configured
@@ -2587,7 +2882,8 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
                obs_dir: Optional[str] = None,
                serve_port: Optional[int] = None,
                flight_capacity: Optional[int] = None,
-               health_lag_ms: Optional[float] = None) -> List[dict]:
+               health_lag_ms: Optional[float] = None,
+               health_first_emit_ms: Optional[float] = None) -> List[dict]:
     """All cells of one config; writes result_<name>.json (each cell row
     carries a ``metrics`` section unless ``collect_metrics=False``). With
     ``obs_dir``, additionally exports a per-config JSONL time series (one
@@ -2601,7 +2897,9 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
     each cell completes); ``flight_capacity`` attaches a FlightRecorder
     of that many ring slots to every cell's Observability (wraparound
     drops surface as the gated ``flight_dropped_events`` counter);
-    ``health_lag_ms`` arms the ``/healthz`` watermark-lag check."""
+    ``health_lag_ms`` arms the ``/healthz`` watermark-lag check;
+    ``health_first_emit_ms`` arms the windowed first-emit p99 check
+    (ISSUE 14 — the unhealthy verdict names the owning stage)."""
     if echo is None:
         echo = _stdout
     rows = []
@@ -2632,7 +2930,8 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
     if serve_port is not None and collect_metrics:
         from ..obs.server import HealthPolicy, serve as _serve
 
-        health = HealthPolicy(max_watermark_lag_ms=health_lag_ms)
+        health = HealthPolicy(max_watermark_lag_ms=health_lag_ms,
+                              max_first_emit_p99_ms=health_first_emit_ms)
         server = _serve(lambda: live["obs"], port=serve_port,
                         health=health)
         echo(f"  live obs endpoint: http://127.0.0.1:{server.port}"
@@ -2675,6 +2974,13 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                 cell["rtt_floor_ms"] = rtt_floor
                 for extra in ("link_mbps_raw", "link_mbps_achieved",
                               "link_saturation", "n_lat_samples",
+                              "first_emit_p50_ms", "first_emit_p99_ms",
+                              "first_emit_samples",
+                              "latency_stages_ms",
+                              "latency_conservation_ok",
+                              "latency_worst_chain_gap_ms",
+                              "latency_chains", "latency_owner_stage",
+                              "latency_overhead_pct_median",
                               "p50_emit_ms", "emit_ms_device",
                               "p99_emit_ms_trimmed", "n_stall_samples",
                               "n_trimmed_samples", "stall_flagged",
@@ -2803,6 +3109,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="arm the /healthz watermark-lag check "
                          "(scotty_tpu.obs.HealthPolicy): verdicts flip "
                          "unhealthy while watermark_lag_ms exceeds MS")
+    ap.add_argument("--health-first-emit-ms", default=None, type=float,
+                    metavar="MS",
+                    help="arm the /healthz windowed first-emit check "
+                         "(scotty_tpu.obs.HealthPolicy."
+                         "max_first_emit_p99_ms): verdicts flip "
+                         "unhealthy while p99 first-emit latency over "
+                         "the recent sample window exceeds MS, naming "
+                         "the stage that owns the critical path")
     ap.add_argument("--soak-seconds", default=None, type=float,
                     metavar="S",
                     help="override every config's soakSeconds (the Soak "
@@ -2854,7 +3168,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    collect_metrics=not args.no_obs, obs_dir=args.obs_dir,
                    serve_port=args.serve_port,
                    flight_capacity=args.flight_capacity,
-                   health_lag_ms=args.health_lag_ms)
+                   health_lag_ms=args.health_lag_ms,
+                   health_first_emit_ms=args.health_first_emit_ms)
         if args.gate:
             if baseline_snap is None:
                 _stdout(f"  gate: no baseline for {cfg.name} — skipped "

@@ -25,6 +25,7 @@ import numpy as np
 
 from .. import obs as _obs
 from ..obs import flight as _flight
+from ..obs import latency as _lat
 from ..core.aggregates import AggregateFunction
 from ..core.operator import AggregateWindow
 from ..core.windows import Window
@@ -352,6 +353,10 @@ class KeyedScottyWindowOperator:
     def _process_element_now(self, key: Hashable, value: Any, ts: int
                              ) -> List[Tuple[Hashable, AggregateWindow]]:
         if self.obs is not None:
+            if self.obs.latency is not None:
+                # record-arrival pre-stamp (ISSUE 14): the connector
+                # boundary is where a record's emission chain begins
+                self.obs.latency.pre(_lat.STAGE_ARRIVAL)
             self.obs.counter(_obs.INGEST_TUPLES).inc()
             wm_cur = self.policy.current_watermark()
             if wm_cur is not None \

@@ -104,6 +104,14 @@ class TransactionalSink:
         self.emitted += 1
         if self.obs is not None:
             self.obs.counter(_obs.DELIVERY_EMITTED).inc()
+            if self.obs.latency is not None:
+                # emission-latency sink stamp (ISSUE 14): the first
+                # delivery of the awaiting chain's batch sets the
+                # first-emit endpoint, every delivery advances the
+                # whole-emission lag. AFTER the high-water mark on
+                # purpose — a stamp must never become a new crash
+                # site between sequencing and delivery.
+                self.obs.latency.sink_delivered()
         return True
 
     def filter(self, items):

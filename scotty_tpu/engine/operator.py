@@ -28,6 +28,7 @@ import numpy as np
 
 from .. import obs as _obs
 from ..obs import flight as _flight
+from ..obs import latency as _lat
 from ..core.aggregates import AggregateFunction
 from ..core.operator import AggregateWindow, WindowOperator
 from ..core.windows import (
@@ -300,6 +301,10 @@ class TpuWindowOperator(WindowOperator):
         #: its staged records first (same contract as the shaper) and
         #: check_overflow folds its ingest_ring_* telemetry.
         self._ingest_feed = None
+        #: the in-flight emission-latency chain key (ISSUE 14): one per
+        #: watermark, opened at dispatch, completed at the arrays/emit
+        #: face and closed by the sink handoff (obs.latency)
+        self._lat_open = None
         if shaper is not None:
             from ..shaper import ShaperConfig, StreamShaper
 
@@ -851,6 +856,15 @@ class TpuWindowOperator(WindowOperator):
     def process_elements(self, elements: Sequence, timestamps: Sequence) -> None:
         if not self._built:
             self._build()
+        lat = self.obs.latency if self.obs is not None else None
+        if lat is not None:
+            # emission-latency lineage (ISSUE 14): record-arrival at
+            # the operator boundary — unless this call IS the shaper's
+            # flush re-entering (then the arrival already stamped when
+            # the records first offered, and THIS moment is the
+            # shaper_flush stage)
+            lat.pre(_lat.STAGE_SHAPER_FLUSH if self._shaper_feeding
+                    else _lat.STAGE_ARRIVAL)
         if self._shaper is not None and not self._shaper_feeding:
             # shaped ingest: the accumulator coalesces/sorts and calls
             # back into this method (reentrancy flag set) per full block
@@ -875,6 +889,10 @@ class TpuWindowOperator(WindowOperator):
     def _launch_batch(self, take: int) -> None:
         """Pop `take` tuples from the pending queue, pad to batch_size,
         ts-sort (late tuples must be grouped for the annex path), launch."""
+        if self.obs is not None and self.obs.latency is not None:
+            # device-work-begins pre-stamp for the next watermark's
+            # emission chain (first launch since the last claim wins)
+            self.obs.latency.pre(_lat.STAGE_DISPATCH)
         B = self.config.batch_size
         if len(self._pend_vals) == 1:
             vals_cat, ts_cat = self._pend_vals[0], self._pend_ts[0]
@@ -1491,6 +1509,11 @@ class TpuWindowOperator(WindowOperator):
         host tuple-count mirrors (a conservative total is fine)."""
         if not self._built:
             self._build()
+        if self.obs is not None and self.obs.latency is not None:
+            # dispatch pre-stamp (ISSUE 14): the host-side moment this
+            # device batch's ingest program is dispatched — pure Python,
+            # the ingest kernel HLO is untouched
+            self.obs.latency.pre(_lat.STAGE_DISPATCH)
         if self.config.overflow_policy != "fail":
             raise UnsupportedOnDevice(
                 "overflow policies need host-visible timestamps for the "
@@ -1636,6 +1659,14 @@ class TpuWindowOperator(WindowOperator):
                  if measures is not None and measures.shape[0] > i
                  and measures[i] else WindowMeasure.Time)
             out.append(AggregateWindow(m, int(ws[i]), int(we[i]), values, has))
+        if self._lat_open is not None and self.obs is not None \
+                and self.obs.latency is not None:
+            # hand the chain to the sink slot: a TransactionalSink
+            # downstream stamps the first delivery and closes it; a
+            # sink-less run's chain closes at the next watermark or the
+            # check_overflow flush
+            self.obs.latency.emitted(self._lat_open)
+            self._lat_open = None
         return out
 
     def process_watermark_async(self, watermark_ts: int):
@@ -1655,8 +1686,26 @@ class TpuWindowOperator(WindowOperator):
         obs = self.obs
         if obs is None:
             return self._process_watermark_dispatch(watermark_ts)
+        lat = obs.latency
+        if lat is not None and self._lat_open is not None:
+            # an async caller never fetched the previous watermark's
+            # results through this operator — close its chain as-is
+            # (no drain/emit stamps) instead of leaking it to eviction
+            lat.finalize(self._lat_open)
+            self._lat_open = None
+        t_elig = lat.clock.now() if lat is not None else 0.0
         t0 = time.perf_counter()
         out = self._process_watermark_dispatch(watermark_ts)
+        if lat is not None:
+            # emission-latency lineage (ISSUE 14): the watermark's
+            # arrival IS the eligibility moment for every window it
+            # closes — the chain opens here, claiming the pending
+            # arrival/ring/shaper/dispatch pre-stamps of the records
+            # this watermark sweeps (drains inside the dispatch above
+            # may add late pre-stamps; finalize time-orders them). One
+            # chain per watermark, completed by the arrays/emit face.
+            self._lat_open = lat.open()
+            lat.stamp(self._lat_open, _lat.STAGE_ELIGIBILITY, at=t_elig)
         # host-side, interval-boundary telemetry: dispatch wall time (no
         # device sync — delivery latency is the harness's emit_latency_ms),
         # watermark count, and event-time lag of the watermark behind the
@@ -1839,6 +1888,14 @@ class TpuWindowOperator(WindowOperator):
             outs.append((m_d, e_s, e_e, e_c, e_p))
         return outs
 
+    def _lat_stamp(self, stage: str) -> None:
+        """Stamp one stage on the in-flight watermark chain (no-op
+        without a tracer or an open chain — one attribute check)."""
+        if self._lat_open is not None and self.obs is not None:
+            lat = self.obs.latency
+            if lat is not None:
+                lat.stamp(self._lat_open, stage)
+
     def process_watermark_arrays(self, watermark_ts: int):
         """Synchronous watermark: returns numpy ``(starts[T], ends[T],
         counts[T], [per-agg lowered [T]])`` — one bundled device fetch."""
@@ -1846,6 +1903,7 @@ class TpuWindowOperator(WindowOperator):
         if isinstance(out[0], str) and out[0] == "session":
             ws, we, cnt, lowered = self._fetch_sessions(out[1])
             self._trigger_measures = np.zeros((ws.shape[0],), bool)
+            self._lat_stamp(_lat.STAGE_EMIT)
             return ws, we, cnt, lowered
         if isinstance(out[0], str) and out[0] == "mixed":
             _, grid, s_outs = out
@@ -1859,8 +1917,11 @@ class TpuWindowOperator(WindowOperator):
             is_count = grid[2]
             self._trigger_measures = np.concatenate(
                 [is_count, np.zeros((s_ws.shape[0],), bool)])
+            self._lat_stamp(_lat.STAGE_EMIT)
             return ws, we, cnt, lowered
-        return self._fetch_grid(out)
+        res = self._fetch_grid(out)
+        self._lat_stamp(_lat.STAGE_EMIT)
+        return res
 
     def _fetch_grid(self, grid):
         import jax
@@ -1875,6 +1936,7 @@ class TpuWindowOperator(WindowOperator):
             ovf_src = self._state.overflow if self._rec is None \
                 else self._state.overflow | self._rec.overflow
             cnt_h, res_h, ovf = jax.device_get((cnt_d, results, ovf_src))
+            self._lat_stamp(_lat.STAGE_DRAIN)
             self._raise_if_overflow(ovf)
             cnt_np = cnt_h[:T]
             for agg, res in zip(self.aggregations, res_h):
@@ -1940,6 +2002,16 @@ class TpuWindowOperator(WindowOperator):
             # and sample the flight ring (zero additional device syncs —
             # the watermark advance itself was recorded at dispatch)
             self.obs.flight_sample()
+            lat = self.obs.latency
+            if lat is not None:
+                # latency drain-point tidy: close a chain an async
+                # caller left open and a parked sink handoff, fold the
+                # lineage/drop totals — same discipline as the folds
+                # above, zero extra syncs
+                if self._lat_open is not None:
+                    lat.finalize(self._lat_open)
+                    self._lat_open = None
+                lat.flush()
 
     def _fetch_sessions(self, outs):
         """Fetch per-session-window sweep outputs; emission follows window
@@ -1949,6 +2021,7 @@ class TpuWindowOperator(WindowOperator):
         fetched = jax.device_get(
             (outs, tuple(s.overflow for s in (list(self._session_states)
                                               + list(self._ctx_states)))))
+        self._lat_stamp(_lat.STAGE_DRAIN)
         gap_outs, ovfs = fetched
         for ovf in ovfs:
             self._raise_if_overflow(ovf)

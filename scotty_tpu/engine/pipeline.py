@@ -31,6 +31,7 @@ import numpy as np
 from .. import jax_config  # noqa: F401
 from .. import obs as _obs
 from ..obs import flight as _flight
+from ..obs import latency as _lat
 
 from ..core.aggregates import AggregateFunction
 from ..core.windows import (
@@ -514,11 +515,20 @@ class FusedPipelineDriver:
         if self._needs_reset():
             self.reset()
         obs = self.obs
+        lat = obs.latency if obs is not None else None
         out = []
         for _ in range(n_intervals):
             i = self._interval
             t0 = time.perf_counter() if obs is not None else 0.0
+            # emission-latency lineage (ISSUE 14, host-side only —
+            # the step HLO stays pinned byte-identical): the chain
+            # opens at dispatch; the step's own watermark advance IS
+            # the eligibility moment for this interval's windows, so
+            # eligibility stamps the instant the dispatch returns
+            lid = lat.open() if lat is not None else None
             res = self._step_interval(self._interval_key(i), i)
+            if lid is not None:
+                lat.stamp(lid, _lat.STAGE_ELIGIBILITY)
             self._interval += 1
             if obs is not None:
                 obs.histogram(_obs.INTERVAL_STEP_MS).observe(
@@ -572,6 +582,15 @@ class FusedPipelineDriver:
             # sync): the watermark this pipeline has advanced to plus the
             # registry deltas since the last drain land in the ring
             obs.flight_sync(watermark=self._interval * self.wm_period_ms)
+            lat = obs.latency
+            if lat is not None:
+                # every queued interval's chain observes this one drain
+                # (the sync drains them all); the drain IS the delivery
+                # point of the steady-state pipelined flow, so chains
+                # close here — the stamp rides the fetch that already
+                # happened, zero extra syncs
+                lat.stamp_open(_lat.STAGE_DRAIN)
+                lat.finalize_open()
         return v
 
     def enforce_overflow_policy(self, factory=None, obs=None):

@@ -40,6 +40,7 @@ from typing import Callable, Optional
 
 from .. import obs as _obs
 from ..obs import flight as _flight
+from ..obs import latency as _lat
 from ..resilience.clock import Clock, SystemClock
 from ..resilience.connectors import flag_stall
 from .ring import IngestRing, RingBlock, RingConfig, RingFull
@@ -53,11 +54,16 @@ class BlockSinkFeeder:
     accumulator's slack band past this call, while the freed slot
     recycles to the producer and is overwritten)."""
 
-    def __init__(self, ring: IngestRing, sink: Callable):
+    def __init__(self, ring: IngestRing, sink: Callable, obs=None):
         self.ring = ring
         self.sink = sink
+        self.obs = obs
 
     def _deliver(self, blk: RingBlock) -> None:
+        if self.obs is not None and self.obs.latency is not None:
+            # ring-dequeue pre-stamp (ISSUE 14): the block leaves the
+            # staging ring for the downstream sink
+            self.obs.latency.pre(_lat.STAGE_RING_DEQUEUE)
         n = blk.n
         if self.ring.keyed:
             self.sink(blk.keys[:n].copy(), blk.vals[:n].copy(),
@@ -155,6 +161,11 @@ class DeviceRingFeeder:
     def _dispatch_oldest(self) -> int:
         import time
 
+        op_obs = getattr(self.op, "obs", None)
+        if op_obs is not None and op_obs.latency is not None:
+            # ring-dequeue pre-stamp (ISSUE 14): the oldest staged
+            # block's ingest is about to dispatch
+            op_obs.latency.pre(_lat.STAGE_RING_DEQUEUE)
         blk, v_dev, t_dev = self._staged.popleft()
         t0 = time.perf_counter()
         if self.shaper is not None:
@@ -264,7 +275,7 @@ class RingIngestor:
         ``sink`` (the operator's block replay)."""
         B = config.block_size or block_size_default
         ring = IngestRing(config.depth, B, keyed=keyed, value_dtype=None)
-        feeder = BlockSinkFeeder(ring, sink)
+        feeder = BlockSinkFeeder(ring, sink, obs=obs)
         return cls(ring, feeder, policy=config.policy,
                    pump_at=config.pump_at, obs=obs, clock=clock,
                    stall_timeout_s=config.stall_timeout_s,
@@ -272,9 +283,16 @@ class RingIngestor:
                    stage_deadline_s=stage_deadline_s)
 
     # -- producing ---------------------------------------------------------
+    def _lat_enqueue(self) -> None:
+        if self.obs is not None and self.obs.latency is not None:
+            # ring-enqueue pre-stamp (ISSUE 14): oldest record accepted
+            # into the staging ring since the last chain claim
+            self.obs.latency.pre(_lat.STAGE_RING_ENQUEUE)
+
     def offer_one(self, val, ts, key=None) -> bool:
         """One record in; returns False iff it was SHED (policy='shed'
         while full). Blocking policy never loses the record."""
+        self._lat_enqueue()
         while not self.ring.offer_one(val, ts, key):
             if not self._on_full([val], [ts],
                                  None if key is None else [key]):
@@ -288,6 +306,7 @@ class RingIngestor:
         rest — nonzero only under policy='shed' — were shed, counted and
         handed to ``shed_callback``)."""
         v, t, k = self.ring.coerce_block(vals, ts, keys)
+        self._lat_enqueue()
         pos, n = 0, t.size
         while pos < n:
             pos += self.ring.offer_block(

@@ -30,6 +30,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import latency as _lat
 from ..resilience.clock import Clock, SystemClock
 from .feeder import DeviceRingFeeder, RingIngestor
 from .ring import IngestRing, RingConfig
@@ -54,6 +55,7 @@ class LineRateFeed:
         self.op = op
         self.clock = clock or SystemClock()
         obs = obs if obs is not None else getattr(op, "obs", None)
+        self.obs = obs
         B = ring.block_size or op.config.batch_size
         if B != op.config.batch_size:
             raise ValueError(
@@ -119,6 +121,10 @@ class LineRateFeed:
     def offer_block(self, vals, ts) -> None:
         """Offer a chunk of host records (any timestamp order within the
         configured slack/shaper tolerance)."""
+        if self.obs is not None and self.obs.latency is not None:
+            # record-arrival pre-stamp (ISSUE 14): the line-rate feed
+            # IS the connector boundary for externally-fed streams
+            self.obs.latency.pre(_lat.STAGE_ARRIVAL)
         self.accumulator.offer_block(vals, ts)
         self._propagate_deadline()
 

@@ -377,10 +377,20 @@ class MeshKeyedPipeline(FusedPipelineDriver):
 
     def lowered_results_for_key(self, interval_out, key_idx: int) -> list:
         """Fetch + lower one interval's window results for one LOGICAL
-        key (row attribution through the routing table)."""
+        key (row attribution through the routing table). The fetch
+        duration folds into the owning shard's
+        ``latency_shard_<s>_emit_ms`` histogram (ISSUE 14 — the
+        per-shard stamp at the psum drain, on the tracer's injectable
+        clock; host-side only, the shard_map step HLO stays pinned)."""
         import jax
 
+        lat = self.obs.latency if self.obs is not None else None
+        t0 = lat.clock.now() if lat is not None else 0.0
         ws, we, cnt, results = jax.device_get(interval_out[:4])
+        if lat is not None:
+            shard = int(self.routing.row_of[key_idx]) \
+                // self.routing.rows_per_shard
+            lat.shard_fold(shard, (lat.clock.now() - t0) * 1e3)
         r = int(self.routing.row_of[key_idx])
         cnt_k = cnt[r]
         lowered = [np.asarray(agg.device_spec().lower(res[r], cnt_k))

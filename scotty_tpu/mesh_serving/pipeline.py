@@ -569,8 +569,16 @@ class MeshServingPipeline(FusedPipelineDriver):
 
         ws_d, we_d, cnt_d, results_d = interval_out[:4]
         r = int(self.routing.row_of[key_idx])
+        # per-shard latency fold at the psum drain (ISSUE 14): the
+        # sampled-key fetch attributes its duration to the owning shard
+        # on the tracer's injectable clock (host-side; HLO pin intact)
+        lat = self.obs.latency if self.obs is not None else None
+        t0 = lat.clock.now() if lat is not None else 0.0
         ws, we, cnt_k, res_k = jax.device_get(
             (ws_d, we_d, cnt_d[r], [res[r] for res in results_d]))
+        if lat is not None:
+            lat.shard_fold(r // self.routing.rows_per_shard,
+                           (lat.clock.now() - t0) * 1e3)
         lowered = [np.asarray(agg.device_spec().lower(rk, cnt_k))
                    for agg, rk in zip(self.aggregations, res_k)]
         return ws, we, cnt_k, lowered

@@ -79,6 +79,31 @@ layer; :mod:`.flight`, :mod:`.server`, :mod:`.postmortem`):
 ``health_unhealthy``        counter: verdicts that came back unhealthy
                             (gated by the default ``obs diff``)
 ==========================  ==================================================
+
+Emission-latency contract (ISSUE 14 — :mod:`.latency`: stage-stamped
+window lineage, sampled 1-in-N with an exact small-stream mode, every
+stamp host-side at existing drain points on the injectable
+``resilience.Clock``; ``python -m scotty_tpu.obs latency <export>``
+prints the critical-path attribution):
+
+=============================  ===========================================
+``latency_stage_<stage>_ms``   histogram: one stage's share of a sampled
+                               chain (stages: arrival, ring_enqueue,
+                               ring_dequeue, shaper_flush, dispatch,
+                               eligibility, drain, emit, sink)
+``latency_first_emit_ms``      histogram: watermark-eligibility → first
+                               delivered window (ROADMAP item 4's bench
+                               dimension)
+``latency_eligibility_ms``     histogram: eligibility → last delivery
+                               (the Karimov-style whole-emission lag)
+``latency_end_to_end_ms``      histogram: first stamp → last stamp
+                               (stage durations sum to exactly this)
+``latency_shard_<s>_emit_ms``  histogram: mesh per-shard emit-fetch time
+                               folded at the psum drain
+``latency_lineages``           counter: sampled chains finalized
+``latency_stamp_dropped``      counter: chains evicted unfinalized /
+                               late stamps (gated by ``obs diff``)
+=============================  ===========================================
 """
 
 from __future__ import annotations
@@ -208,6 +233,25 @@ MESH_RESHARDS = "mesh_reshards"
 MESH_RESHARD_RETRACES = "mesh_reshard_retraces"
 SERVING_TENANT_OTHER = "serving_tenant_other"
 
+# emission-latency attribution contract (ISSUE 14 — scotty_tpu.obs.
+# latency: stage-stamped window lineage from ingest to delivered
+# emission. Stage histograms are latency_stage_<stage>_ms (stages:
+# arrival, ring_enqueue, ring_dequeue, shaper_flush, dispatch,
+# eligibility, drain, emit, sink); per-shard mesh emit folds are
+# latency_shard_<s>_emit_ms. latency_stamp_dropped APPEARING gates the
+# default ``obs diff`` — a tracer that lost stamps is losing the very
+# attribution it exists to provide. Defined ONCE in .latency (the
+# module that observes under them) and re-exported here so METRIC_HELP
+# and the diff gate can never drift from the recording side.
+from .latency import (  # noqa: E402  (contract re-export)
+    LATENCY_ELIGIBILITY_MS,
+    LATENCY_END_TO_END_MS,
+    LATENCY_FIRST_EMIT_MS,
+    LATENCY_LINEAGES,
+    LATENCY_OPEN_DECLINED,
+    LATENCY_STAMP_DROPPED,
+)
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -313,6 +357,22 @@ METRIC_HELP = {
         "flight-recorder ring events lost to wraparound",
     HEALTH_CHECKS: "/healthz verdicts computed",
     HEALTH_UNHEALTHY: "/healthz verdicts that came back unhealthy",
+    LATENCY_FIRST_EMIT_MS:
+        "watermark-eligibility -> first delivered window of a sampled "
+        "emission chain",
+    LATENCY_ELIGIBILITY_MS:
+        "watermark-eligibility -> last delivery of the chain (the "
+        "Karimov-style whole-emission lag)",
+    LATENCY_END_TO_END_MS:
+        "first stage stamp -> last stage stamp of a sampled chain "
+        "(stage durations telescope to exactly this)",
+    LATENCY_LINEAGES: "sampled emission chains finalized",
+    LATENCY_STAMP_DROPPED:
+        "latency stamps/finalizes that lost their chain "
+        "(gated by the default obs diff)",
+    LATENCY_OPEN_DECLINED:
+        "latency lineages declined at max_open in-flight chains "
+        "(sampling backpressure — coverage, not loss)",
 }
 
 
@@ -335,11 +395,16 @@ class Observability:
                  spans: Optional[SpanRecorder] = None,
                  annotate: bool = False,
                  flight: Optional[FlightRecorder] = None,
-                 postmortem_dir: Optional[str] = None):
+                 postmortem_dir: Optional[str] = None,
+                 latency=None):
         self.registry = registry or MetricsRegistry()
         self.spans = spans or SpanRecorder(annotate=annotate)
         self.flight = flight
         self.postmortem_dir = postmortem_dir
+        #: emission-latency tracer (ISSUE 14): None by default — every
+        #: stamping seam pays one attribute check, exactly the flight
+        #: discipline. Attach with :meth:`attach_latency`.
+        self.latency = latency.bind(self) if latency is not None else None
         self._flight_prev: dict = {}
         #: crash-site seam (ISSUE 8): when set, called as
         #: ``flight_hook(kind, name, value)`` BEFORE every flight event
@@ -437,6 +502,19 @@ class Observability:
                                float(watermark))
         self.flight_sample()
 
+    # -- emission-latency attribution (ISSUE 14) --------------------------
+    def attach_latency(self, tracer=None, **kwargs):
+        """Attach (and return) a :class:`.latency.LatencyTracer` —
+        construction kwargs (``clock=``, ``sample_every=``, …) pass
+        through when no tracer is given; detach with
+        ``obs.latency = None``."""
+        from .latency import LatencyTracer
+
+        if tracer is None:
+            tracer = LatencyTracer(**kwargs)
+        self.latency = tracer.bind(self)
+        return tracer
+
     def record_failure(self, exc: BaseException, kind: str = "overflow",
                        config=None, checkpoint: Optional[str] = None):
         """Flight-record a fatal event and, when ``postmortem_dir`` is
@@ -518,6 +596,9 @@ __all__ = [
     "SERVING_RETRACES", "SERVING_CACHE_HITS", "SERVING_CACHE_MISSES",
     "SERVING_CACHE_EVICTIONS", "SERVING_ACTIVE_QUERIES",
     "MESH_RESHARDS", "MESH_RESHARD_RETRACES", "SERVING_TENANT_OTHER",
+    "LATENCY_FIRST_EMIT_MS", "LATENCY_ELIGIBILITY_MS",
+    "LATENCY_END_TO_END_MS", "LATENCY_LINEAGES", "LATENCY_STAMP_DROPPED",
+    "LATENCY_OPEN_DECLINED",
     "RESILIENCE_SHED_TUPLES", "RESILIENCE_GROW_EVENTS",
     "RESILIENCE_CHECKPOINTS", "RESILIENCE_RESTARTS",
     "DELIVERY_EMITTED", "DELIVERY_DUPLICATES_SUPPRESSED",

@@ -1,4 +1,5 @@
-"""CLI entry: ``python -m scotty_tpu.obs {report,diff,postmortem} ...``."""
+"""CLI entry: ``python -m scotty_tpu.obs
+{report,diff,latency,postmortem,fsck} ...``."""
 
 import sys
 

@@ -132,6 +132,21 @@ DEFAULT_THRESHOLDS = {
         "delivery_duplicates_suppressed": {"direction": "lower",
                                            "default": 0},
         "ckpt_integrity_failures": {"direction": "lower", "default": 0},
+        # emission-latency contract (ISSUE 14): first-emit p99 growing
+        # >10% on the same workload is a latency regression even when
+        # throughput held (the whole point of the stage-stamped lineage
+        # — ROADMAP item 4's criterion is judged on this number); the
+        # cell-row field and the registry-histogram export key are both
+        # gated because cells that measure first-emit directly embed
+        # the former while JSONL/snapshot exports only carry the
+        # latter. No "default": an export without samples (sampling
+        # disabled) is one-sided and skips, never a false gate.
+        # latency_stamp_dropped APPEARING gates — a tracer evicting
+        # unfinalized chains is losing its own attribution.
+        "first_emit_p99_ms": {"direction": "lower", "rel_tol": 0.10},
+        "latency_first_emit_ms_p99": {"direction": "lower",
+                                      "rel_tol": 0.10},
+        "latency_stamp_dropped": {"direction": "lower", "default": 0},
         # operations contract (ISSUE 4): flight-ring wraparound drops and
         # unhealthy /healthz verdicts appearing between two exports gate —
         # a run that silently lost its own black-box tail, or that an

@@ -148,6 +148,14 @@ MESH_QUERY_CANCEL = "mesh_query_cancel"
 # removing an aged-out generation
 EMIT = "emit"
 DUPLICATE_SUPPRESSED = "duplicate_suppressed"
+# emission-latency lineage events (ISSUE 14, scotty_tpu.obs.latency):
+# one event per stage boundary of a finalized sampled chain (name = the
+# stage, value = the stage's duration in ms) — a postmortem timeline
+# shows exactly where the last emissions were spending their time when
+# the run died. Recorded via FlightRecorder.record directly (no
+# flight_hook crash seam: a latency stamp must never become a new
+# crash-point site inside the emission path it is measuring)
+LATENCY_STAGE = "latency_stage"
 #: generic fatal failure recorded by ``record_failure`` when no more
 #: specific kind applies (the postmortem CLI's ``crash`` cause class)
 CRASH = "crash"

@@ -1,0 +1,631 @@
+"""End-to-end emission-latency attribution (ISSUE 14 tentpole).
+
+The only latency signal before this module was a wall-clock
+``latency_stats`` over whole-interval emit times — p99 has sat pinned at
+~140–190 ms over a 71–113 ms RTT floor since r3 (BENCH_r03–r05) with no
+way to say *which stage* owns it. Following the event-time latency
+benchmarking discipline of Karimov et al. (ICDE 2018 — the same
+TU-Berlin group as Scotty), which defines emission latency as
+watermark-eligibility→delivery rather than wall-clock interval time,
+:class:`LatencyTracer` stamps a sampled **birth chain** of host-side
+clock readings onto window emissions as they move through the full edge
+the repo now has::
+
+    arrival        record at the connector / BatchAccumulator boundary
+    ring_enqueue   record accepted by the IngestRing (RingIngestor)
+    ring_dequeue   block handed downstream (DeviceRingFeeder /
+                   BlockSinkFeeder)
+    shaper_flush   accumulator block flushed into the engine
+    dispatch       device step / ingest program dispatched
+    eligibility    the watermark that makes the windows emittable
+                   arrives (process_watermark / the fused step's
+                   watermark advance)
+    drain          results fetched at an existing drain point
+                   (sync() / process_watermark_arrays / check_overflow)
+    emit           window results materialized on host
+    sink           first TransactionalSink delivery of the chain
+
+Every stamp is HOST-side, read from the injectable
+:class:`~scotty_tpu.resilience.clock.Clock` (``ManualClock`` in the
+differential tests — the no-wall-clock lint covers this module like the
+rest of ``scotty_tpu/obs/``), and every stamp lands at a point where the
+host already runs Python: the zero-extra-sync discipline of the
+DeviceMetrics fold. Nothing here may enter a jitted code path — the
+aligned/session/count/context/mesh/mesh_serving step HLO pins stay
+byte-identical.
+
+Sampling: 1-in-``sample_every`` chains by default, with an **exact
+small-stream mode** — the first ``exact_limit`` chains are always
+sampled, so short differential runs attribute every emission while long
+bench runs pay O(1/N). Unsampled chains cost one modulo on ``open()``;
+stamps on them are no-ops. With ``max_open`` chains already in flight
+(a long dispatch run between drain points), ``open()`` DECLINES the
+lineage — sampling backpressure, counted in ``saturated``, never an
+eviction. ``latency_stamp_dropped`` — gated by the default ``obs
+diff`` thresholds, never silent — counts only stamps and finalizes
+that actually lost their chain.
+
+Derived numbers folded into the registry at finalize (names are the obs
+contract; stage histograms are ``latency_stage_<stage>_ms``):
+
+* ``latency_first_emit_ms`` — watermark-eligibility → the FIRST
+  delivered window of the chain (sink if one rode the chain, else host
+  materialization, else the drain fetch). The ROADMAP item 4 criterion
+  ("p99 first-emit under half the interval's emit latency") is measured
+  on exactly this number.
+* ``latency_eligibility_ms`` — eligibility → the LAST delivery the
+  chain saw (the Karimov-style whole-emission lag; equals first-emit
+  when one delivery closes the chain).
+* ``latency_end_to_end_ms`` — first stamp → last stamp. Stage
+  durations are consecutive deltas over the time-ordered stamps, so
+  ``sum(stages) == end_to_end`` EXACTLY (asserted to the float on
+  ManualClock by the differential suite).
+
+Sampled chains also render as ``latency/<stage>`` spans in the existing
+Chrome-trace exporter and land one ``latency_stage`` flight event per
+stage boundary, so a postmortem timeline shows where the last emissions
+were when a run died. ``python -m scotty_tpu.obs latency <export>``
+summarizes any export into a critical-path attribution table (which
+stage owns p99, conservation check).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..resilience.clock import Clock, SystemClock
+
+# -- the stage vocabulary (canonical order; chains may skip stages) --------
+STAGE_ARRIVAL = "arrival"
+STAGE_RING_ENQUEUE = "ring_enqueue"
+STAGE_RING_DEQUEUE = "ring_dequeue"
+STAGE_SHAPER_FLUSH = "shaper_flush"
+STAGE_DISPATCH = "dispatch"
+STAGE_ELIGIBILITY = "eligibility"
+STAGE_DRAIN = "drain"
+STAGE_EMIT = "emit"
+STAGE_SINK = "sink"
+
+#: canonical stage order — used to tie-break simultaneous stamps (a
+#: ManualClock that never advances must still produce a deterministic
+#: chain) and by the CLI's table ordering
+STAGES = (STAGE_ARRIVAL, STAGE_RING_ENQUEUE, STAGE_RING_DEQUEUE,
+          STAGE_SHAPER_FLUSH, STAGE_DISPATCH, STAGE_ELIGIBILITY,
+          STAGE_DRAIN, STAGE_EMIT, STAGE_SINK)
+_STAGE_RANK = {s: i for i, s in enumerate(STAGES)}
+
+#: pre-dispatch stages — stamped into the tracer's pending slot before a
+#: chain exists, claimed wholesale by the next ``open()``
+PRE_STAGES = (STAGE_ARRIVAL, STAGE_RING_ENQUEUE, STAGE_RING_DEQUEUE,
+              STAGE_SHAPER_FLUSH, STAGE_DISPATCH)
+
+# -- registry names (the obs contract; see obs/__init__.py METRIC_HELP) ----
+LATENCY_FIRST_EMIT_MS = "latency_first_emit_ms"
+LATENCY_ELIGIBILITY_MS = "latency_eligibility_ms"
+LATENCY_END_TO_END_MS = "latency_end_to_end_ms"
+LATENCY_LINEAGES = "latency_lineages"
+LATENCY_STAMP_DROPPED = "latency_stamp_dropped"
+LATENCY_OPEN_DECLINED = "latency_open_declined"
+#: per-stage histograms are ``latency_stage_<stage>_ms``
+LATENCY_STAGE_PREFIX = "latency_stage_"
+#: mesh per-shard emit folds are ``latency_shard_<s>_emit_ms``
+LATENCY_SHARD_PREFIX = "latency_shard_"
+
+
+def stage_metric(stage: str) -> str:
+    """Registry histogram name for one stage's durations."""
+    return f"latency_stage_{stage}_ms"
+
+
+def shard_metric(shard: int) -> str:
+    """Registry histogram name for one mesh shard's emit-fetch
+    durations (the per-shard fold at the psum drain)."""
+    return f"latency_shard_{shard}_emit_ms"
+
+
+class _Chain:
+    """One sampled lineage: stage → stamp time (first write wins), plus
+    the delivery bookkeeping the derived numbers read."""
+
+    __slots__ = ("key", "stamps", "last_delivery", "await_sink")
+
+    def __init__(self, key: int):
+        self.key = key
+        self.stamps: Dict[str, float] = {}
+        self.last_delivery: Optional[float] = None
+        self.await_sink = False
+
+
+class LatencyTracer:
+    """Stage-stamped emission-latency lineage (module docstring).
+
+    Single-writer by design, like the engine seams that call it: the
+    synchronous run loops interleave ingest and emission in one thread
+    (the asyncio path stamps from its consumer thread only). ``clock``
+    is the injectable resilience clock — every differential test drives
+    a :class:`~scotty_tpu.resilience.clock.ManualClock`.
+
+    ``sample_every`` / ``exact_limit`` — the sampling policy above.
+    ``sample_every=0`` disables sampling entirely (every ``open()``
+    returns None; the measured-overhead A/B arm). ``recent_window``
+    bounds the deques the windowed :class:`~.server.HealthPolicy`
+    first-emit check reads.
+    """
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 sample_every: int = 32, exact_limit: int = 128,
+                 max_open: int = 256, recent_window: int = 256,
+                 obs=None):
+        if sample_every < 0:
+            raise ValueError(f"sample_every must be >= 0, got "
+                             f"{sample_every}")
+        self.clock = clock or SystemClock()
+        self.sample_every = int(sample_every)
+        self.exact_limit = int(exact_limit)
+        self.max_open = int(max_open)
+        self.obs = obs
+        self._pending: Dict[str, float] = {}
+        self._open: "dict[int, _Chain]" = {}     # insertion-ordered
+        self._next_key = 0
+        self._opened = 0                  # chains considered (sampling)
+        self._await_sink: Optional[_Chain] = None
+        #: finalized-chain tails the windowed health check reads:
+        #: (first_emit_ms) and (stage, dur_ms) of recent sampled chains.
+        #: _recent_lock orders the /healthz server thread's reads
+        #: against the engine thread's finalize appends (a CPython
+        #: deque iterator raises on concurrent mutation)
+        self._recent_lock = threading.Lock()
+        self.recent_first_emit: deque = deque(maxlen=recent_window)
+        self.recent_stages: deque = deque(maxlen=recent_window)
+        #: exact totals (folded lazily into the registry by _fold)
+        self.lineages = 0
+        self.dropped = 0
+        self.saturated = 0      # opens declined at max_open (not a drop)
+        self._folded_lineages = 0
+        self._folded_dropped = 0
+        self._folded_saturated = 0
+
+    # -- attachment --------------------------------------------------------
+    def bind(self, obs) -> "LatencyTracer":
+        """Point the fold at an Observability (also done by
+        ``Observability.attach_latency``)."""
+        self.obs = obs
+        return self
+
+    # -- pre-dispatch stamps ----------------------------------------------
+    def pre(self, stage: str) -> None:
+        """Record a pre-dispatch stamp (``arrival`` … ``dispatch``) for
+        the chain the next ``open()`` will claim. First write per stage
+        wins — with batches coalescing into one dispatch, the chain
+        carries the OLDEST record's walk through the edge, which is the
+        worst case attribution wants."""
+        if stage not in self._pending:
+            self._pending[stage] = self.clock.now()
+
+    def reset_pending(self) -> None:
+        """Discard pending pre-dispatch stamps — callers that warm up
+        through the stamped seams (compile phases) clear the slate so
+        the first measured chain doesn't inherit warmup-era stamps."""
+        self._pending = {}
+
+    # -- chain lifecycle ---------------------------------------------------
+    def open(self, force: bool = False) -> Optional[int]:
+        """Claim the pending pre-dispatch stamps into a new chain and
+        stamp ``dispatch`` (if no pre-stamp already supplied one).
+        Returns the chain key, or None when this lineage is not sampled
+        (pending stamps are discarded either way — they belonged to
+        this dispatch)."""
+        pending, self._pending = self._pending, {}
+        n = self._opened
+        self._opened = n + 1
+        if not force:
+            if self.sample_every == 0:
+                return None
+            if n >= self.exact_limit and n % self.sample_every != 0:
+                return None
+        if len(self._open) >= self.max_open:
+            # a long dispatch run between drain points: DECLINE this
+            # lineage instead of evicting an open chain — sampling
+            # backpressure, not attribution loss (``saturated`` counts
+            # the declines; ``latency_stamp_dropped`` stays reserved
+            # for stamps that actually lost their chain)
+            self.saturated += 1
+            return None
+        key = self._next_key
+        self._next_key = key + 1
+        chain = _Chain(key)
+        chain.stamps.update(pending)
+        chain.stamps.setdefault(STAGE_DISPATCH, self.clock.now())
+        self._open[key] = chain
+        return key
+
+    def stamp(self, key: Optional[int], stage: str,
+              at: Optional[float] = None) -> None:
+        """Stamp one stage on an open chain (no-op for ``key=None`` —
+        the unsampled case — and for unknown/closed keys: a late stamp
+        after finalize is counted, not raised)."""
+        if key is None:
+            return
+        chain = self._open.get(key)
+        if chain is None:
+            if self._await_sink is not None \
+                    and self._await_sink.key == key:
+                chain = self._await_sink
+            else:
+                self.dropped += 1
+                return
+        chain.stamps.setdefault(
+            stage, self.clock.now() if at is None else float(at))
+
+    def stamp_open(self, stage: str) -> None:
+        """Stamp ``stage`` on EVERY open chain — the drain-point face:
+        one ``sync()`` drains all queued intervals at once, and each of
+        their chains observes the same fetch."""
+        if not self._open:
+            return
+        t = self.clock.now()
+        for chain in self._open.values():
+            chain.stamps.setdefault(stage, t)
+
+    def finalize(self, key: Optional[int]) -> Optional[dict]:
+        """Close a chain: fold its stage durations / derived numbers
+        into the registry and return the breakdown (None for unsampled
+        keys). See the module docstring for the derived-number
+        definitions."""
+        if key is None:
+            return None
+        chain = self._open.pop(key, None)
+        if chain is None:
+            if self._await_sink is not None \
+                    and self._await_sink.key == key:
+                chain, self._await_sink = self._await_sink, None
+            else:
+                self.dropped += 1
+                return None
+        return self._finalize(chain)
+
+    def finalize_open(self) -> List[dict]:
+        """Close every open chain (the pipeline ``sync()`` face)."""
+        chains, self._open = list(self._open.values()), {}
+        return [self._finalize(c) for c in chains]
+
+    # -- the sink handoff --------------------------------------------------
+    def emitted(self, key: Optional[int], expect_sink: bool = True) -> \
+            Optional[dict]:
+        """The emission owner's close: with ``expect_sink`` the chain
+        parks in a single await-sink slot — the next
+        :meth:`sink_delivered` (the TransactionalSink handoff) stamps
+        ``sink`` and finalizes; a new emission or :meth:`flush`
+        finalizes it as-is first. Without a sink downstream, finalizes
+        immediately."""
+        if key is None:
+            return None
+        if not expect_sink:
+            return self.finalize(key)
+        chain = self._open.pop(key, None)
+        if chain is None:
+            self.dropped += 1
+            return None
+        prev, self._await_sink = self._await_sink, chain
+        chain.await_sink = True
+        if prev is not None:
+            return self._finalize(prev)
+        return None
+
+    def sink_delivered(self) -> None:
+        """One sink delivery of the awaiting chain's batch: the FIRST
+        stamps ``sink`` (→ ``latency_first_emit_ms``); every one
+        advances ``last_delivery`` (→ the Karimov-style whole-emission
+        ``latency_eligibility_ms``). The chain stays parked until the
+        next :meth:`emitted` or a drain-point :meth:`flush` folds it —
+        stage stamps are first-wins, so conservation holds. No-op when
+        no chain awaits (unsampled lineages, sinks outside a traced
+        run)."""
+        chain = self._await_sink
+        if chain is None:
+            return
+        now = self.clock.now()
+        chain.stamps.setdefault(STAGE_SINK, now)
+        chain.last_delivery = now
+
+    def flush(self) -> None:
+        """Drain-point tidy (wired into ``check_overflow``): finalize a
+        parked await-sink chain whose batch ended without a sink, and
+        fold the lazily-counted totals."""
+        chain, self._await_sink = self._await_sink, None
+        if chain is not None:
+            self._finalize(chain)
+        self._fold_totals()
+
+    # -- folding -----------------------------------------------------------
+    def _finalize(self, chain: _Chain) -> dict:
+        stamps = sorted(chain.stamps.items(),
+                        key=lambda kv: (kv[1], _STAGE_RANK.get(kv[0], 99)))
+        self.lineages += 1
+        stages: Dict[str, float] = {}
+        end_to_end = 0.0
+        if stamps:
+            t_first = stamps[0][1]
+            t_last = stamps[-1][1]
+            end_to_end = (t_last - t_first) * 1e3
+            prev_t = t_first
+            for stage, t in stamps[1:]:
+                stages[stage] = (t - prev_t) * 1e3
+                prev_t = t
+        t_elig = chain.stamps.get(STAGE_ELIGIBILITY)
+        first_emit = None
+        elig_lag = None
+        if t_elig is not None:
+            t_deliver = None
+            for s in (STAGE_SINK, STAGE_EMIT, STAGE_DRAIN):
+                if s in chain.stamps:
+                    t_deliver = chain.stamps[s]
+                    break
+            if t_deliver is not None:
+                first_emit = (t_deliver - t_elig) * 1e3
+                t_close = chain.last_delivery \
+                    if chain.last_delivery is not None else t_deliver
+                elig_lag = (t_close - t_elig) * 1e3
+        out = {"key": chain.key, "stages": stages,
+               "end_to_end_ms": end_to_end,
+               "first_emit_ms": first_emit,
+               "eligibility_ms": elig_lag,
+               "stamps": dict(chain.stamps)}
+        with self._recent_lock:
+            self.recent_stages.append(stages)
+            if first_emit is not None:
+                self.recent_first_emit.append(first_emit)
+        obs = self.obs
+        if obs is not None:
+            reg = obs.registry
+            for stage, dur in stages.items():
+                reg.histogram(stage_metric(stage)).observe(dur)
+            reg.histogram(LATENCY_END_TO_END_MS).observe(end_to_end)
+            if first_emit is not None:
+                reg.histogram(LATENCY_FIRST_EMIT_MS).observe(first_emit)
+            if elig_lag is not None:
+                reg.histogram(LATENCY_ELIGIBILITY_MS).observe(elig_lag)
+            self._spans_and_flight(obs, stamps, stages)
+            self._fold_totals()
+        return out
+
+    def _spans_and_flight(self, obs, stamps, stages) -> None:
+        """Per-stage spans into the Chrome-trace recorder + one
+        ``latency_stage`` flight event per stage boundary. The span
+        recorder runs on its own perf-counter epoch, so stage spans are
+        re-anchored to "now" at finalize, preserving relative offsets."""
+        from . import flight as _flight
+
+        rec = obs.spans
+        if rec is not None and len(stamps) > 1:
+            try:
+                now_rel = rec._clock() - rec._epoch
+            # scotty: allow(silent-drop) — telemetry-only fallback: a
+            # custom recorder without the epoch face still gets the
+            # histograms/flight events; no tuple or event is lost
+            except Exception:
+                now_rel = None
+            if now_rel is not None:
+                t_last = stamps[-1][1]
+                prev_t = stamps[0][1]
+                for stage, t in stamps[1:]:
+                    rec.record_span(f"latency/{stage}",
+                                    now_rel - (t_last - prev_t),
+                                    t - prev_t)
+                    prev_t = t
+        fl = obs.flight
+        if fl is not None:
+            for stage, dur in stages.items():
+                fl.record(_flight.LATENCY_STAGE, stage, dur)
+
+    def _fold_totals(self) -> None:
+        obs = self.obs
+        if obs is None:
+            return
+        if self.lineages > self._folded_lineages:
+            obs.registry.counter(LATENCY_LINEAGES).inc(
+                self.lineages - self._folded_lineages)
+            self._folded_lineages = self.lineages
+        if self.dropped > self._folded_dropped:
+            obs.registry.counter(LATENCY_STAMP_DROPPED).inc(
+                self.dropped - self._folded_dropped)
+            self._folded_dropped = self.dropped
+        if self.saturated > self._folded_saturated:
+            # declines are benign sampling backpressure, not drops —
+            # exported (so coverage loss is visible) but not gated
+            obs.registry.counter(LATENCY_OPEN_DECLINED).inc(
+                self.saturated - self._folded_saturated)
+            self._folded_saturated = self.saturated
+
+    # -- the mesh per-shard fold ------------------------------------------
+    def shard_fold(self, shard: int, dur_ms: float) -> None:
+        """Fold one per-shard emit-fetch duration (mesh/mesh_serving
+        call this at their psum-drain host faces, attributing the fetch
+        to the shard that owns the materialized key). Kept OUT of the
+        stage histograms on purpose — those carry only chain deltas, so
+        the conservation identity stays exact."""
+        obs = self.obs
+        if obs is not None:
+            obs.registry.histogram(shard_metric(int(shard))).observe(
+                float(dur_ms))
+
+    # -- the windowed health face -----------------------------------------
+    def first_emit_p99_recent(self) -> Optional[float]:
+        """p99 over the recent first-emit window (None below 5 samples
+        — a verdict needs a distribution, not a point). Safe to call
+        from the /healthz server thread."""
+        with self._recent_lock:
+            samples = list(self.recent_first_emit)
+        if len(samples) < 5:
+            return None
+        import numpy as np
+
+        return float(np.percentile(samples, 99))
+
+    def owning_stage_recent(self) -> Optional[str]:
+        """The stage with the largest p99 duration over the recent
+        window — the critical-path owner a /healthz verdict names.
+        Safe to call from the /healthz server thread."""
+        with self._recent_lock:
+            recent = list(self.recent_stages)
+        if not recent:
+            return None
+        import numpy as np
+
+        series: Dict[str, list] = {}
+        for stages in recent:
+            for s, d in stages.items():
+                series.setdefault(s, []).append(d)
+        if not series:
+            return None
+        return max(series,
+                   key=lambda s: float(np.percentile(series[s], 99)))
+
+
+# ---------------------------------------------------------------------------
+# ``python -m scotty_tpu.obs latency <export>`` — critical-path attribution
+# ---------------------------------------------------------------------------
+
+#: conservation slack: stage sums must match end-to-end within this many
+#: milliseconds per recorded chain (stamp resolution + reservoir skew —
+#: the EXACT identity is asserted per chain on ManualClock in tests;
+#: aggregated histograms only see the sampled reservoir)
+CONSERVATION_TOL_MS = 1.0
+
+
+def _latency_metrics(flat: dict) -> dict:
+    """Extract the latency families from one flat metrics dict."""
+    stages = {}
+    for k, v in flat.items():
+        if k.startswith(LATENCY_STAGE_PREFIX) and k.endswith("_ms_mean"):
+            stage = k[len(LATENCY_STAGE_PREFIX):-len("_ms_mean")]
+            stages[stage] = {
+                "mean_ms": float(v),
+                "count": int(flat.get(
+                    f"latency_stage_{stage}_ms_count", 0)),
+                "p50_ms": float(flat.get(
+                    f"latency_stage_{stage}_ms_p50", 0.0)),
+                "p99_ms": float(flat.get(
+                    f"latency_stage_{stage}_ms_p99", 0.0)),
+            }
+    out = {"stages": stages,
+           "samples": int(flat.get("latency_end_to_end_ms_count", 0)),
+           "end_to_end_mean_ms": float(flat.get(
+               "latency_end_to_end_ms_mean", 0.0)),
+           "end_to_end_p99_ms": float(flat.get(
+               "latency_end_to_end_ms_p99", 0.0)),
+           "first_emit_p50_ms": float(flat.get(
+               "latency_first_emit_ms_p50", 0.0)),
+           "first_emit_p99_ms": float(flat.get(
+               "latency_first_emit_ms_p99", 0.0)),
+           "first_emit_samples": int(flat.get(
+               "latency_first_emit_ms_count", 0)),
+           "eligibility_p99_ms": float(flat.get(
+               "latency_eligibility_ms_p99", 0.0)),
+           "stamp_dropped": float(flat.get(LATENCY_STAMP_DROPPED, 0.0))}
+    return out
+
+
+def attribute(flat: dict) -> dict:
+    """Critical-path attribution over one flat metrics dict: which
+    stage owns p99, plus the conservation check (mean-weighted stage
+    sums vs end-to-end, within :data:`CONSERVATION_TOL_MS`). Zero
+    samples degrade to a counted verdict — never a crash."""
+    m = _latency_metrics(flat)
+    if m["samples"] == 0:
+        m.update(owner=None, owner_p99_ms=0.0, owner_share=0.0,
+                 conservation_ok=True, conservation_gap_ms=0.0,
+                 note="no latency samples (sampling disabled or the "
+                      "export predates the tracer)")
+        return m
+    stages = m["stages"]
+    if stages:
+        owner = max(stages, key=lambda s: stages[s]["p99_ms"])
+        m["owner"] = owner
+        m["owner_p99_ms"] = stages[owner]["p99_ms"]
+        tot = sum(st["p99_ms"] for st in stages.values())
+        m["owner_share"] = (stages[owner]["p99_ms"] / tot) if tot else 0.0
+    else:
+        m.update(owner=None, owner_p99_ms=0.0, owner_share=0.0)
+    # per-chain the identity telescopes exactly (sum(stage deltas) ==
+    # last - first); summed over chains it survives aggregation, so the
+    # histogram-level check compares TOTAL stage milliseconds
+    # (mean * count == the histogram's exact sum) against total
+    # end-to-end milliseconds, normalized back to a per-chain gap
+    stage_total = sum(st["mean_ms"] * st["count"]
+                      for st in stages.values())
+    e2e_total = m["end_to_end_mean_ms"] * m["samples"]
+    gap = abs(stage_total - e2e_total) / max(1, m["samples"])
+    m["conservation_gap_ms"] = gap
+    m["conservation_ok"] = gap <= CONSERVATION_TOL_MS
+    return m
+
+
+def _flat_sections(path: str) -> List[dict]:
+    """(label, flat-metrics) rows from any export the diff/report
+    tooling reads — bench cell lists, snapshot dicts, JSONL series."""
+    from .diff import _cells
+
+    cells = _cells(path)
+    return [{"cell": key or "(snapshot)", **attribute(flat)}
+            for key, flat in cells.items()]
+
+
+def render_latency(path: str, as_json: bool = False,
+                   rows: Optional[List[dict]] = None) -> str:
+    import json
+
+    if rows is None:
+        rows = _flat_sections(path)
+    if as_json:
+        return json.dumps({"cells": rows}, indent=1, default=float)
+    lines = [f"{path} [latency attribution]"]
+    for row in rows:
+        lines.append(f"  cell: {row['cell']}")
+        if row.get("note"):
+            lines.append(f"    {row['note']}")
+            continue
+        lines.append(
+            f"    end-to-end: mean {row['end_to_end_mean_ms']:.3f} ms  "
+            f"p99 {row['end_to_end_p99_ms']:.3f} ms  "
+            f"({row['samples']} chains)")
+        if row["first_emit_samples"]:
+            lines.append(
+                f"    first-emit: p50 {row['first_emit_p50_ms']:.3f} ms  "
+                f"p99 {row['first_emit_p99_ms']:.3f} ms  "
+                f"eligibility-lag p99 {row['eligibility_p99_ms']:.3f} ms")
+        lines.append(f"    {'stage':16s} {'count':>7s} {'p50_ms':>10s} "
+                     f"{'p99_ms':>10s} {'mean_ms':>10s}")
+        order = {s: i for i, s in enumerate(STAGES)}
+        for stage in sorted(row["stages"],
+                            key=lambda s: order.get(s, 99)):
+            st = row["stages"][stage]
+            mark = "  <- owns p99" if stage == row.get("owner") else ""
+            lines.append(
+                f"    {stage:16s} {st['count']:7d} {st['p50_ms']:10.3f} "
+                f"{st['p99_ms']:10.3f} {st['mean_ms']:10.3f}{mark}")
+        ok = "ok" if row["conservation_ok"] else "VIOLATED"
+        lines.append(
+            f"    conservation: stage sums vs end-to-end gap "
+            f"{row['conservation_gap_ms']:.3f} ms ({ok}, tol "
+            f"{CONSERVATION_TOL_MS} ms)")
+        if row["stamp_dropped"]:
+            lines.append(f"    latency_stamp_dropped: "
+                         f"{int(row['stamp_dropped'])} (gated by obs diff)")
+    return "\n".join(lines)
+
+
+def latency_main(path: str, as_json: bool = False, echo=None) -> int:
+    """The ``obs latency`` entry: 0 = attributed (or no samples),
+    1 = a conservation violation — stage stamps that do not add up
+    mean the attribution cannot be trusted."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    rows = _flat_sections(path)
+    echo(render_latency(path, as_json=as_json, rows=rows))
+    bad = sum(1 for r in rows if not r.get("conservation_ok", True))
+    return 1 if bad else 0

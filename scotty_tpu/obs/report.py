@@ -59,8 +59,13 @@ def summarize_jsonl(path: str) -> dict:
                 rows.append(row)
             else:                      # a bare scalar/list is not a snapshot
                 skipped += 1
+    from .latency import attribute
+
     return {"kind": "jsonl", "rows": len(rows), "skipped_lines": skipped,
-            "metrics": summarize_rows(rows)}
+            "metrics": summarize_rows(rows),
+            # latency section (ISSUE 14): attribution over the final
+            # snapshot row; zero samples degrade to a counted note
+            "latency": attribute(rows[-1] if rows else {})}
 
 
 def summarize_trace(obj: dict) -> dict:
@@ -78,17 +83,23 @@ def summarize_trace(obj: dict) -> dict:
 
 
 def summarize_bench_results(cells: List[dict]) -> dict:
+    from .latency import attribute
+
     out = {"kind": "bench-result", "cells": []}
     for cell in cells:
         row = {k: cell.get(k) for k in
                ("name", "windows", "engine", "aggregation",
-                "tuples_per_sec", "p99_emit_ms", "error")
+                "tuples_per_sec", "p99_emit_ms", "first_emit_p50_ms",
+                "first_emit_p99_ms", "error")
                if k in cell}
         m = cell.get("metrics")
         if isinstance(m, dict):
             row["metrics"] = m.get("metrics", m)
             if "spans" in m:
                 row["spans"] = m["spans"]
+            # latency section (ISSUE 14): per-cell critical-path
+            # attribution; zero-sample cells carry a counted note
+            row["latency"] = attribute(row["metrics"])
         out["cells"].append(row)
     return out
 
@@ -116,8 +127,11 @@ def summarize(path: str) -> dict:
             if "traceEvents" in obj:
                 return summarize_trace(obj)
             # a single snapshot object: treat as a one-row series
+            from .latency import attribute
+
             return {"kind": "snapshot", "rows": 1, "skipped_lines": 0,
-                    "metrics": summarize_rows([obj])}
+                    "metrics": summarize_rows([obj]),
+                    "latency": attribute(obj)}
     return summarize_jsonl(path)
 
 
@@ -125,6 +139,31 @@ def _fmt(v: float) -> str:
     if v == int(v) and abs(v) < 1e15:
         return f"{int(v):,}"
     return f"{v:,.3f}"
+
+
+def _latency_lines(lat: dict, indent: str = "  ") -> List[str]:
+    """The report's latency section for one attribution dict
+    (:func:`.latency.attribute`) — zero samples degrade to a counted
+    note, never a crash."""
+    if not isinstance(lat, dict):
+        return []
+    if lat.get("note"):
+        return [f"{indent}latency: {lat['note']}"]
+    lines = [f"{indent}latency: end-to-end p99 "
+             f"{lat.get('end_to_end_p99_ms', 0.0):.3f} ms over "
+             f"{lat.get('samples', 0)} chains"]
+    if lat.get("first_emit_samples"):
+        lines.append(
+            f"{indent}  first-emit p50 {lat['first_emit_p50_ms']:.3f} "
+            f"ms / p99 {lat['first_emit_p99_ms']:.3f} ms")
+    if lat.get("owner"):
+        lines.append(
+            f"{indent}  p99 owner: {lat['owner']} "
+            f"({lat['owner_p99_ms']:.3f} ms, "
+            f"{lat['owner_share']:.0%} of the stage-p99 sum); "
+            f"conservation "
+            f"{'ok' if lat.get('conservation_ok') else 'VIOLATED'}")
+    return lines
 
 
 def render(path: str, as_json: bool = False) -> str:
@@ -145,6 +184,7 @@ def render(path: str, as_json: bool = False) -> str:
                 f"  {name:32s} {st['n']:6d} {_fmt(st['last']):>14s} "
                 f"{_fmt(st['mean']):>14s} {_fmt(st['min']):>14s} "
                 f"{_fmt(st['max']):>14s}")
+        lines.extend(_latency_lines(summary.get("latency")))
     elif summary["kind"] == "chrome-trace":
         lines.append(f"  {'span':32s} {'count':>6s} {'total_ms':>12s} "
                      f"{'mean_ms':>12s} {'max_ms':>12s}")
@@ -176,6 +216,8 @@ def render(path: str, as_json: bool = False) -> str:
                         lines.append(
                             f"    span {name:25s} count={st['count']:<5d} "
                             f"total={st['total_ms']:.3f} ms")
+            lines.extend(_latency_lines(cell.get("latency"),
+                                        indent="    "))
     return "\n".join(lines)
 
 
@@ -214,6 +256,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="machine-readable analysis instead of the report")
     pp.add_argument("--timeline", action="store_true",
                     help="include the full event-by-event timeline")
+    lp = sub.add_parser(
+        "latency", help="emission-latency critical-path attribution "
+                        "over any export: which stage owns p99, "
+                        "first-emit/eligibility percentiles, and the "
+                        "stage-sum conservation check (exits nonzero "
+                        "on a conservation violation)")
+    lp.add_argument("file", help="path to the exported metrics file "
+                                 "(result_*.json, snapshot, or JSONL)")
+    lp.add_argument("--json", action="store_true",
+                    help="machine-readable attribution instead of the "
+                         "table")
     fp = sub.add_parser(
         "fsck", help="verify a checkpoint directory's integrity "
                      "manifests: per-generation verdict naming the "
@@ -236,6 +289,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         return diff_main(args.baseline, args.candidate, args.thresholds,
                          as_json=args.json)
+    if args.cmd == "latency":
+        from .latency import latency_main
+
+        return latency_main(args.file, as_json=args.json)
     if args.cmd == "postmortem":
         from .postmortem import postmortem_main
 

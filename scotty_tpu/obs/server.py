@@ -52,7 +52,14 @@ class HealthPolicy:
     previous probe (the PR 3 watchdogs count them; a probe after a quiet
     interval recovers). ``overflow_unhealthy`` — unhealthy once any
     ``overflows`` were counted (a raised overflow flag is terminal, so
-    this check never recovers).
+    this check never recovers). ``max_first_emit_p99_ms`` (ISSUE 14) —
+    unhealthy while p99 first-emit latency over the attached
+    :class:`~.latency.LatencyTracer`'s RECENT sample window exceeds it;
+    the verdict names the stage that owns the recent critical path
+    (``owning_stage``), so an operator paged on emission latency knows
+    which layer to look at. The check needs ``obs.latency`` with ≥ 5
+    recent samples; without them it reports ok with ``samples`` counted
+    (a disabled tracer must not flap a probe).
 
     ``verdict`` is also callable without a server (tests drive it
     directly) and is safe under concurrent probes (one policy-level lock
@@ -61,10 +68,12 @@ class HealthPolicy:
 
     def __init__(self, max_watermark_lag_ms: Optional[float] = None,
                  stall_unhealthy: bool = True,
-                 overflow_unhealthy: bool = True):
+                 overflow_unhealthy: bool = True,
+                 max_first_emit_p99_ms: Optional[float] = None):
         self.max_watermark_lag_ms = max_watermark_lag_ms
         self.stall_unhealthy = stall_unhealthy
         self.overflow_unhealthy = overflow_unhealthy
+        self.max_first_emit_p99_ms = max_first_emit_p99_ms
         self._lock = threading.Lock()
         self._last_stalls = 0.0
 
@@ -98,6 +107,22 @@ class HealthPolicy:
             ok = overflows == 0
             checks["overflow"] = {"ok": ok, "overflows": overflows}
             healthy = healthy and ok
+        if self.max_first_emit_p99_ms is not None:
+            tracer = getattr(obs, "latency", None)
+            p99 = tracer.first_emit_p99_recent() \
+                if tracer is not None else None
+            row = {"ok": True, "p99_ms": p99,
+                   "max_p99_ms": self.max_first_emit_p99_ms,
+                   "samples": len(tracer.recent_first_emit)
+                   if tracer is not None else 0}
+            if p99 is not None:
+                row["ok"] = p99 <= self.max_first_emit_p99_ms
+                if not row["ok"]:
+                    # name the offending stage: the critical-path owner
+                    # over the same recent window the p99 came from
+                    row["owning_stage"] = tracer.owning_stage_recent()
+            checks["first_emit"] = row
+            healthy = healthy and row["ok"]
         obs.counter(HEALTH_CHECKS).inc()
         if not healthy:
             obs.counter(HEALTH_UNHEALTHY).inc()

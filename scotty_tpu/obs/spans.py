@@ -98,6 +98,20 @@ class SpanRecorder:
                 else:
                     self._dropped += 1
 
+    def record_span(self, name: str, t0_rel: float, dur: float,
+                    depth: int = 0) -> None:
+        """Append one ALREADY-CLOSED span (seconds relative to the
+        recorder's epoch) — the post-hoc face the emission-latency
+        tracer uses to land ``latency/<stage>`` spans in the Chrome
+        trace without having wrapped the region in a context manager.
+        Bounded exactly like :meth:`span`."""
+        with self._lock:
+            if len(self.spans) < self.max_spans:
+                self.spans.append(Span(name, float(t0_rel), float(dur),
+                                       depth, threading.get_ident()))
+            else:
+                self._dropped += 1
+
     # -- export -----------------------------------------------------------
     def summary(self) -> dict:
         """Per-name aggregate: count / total / mean / max milliseconds."""

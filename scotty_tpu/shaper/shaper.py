@@ -39,6 +39,7 @@ import numpy as np
 
 from .. import obs as _obs
 from ..obs import flight as _flight
+from ..obs import latency as _latn
 from ..resilience.clock import Clock, SystemClock
 from . import device as _dev
 from .host import BatchAccumulator
@@ -159,9 +160,19 @@ class StreamShaper:
         return self.offer_many([value], [ts],
                                None if key is None else [key])
 
+    def _lat_arrival(self) -> None:
+        obs = self.obs
+        if obs is not None and obs.latency is not None:
+            # record-arrival pre-stamp (ISSUE 14): oldest record to
+            # enter the accumulator since the last chain claim (the
+            # operator's process_elements stamps the same moment for
+            # host-fed paths; setdefault keeps the earliest)
+            obs.latency.pre(_latn.STAGE_ARRIVAL)
+
     def offer_many(self, vals, ts, keys=None) -> int:
         """Buffer a chunk of host records; flushes full sorted blocks
         (plus any expired bounded-delay flush) into the operator/sink."""
+        self._lat_arrival()
         n = self.accumulator.offer(vals, ts, keys=keys)
         self._record_host_telemetry()
         return n
@@ -171,6 +182,7 @@ class StreamShaper:
         accumulator's vectorized block-fill path (ISSUE 7) — exactly
         equivalent to per-record offers, without the per-record Python
         work. The ingest-ring replay path lands whole blocks here."""
+        self._lat_arrival()
         n = self.accumulator.offer_block(vals, ts, keys=keys)
         self._record_host_telemetry()
         return n
@@ -197,6 +209,10 @@ class StreamShaper:
         obs = self.obs
         if obs is not None:
             size = block[-1].shape[0]
+            if obs.latency is not None:
+                # shaper-flush pre-stamp (ISSUE 14): the block leaves
+                # the accumulator for the operator/sink
+                obs.latency.pre(_latn.STAGE_SHAPER_FLUSH)
             obs.counter(_obs.SHAPER_FLUSHES).inc()
             obs.histogram(_obs.SHAPER_FILL_RATIO).observe(
                 size / self.batch_size)

@@ -341,6 +341,58 @@ def test_runner_count_fused_and_ring_fed_cells(tmp_path):
     assert row["legacy_anchor_tps"] > 0
 
 
+def test_runner_latency_headline_cell(tmp_path, monkeypatch):
+    """ISSUE 14: the LatencyHeadline engine runs end-to-end at a tiny
+    shape — full stage decomposition with exact conservation, measured
+    first-emit dimension, oracle arm green, and the cell JSON embeds
+    the standing latency fields. The interleaved overhead arm is
+    monkeypatched (it compiles two extra aligned pipelines — measured
+    for real by the recorded artifact, not per CI run)."""
+    import json as _json
+
+    from scotty_tpu.bench import load_config, run_config
+    from scotty_tpu.bench import runner as _runner
+
+    monkeypatch.setattr(_runner, "measure_latency_overhead",
+                        lambda **kw: 0.0)
+    cfg_path = tmp_path / "lh.json"
+    cfg_path.write_text(_json.dumps({
+        "name": "lh",
+        "throughput": 100_000,
+        "runtime": 4,
+        "windowConfigurations": ["Sliding(4000,1000)"],
+        "configurations": ["LatencyHeadline"],
+        "aggFunctions": ["sum"],
+        "watermarkPeriodMs": 1000,
+        "batchSize": 16384,
+        "capacity": 8192,
+        "maxLateness": 1000,
+    }))
+    rows = run_config(load_config(str(cfg_path)),
+                      out_dir=str(tmp_path / "out"),
+                      echo=lambda *a, **k: None)
+    assert len(rows) == 1 and "error" not in rows[0], rows
+    row = rows[0]
+    assert row["oracle_match"] and row["oracle_windows"] > 0
+    assert row["latency_conservation_ok"]
+    assert row["latency_chains"] > 0
+    assert row["first_emit_samples"] > 0
+    assert row["first_emit_p99_ms"] >= row["first_emit_p50_ms"] > 0
+    stages = row["latency_stages_ms"]
+    # the full edge decomposes: ring + dispatch + delivery stages
+    for s in ("ring_enqueue", "ring_dequeue", "eligibility", "drain",
+              "emit", "sink"):
+        assert s in stages, (s, sorted(stages))
+    # written cell JSON carries the dimension (the standing-field check)
+    disk = _json.load(open(tmp_path / "out" / "result_lh.json"))
+    assert disk[0]["first_emit_p99_ms"] == row["first_emit_p99_ms"]
+    # and `obs latency` attributes the written artifact, exit 0
+    from scotty_tpu.obs.report import main as obs_main
+
+    assert obs_main(["latency",
+                     str(tmp_path / "out" / "result_lh.json")]) == 0
+
+
 def test_latency_stats_stall_robust():
     """VERDICT r4 weak #5: a tunnel stall in the sample set must not be
     the only published percentile — trimmed companion + stall count."""
