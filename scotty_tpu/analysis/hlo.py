@@ -175,6 +175,99 @@ def _mesh_serving_lowered():
                          jax.device_put(np.int64(0)))
 
 
+def _keyed_lowered():
+    """Canonical keyed aligned step (ISSUE 10 machinery; pinned since
+    ISSUE 15): 4 keys, tiny shapes — the vmapped per-key fold + append
+    + range query whose flags-off lowering the Pallas work must leave
+    byte-identical."""
+    import numpy as np
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.parallel.keyed import KeyedAlignedPipeline
+
+    p = KeyedAlignedPipeline(
+        [TumblingWindow(WindowMeasure.Time, 50)], [SumAggregation()],
+        n_keys=4,
+        config=EngineConfig(capacity=1 << 10, batch_size=64,
+                            annex_capacity=64, min_trigger_pad=32),
+        throughput=4 * 4000, wm_period_ms=100, max_lateness=100, seed=5,
+        gc_every=10 ** 9)
+    p.reset()
+    return p._step.lower(p.state, p._interval_key(0), np.int64(0))
+
+
+def _aligned_pallas_lowered(window_ms: int = 50):
+    """Flagged-ON canonical aligned step (ISSUE 15): the SAME tiny
+    lineage config as the default-off aligned pin, with the Pallas
+    segmented-reduce fold enabled — so the Pallas lowering carries its
+    own pinned lineage next to the default-off pin, and drift in
+    either is independently red/green."""
+    import numpy as np
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    p = AlignedStreamPipeline(
+        [TumblingWindow(WindowMeasure.Time, window_ms)],
+        [SumAggregation()],
+        config=EngineConfig(capacity=1 << 12, batch_size=256,
+                            annex_capacity=256, min_trigger_pad=32,
+                            pallas_slice_merge=True),
+        throughput=20_000, wm_period_ms=100, max_lateness=100, seed=5,
+        gc_every=10 ** 9, value_scale=1024.0)
+    p.reset()
+    return p._step.lower(p.state, p.dm, p._interval_key(0), np.int64(0))
+
+
+def _aligned_microbatch_lowered():
+    """Flagged-ON canonical micro-batched flush (ISSUE 15): the aligned
+    lineage config at ``micro_batch=2`` — pins the flush program
+    (reduce + append + trigger/query) of the streamed-emission path."""
+    import numpy as np
+
+    import jax
+
+    from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+    from scotty_tpu.engine import EngineConfig
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    p = AlignedStreamPipeline(
+        [TumblingWindow(WindowMeasure.Time, 50)], [SumAggregation()],
+        config=EngineConfig(capacity=1 << 12, batch_size=256,
+                            annex_capacity=256, min_trigger_pad=32,
+                            micro_batch=2),
+        throughput=20_000, wm_period_ms=100, max_lateness=100, seed=5,
+        gc_every=10 ** 9, value_scale=1024.0)
+    p.reset()
+    p.micro_start(0)
+    return p._micro_flush_fn.lower(
+        p.state, p.dm, p._micro_slab, p._micro_key, p._micro_iv)
+
+
+def _sort_split_pallas_lowered():
+    """Canonical Pallas sort-split lowering (ISSUE 15): the bucketed
+    bitonic kernel at a tiny power-of-two batch — the lowering every
+    flagged shaped batch dispatches (per-shape; this pins the
+    construction's lineage)."""
+    import numpy as np
+
+    import jax
+
+    from scotty_tpu.pallas import build_pallas_sort_split
+    from scotty_tpu.shaper.device import init_shaper_stats
+
+    B, L = 256, 64
+    kern = jax.jit(build_pallas_sort_split(B, L), donate_argnums=0)
+    stats = init_shaper_stats()
+    ts = np.arange(B, dtype=np.int64)
+    vals = np.zeros(B, np.float32)
+    valid = np.ones(B, bool)
+    return kern.lower(stats, ts, vals, valid, np.int64(0), np.int64(0),
+                      np.int64(0))
+
+
 #: the pinned step configs; insertion order is the report order
 CANONICAL_STEPS = {
     "aligned": _aligned_lowered,
@@ -183,6 +276,12 @@ CANONICAL_STEPS = {
     "context": _context_lowered,
     "mesh": _mesh_lowered,
     "mesh_serving": _mesh_serving_lowered,
+    "keyed": _keyed_lowered,
+    # flagged-ON Pallas / micro-batch lineages (ISSUE 15) — pinned next
+    # to the default-off pins so both drift independently
+    "aligned_pallas": _aligned_pallas_lowered,
+    "aligned_microbatch": _aligned_microbatch_lowered,
+    "sort_split_pallas": _sort_split_pallas_lowered,
 }
 
 

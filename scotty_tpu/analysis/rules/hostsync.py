@@ -47,6 +47,12 @@ DRAIN_POINT_FUNCTIONS = frozenset({
     # sampling keys never pulls the full [K, T] block) — documented as
     # riding the same drain cadence as lowered_global
     "per_key_columns",
+    # micro-batched streamed emission (ISSUE 15): _fetch_streamed IS the
+    # streamed drain (one interval's result fetch — the per-interval
+    # analogue of sync()); micro_push's anchor fetch is the documented
+    # arrival-pacing discipline (micro_pace, off by default);
+    # micro_snapshot is a checkpoint boundary, like save/restore
+    "_fetch_streamed", "micro_push", "micro_snapshot",
 })
 
 _SYNC_ATTRS = ("device_get", "block_until_ready", "item")
@@ -76,7 +82,7 @@ class HostSyncBan(Rule):
     include = ("scotty_tpu/engine", "scotty_tpu/parallel",
                "scotty_tpu/shaper", "scotty_tpu/serving",
                "scotty_tpu/core", "scotty_tpu/mesh",
-               "scotty_tpu/mesh_serving")
+               "scotty_tpu/mesh_serving", "scotty_tpu/pallas")
 
     def check(self, src: SourceFile):
         for node in src.walk:

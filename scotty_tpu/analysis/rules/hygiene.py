@@ -91,7 +91,7 @@ class NoWallClock(Rule):
            "delivery — timestamps come from resilience.clock "
            "(wall_time / the injectable Clock)")
     include = ("scotty_tpu/obs", "scotty_tpu/ingest", "scotty_tpu/soak",
-               "scotty_tpu/delivery")
+               "scotty_tpu/delivery", "scotty_tpu/pallas")
 
     def check(self, src: SourceFile):
         for node in _calls(src, names=("time", "monotonic"),

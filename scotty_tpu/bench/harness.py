@@ -220,6 +220,16 @@ class BenchmarkConfig:
     #: commits with each supervisor checkpoint; the cell records the
     #: ledger's overhead alongside)
     delivery: str = "at_least_once"
+    #: ISSUE 15 (threaded into EngineConfig like overflowPolicy): Pallas
+    #: bucketed sort-split for shaped device batches
+    pallas_sort_split: bool = False
+    #: Pallas segmented-reduce slice-merge for the dense-ingest fold and
+    #: the aligned/keyed/mesh generator lifts
+    pallas_slice_merge: bool = False
+    #: micro-batches per interval for streamed emission
+    #: (FusedPipelineDriver.run_streamed; 0 = whole-interval steps) —
+    #: the LatencyHeadline cell's micro-batched first-emit arm reads it
+    micro_batch: int = 0
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -257,6 +267,9 @@ class BenchmarkConfig:
             n_shards=raw.get("nShards", 0),
             mesh_rebalance=raw.get("meshRebalance", True),
             mesh_reshard_schedule=raw.get("meshReshardSchedule", []),
+            pallas_sort_split=raw.get("pallasSortSplit", False),
+            pallas_slice_merge=raw.get("pallasSliceMerge", False),
+            micro_batch=raw.get("microBatch", 0),
         )
 
 

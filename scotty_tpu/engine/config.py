@@ -79,6 +79,41 @@ class EngineConfig:
     #: the overflow flag can — see resilience.policy).
     grow_occupancy: float = 0.85
 
+    #: Pallas bucketed sort-split for the shaper's device batches
+    #: (scotty_tpu.pallas.sort_split, ROADMAP item 4): int32 bitonic
+    #: network in VMEM instead of the emulated-int64 full-block
+    #: ``lax.sort``. Default OFF — every existing step HLO pin stays
+    #: byte-identical; batches whose host-known timestamp span exceeds
+    #: the 31-bit bucket budget fall back to the XLA twin (counted as
+    #: ``pallas_fallbacks``, never silent). Correctness gates on CPU
+    #: via Pallas interpreter mode in tier-1; speed is a TPU-box cert.
+    pallas_sort_split: bool = False
+
+    #: Pallas segmented-reduce slice-merge (scotty_tpu.pallas.seg_fold)
+    #: for the dense-ingest run fold and the aligned/keyed/mesh
+    #: generator lifts (including the PR 10 multi-cell sparse lift):
+    #: lane blocks stream HBM→VMEM double-buffered and reduce into row
+    #: accumulators — no scatter-combine on the fold. Default OFF (HLO
+    #: pins byte-identical); interpreter-mode gated on CPU like
+    #: ``pallas_sort_split``.
+    pallas_slice_merge: bool = False
+
+    #: Pack the Pallas slice-merge value stream as bf16 (half the HBM
+    #: traffic; f32 accumulators). Only meaningful with
+    #: ``pallas_slice_merge``; results carry the derived bf16 rounding
+    #: bound instead of bit-matching the XLA twin.
+    pallas_packed: bool = False
+
+    #: Micro-batches per watermark interval for streamed emission
+    #: (``FusedPipelineDriver.run_streamed``): the per-interval fused
+    #: step splits into this many async micro-dispatches plus one
+    #: trigger/query flush, and the driver fetches interval N's
+    #: eligible windows while N+1's micro-batches dispatch — first-emit
+    #: latency decouples from interval size. 0/1 = off (the default;
+    #: ``run()`` and every HLO pin are untouched). Results bit-match
+    #: the whole-interval step on the same generation keying.
+    micro_batch: int = 0
+
     def __post_init__(self):
         # literal check, NOT an import of resilience.policy.OverflowPolicy:
         # the engine config must not pull the whole resilience package in

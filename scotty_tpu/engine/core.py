@@ -564,7 +564,9 @@ def build_ingest(spec: EngineSpec, capacity: int, annex_capacity: int,
     return ingest
 
 
-def build_ingest_dense(spec: EngineSpec, capacity: int, runs: int):
+def build_ingest_dense(spec: EngineSpec, capacity: int, runs: int,
+                       pallas_fold: bool = False,
+                       pallas_packed: bool = False):
     """In-order ingest without large scatters — the keyed/batched fast path.
 
     int64 scatters cost ~100 ms per 1M lanes on v5e (no native int64: XLA
@@ -585,6 +587,15 @@ def build_ingest_dense(spec: EngineSpec, capacity: int, runs: int):
     count-measure or session windows, dense-lift aggregations, and the
     batch spans < ``runs`` new slices (the kernel raises the overflow flag
     if the bound is violated).
+
+    ``pallas_fold=True`` (``EngineConfig.pallas_slice_merge``) replaces
+    the per-run one-hot matmul / masked [B, R, w] reduction with the
+    Pallas segmented-reduce kernel
+    (:func:`scotty_tpu.pallas.build_segment_fold`): lane blocks stream
+    HBM→VMEM double-buffered into one [R, w] accumulator — the tiny
+    [R]-lane buffer scatter stays. Default OFF keeps this builder's
+    lowering byte-identical. ``pallas_packed`` streams the lifted
+    values as bf16 (toleranced, see ``pallas.packed_tolerance``).
     """
     C, R = capacity, runs
 
@@ -625,7 +636,19 @@ def build_ingest_dense(spec: EngineSpec, capacity: int, runs: int):
         for agg, part in zip(spec.aggs, state.partials):
             lifted, sparse = _lift(agg, vals, valid)
             assert sparse is None, "dense ingest needs dense-lift aggs"
-            if agg.kind == "sum":
+            if pallas_fold:
+                from ..pallas import build_segment_fold
+
+                fold = build_segment_fold(
+                    B, R, part.shape[1], agg.kind, agg.identity,
+                    packed=pallas_packed)
+                # invalid lanes alias run k_last with identity-masked
+                # values (the _lift mask above), so their combine is a
+                # no-op — same guarantee the live mask gives the XLA
+                # branches below
+                upd = fold(k, lifted).astype(part.dtype)
+                part = _combine_scatter(part, rows, upd, agg.kind)
+            elif agg.kind == "sum":
                 oh = (k[:, None] == r_idx[None, :]).astype(part.dtype)
                 upd = oh.T @ lifted                          # [R, w] — MXU
                 upd = jnp.where(live[:, None], upd, 0)

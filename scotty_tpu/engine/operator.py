@@ -236,17 +236,22 @@ def _dm_ingest_kernel():
     return hit
 
 
-def _dense_kernel(spec, capacity: int, runs: int):
-    """Jitted scatter-free in-order ingest (build_ingest_dense), cached."""
+def _dense_kernel(spec, capacity: int, runs: int,
+                  pallas_fold: bool = False, pallas_packed: bool = False):
+    """Jitted scatter-free in-order ingest (build_ingest_dense), cached.
+    The Pallas flags are part of the cache key — a flags-off operator
+    can never be handed a Pallas-bearing executable."""
     import jax
     from . import core as ec
 
     key = ("dense", spec.periods, spec.bands, spec.offset_periods,
-           tuple(a.token for a in spec.aggs), capacity, runs)
+           tuple(a.token for a in spec.aggs), capacity, runs,
+           bool(pallas_fold), bool(pallas_packed))
     hit = _KERNEL_CACHE.get(key)
     if hit is None:
-        hit = jax.jit(ec.build_ingest_dense(spec, capacity, runs),
-                      donate_argnums=0)
+        hit = jax.jit(ec.build_ingest_dense(
+            spec, capacity, runs, pallas_fold=pallas_fold,
+            pallas_packed=pallas_packed), donate_argnums=0)
         _KERNEL_CACHE[key] = hit
     return hit
 
@@ -1335,14 +1340,31 @@ class TpuWindowOperator(WindowOperator):
     def _pick_inorder_kernel(self, ts_lo: int, ts_hi: int):
         """Scatter-free dense kernel when the batch's slice-run count is
         provably under the bound; general in-order kernel otherwise."""
+        pf = bool(getattr(self.config, "pallas_slice_merge", False))
         if self._dense_runs:
             runs = (ts_hi - ts_lo) // self._min_grid + 3
             if runs <= self._dense_runs:
                 if self._ingest_dense is None:
                     self._ingest_dense = _dense_kernel(
                         self._grid_spec, self.config.capacity,
-                        self._dense_runs)
+                        self._dense_runs, pallas_fold=pf,
+                        pallas_packed=pf and bool(getattr(
+                            self.config, "pallas_packed", False)))
+                if pf:
+                    # picked once per dispatched batch — the host-side
+                    # dispatch count of Pallas-bearing programs
+                    from .. import pallas as _pl
+
+                    _pl.record_dispatch(self.obs)
                 return self._ingest_dense
+        if pf:
+            # a flagged batch over the runs bound (or dense ingest
+            # disabled) degrades to the scatter-heavy general kernel —
+            # the same counted-never-silent contract as the shaper's
+            # span/shape misses, gated by obs diff
+            from .. import pallas as _pl
+
+            _pl.record_fallback(self.obs, "dense_runs_bound")
         return self._ingest_inorder
 
     # -- overflow policy (resilience.policy) -------------------------------

@@ -134,6 +134,16 @@ class MeshKeyedPipeline(FusedPipelineDriver):
         Rc = R // n_chunks
         self._n_chunks, self._rc = n_chunks, Rc
 
+        #: Pallas segmented-reduce fold for the per-shard lifts
+        #: (EngineConfig.pallas_slice_merge); part of the step cache
+        #: key — a flags-off pipeline can never adopt a Pallas-bearing
+        #: executable (or vice versa)
+        pallas_fold = bool(getattr(self.config, "pallas_slice_merge",
+                                   False))
+        pallas_packed = pallas_fold and bool(
+            getattr(self.config, "pallas_packed", False))
+        self._pallas_in_step = pallas_fold
+
         win_tok = tuple((type(w).__name__, int(w.size),
                          int(getattr(w, "slide", 0))) for w in self.windows)
         cache_key = (win_tok, tuple(ag.token for ag in aggs), K, C, A,
@@ -143,6 +153,7 @@ class MeshKeyedPipeline(FusedPipelineDriver):
                      # max_chunk_elems budgets would silently pair one
                      # chunking's device stream with the other's replay
                      n_chunks, Rc,
+                     pallas_fold, pallas_packed,
                      _mesh_token(mesh, axis))
         first_lw = max(0, P_ms - max_lateness)
         red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
@@ -173,7 +184,23 @@ class MeshKeyedPipeline(FusedPipelineDriver):
                 flat = vals.reshape(-1)
                 new_parts = []
                 for aspec, acc in zip(aggs, parts_c):
-                    if aspec.is_sparse:
+                    if pallas_fold:
+                        # Pallas segmented-reduce fold per shard (the
+                        # keyed pipeline's routing, under shard_map)
+                        from .. import pallas as _spl
+
+                        if aspec.is_sparse:
+                            col, v = aspec.lift_sparse(flat)
+                            upd = _spl.sparse_row_fold(
+                                col, v, Kl * S, Rc, aspec.width,
+                                aspec.kind, aspec.identity).reshape(
+                                    Kl, S, aspec.width)
+                        else:
+                            upd = _spl.row_fold(
+                                aspec.lift_dense(flat), Kl * S, Rc,
+                                aspec.kind, aspec.identity,
+                                packed=pallas_packed).reshape(Kl, S, -1)
+                    elif aspec.is_sparse:
                         col, v = aspec.lift_sparse(flat)
                         row_id = jnp.arange(Kl * S * Rc,
                                             dtype=jnp.int32) // Rc
@@ -250,11 +277,18 @@ class MeshKeyedPipeline(FusedPipelineDriver):
         state_spec = {"buf": Pa, "keys": Pa}
         hit = _STEP_CACHE.get(cache_key)
         if hit is None:
+            # pallas_call has no shard_map replication rule yet: the
+            # flagged-on step disables the rep check (the out_specs
+            # above pin every output's sharding explicitly, so nothing
+            # is inferred from it); flags-off passes NOTHING extra —
+            # its call shape, trace and pin stay byte-identical
+            step_kw = {"check_rep": False} if pallas_fold else {}
             hit = (
                 jax.jit(shard_map(
                     shard_body, mesh=mesh,
                     in_specs=(state_spec, P(), P()),
-                    out_specs=(state_spec, (P(), P(), Pa, Pa, P(), P()))),
+                    out_specs=(state_spec, (P(), P(), Pa, Pa, P(), P())),
+                    **step_kw),
                     donate_argnums=0),
                 jax.jit(shard_map(
                     lambda st, b: {"buf": jax.vmap(

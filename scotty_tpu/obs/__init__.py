@@ -157,6 +157,17 @@ CTX_SPECULATIVE_TUPLES = "ctx_speculative_tuples"
 CTX_SPECULATIVE_FALLBACK_TUPLES = "ctx_speculative_fallback_tuples"
 CTX_SPECULATIVE_FALLBACKS = "ctx_speculative_fallbacks"
 
+# Pallas hot-path kernels + micro-batched streamed emission (ISSUE 15
+# — scotty_tpu.pallas; host-side counts at the existing call sites,
+# zero device syncs): dispatches of jitted programs containing a
+# Pallas kernel, dispatches routed to the XLA twin instead (span/shape
+# budget misses — gated by obs diff so a silent degrade to the slow
+# twin cannot pass as clean), and micro-batched flush programs (the
+# per-interval trigger/query dispatch of run_streamed)
+PALLAS_KERNEL_DISPATCHES = "pallas_kernel_dispatches"
+PALLAS_FALLBACKS = "pallas_fallbacks"
+MICROBATCH_FLUSHES = "microbatch_flushes"
+
 # sliding-count lateness relaxation (ISSUE 11 — count_pipeline.py):
 # rows carried by the sub-period (max_lateness < wm_period) stratified
 # late model; gated so a config silently flipping into (or out of) the
@@ -295,6 +306,14 @@ METRIC_HELP = {
     SHAPER_SLACK_OVERFLOWS:
         "shaped batches whose late residue exceeded late_capacity",
     SHAPER_FILL_RATIO: "flushed shaper block size / batch_size",
+    PALLAS_KERNEL_DISPATCHES:
+        "host dispatches of jitted programs containing a Pallas kernel",
+    PALLAS_FALLBACKS:
+        "Pallas-flagged dispatches routed to the XLA twin instead "
+        "(bucket-span/shape budget misses; gated)",
+    MICROBATCH_FLUSHES:
+        "micro-batched trigger/query flush programs dispatched "
+        "(run_streamed)",
     SERVING_REGISTERED: "queries registered with the serving layer",
     SERVING_CANCELLED: "queries cancelled (slots recycled)",
     SERVING_REJECTED: "query registrations refused by admission control",

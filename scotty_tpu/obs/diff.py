@@ -82,6 +82,20 @@ DEFAULT_THRESHOLDS = {
         # changed — surfaced rather than silently absorbed.
         "count_lateness_relaxed_rows": {"direction": "lower",
                                         "default": 0, "rel_tol": 0.10},
+        # Pallas hot-path contract (ISSUE 15): fallbacks to the XLA
+        # twin APPEARING (or growing) on the same seeded stream gate —
+        # a flagged run silently degrading to the slow twin is a >10x
+        # throughput cliff short cells can hide. Dispatch and flush
+        # counts gate in the HIGHER direction: on an unchanged flagged
+        # config they must not shrink (the Pallas path or the
+        # micro-batched cadence silently turning off); a flags-off
+        # baseline has no key at all, and "higher" with "default": 0
+        # admits the candidate that newly turns the flags on.
+        "pallas_fallbacks": {"direction": "lower", "default": 0},
+        "pallas_kernel_dispatches": {"direction": "higher", "default": 0,
+                                     "rel_tol": 0.10},
+        "microbatch_flushes": {"direction": "higher", "default": 0,
+                               "rel_tol": 0.10},
         # shaper contract (ISSUE 5): a candidate whose shaper started
         # losing late residues (slack overflow) or holding tuples past
         # the end-of-run drain must not pass as clean; reordered-tuple

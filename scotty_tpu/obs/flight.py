@@ -101,6 +101,13 @@ MARK = "mark"
 SHAPER_FLUSH = "shaper_flush"
 SHAPER_HELD = "shaper_held"
 SHAPER_OVERFLOW = "shaper_overflow"
+# Pallas hot-path kernels + micro-batched streamed emission (ISSUE 15,
+# scotty_tpu.pallas): a flagged dispatch routed to the XLA twin (name =
+# reason: sort_split_span / sort_split_shape), and a micro-batched
+# interval flush — so a postmortem shows whether the run was on the
+# Pallas path and at which micro-batch cadence when it died
+PALLAS_FALLBACK = "pallas_fallback"
+MICROBATCH_FLUSH = "microbatch_flush"
 # ingest-ring / soak events (ISSUE 7, scotty_tpu.ingest + scotty_tpu.soak):
 # backpressure engaging (ring found full), records shed at the ring
 # boundary (value = count), a soak audit pass (value = audit index) and a

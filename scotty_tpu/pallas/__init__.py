@@ -1,0 +1,154 @@
+"""Hand-written Pallas kernels for the two profiled hot paths.
+
+Every fused step before this package was pure XLA-from-jnp. PR 13
+measured the drain-point fetch owning 67–71 ms of the 70.8 ms first-emit
+p99 at the headline shape, and PR 10 profiled the keyed step as
+generation/lift-bound on the scatter-fold — ROADMAP item 4 names both
+halves. This package holds the kernels; the call sites stay in the
+engine/shaper/pipeline modules behind ``EngineConfig`` flags that
+default OFF, so every existing step HLO pin stays byte-identical:
+
+* :mod:`.sort_split` — the shaper's sort-and-split
+  (``shaper/device.py``) as a bucketed int32 bitonic network instead of
+  a full-block stable int64 ``lax.sort`` (int64 compares are emulated
+  with i32 pairs on TPU). The bounded back-reach the ShapedOOO cell
+  already assumes is the license: a batch's timestamp span fits a
+  coarse 31-bit bucket key, so the sort runs on native int32 lanes in
+  VMEM. Batches whose span exceeds the budget fall back to the XLA
+  twin — counted, never silent (``pallas_fallbacks``).
+* :mod:`.seg_fold` — the slice-merge scatter-fold
+  (``engine/core.py`` + the PR 10 multi-cell sparse lift) as a
+  segmented-reduce kernel: lane blocks stream HBM→VMEM double-buffered
+  (the Pallas grid pipeline), each block reduces into a per-row
+  accumulator, and sparse sketch lifts densify per block inside VMEM
+  instead of scattering per lane. ``packed=True`` streams the lifted
+  values as bf16 (half the HBM traffic; accumulation stays f32 — the
+  differential suite derives and asserts the tolerance).
+
+Interpreter mode: on every non-TPU backend the kernels run under
+``pl.pallas_call(..., interpret=True)`` — that is how tier-1 gates
+their correctness on CPU (the differential suite bit-matches each
+kernel against its XLA twin and the host oracle). The raw-speed floors
+stay TPU-box certifications per the PR 5/7/10 discipline; CPU cells
+are honestly platform-tagged. :func:`interpret_mode` pins the choice
+for a whole region (``bench/runner.py`` enters ONE such context across
+all cells instead of re-entering per cell).
+
+Host-side telemetry (the obs contract): ``pallas_kernel_dispatches``
+counts host dispatches of jitted programs that contain a Pallas kernel,
+``pallas_fallbacks`` counts dispatches routed to the XLA twin instead
+(budget misses, unsupported shapes) — both folded at the existing
+host call sites, zero device syncs added.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+#: module-level interpreter-mode override: None = auto (interpret on
+#: every non-TPU backend), True/False = forced. Mutated only through
+#: :func:`interpret_mode` / :func:`set_interpret`.
+_FORCED_INTERPRET: Optional[bool] = None
+
+
+def backend_is_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def resolve_interpret(interpret: Optional[bool] = None) -> bool:
+    """The effective ``interpret=`` for a ``pallas_call``: an explicit
+    argument wins, then a :func:`interpret_mode` region, then the
+    backend default (interpret everywhere but TPU)."""
+    if interpret is not None:
+        return bool(interpret)
+    if _FORCED_INTERPRET is not None:
+        return _FORCED_INTERPRET
+    return not backend_is_tpu()
+
+
+def set_interpret(value: Optional[bool]) -> None:
+    """Pin (True/False) or restore auto (None) interpreter mode.
+
+    The resolution is baked into a kernel WHEN IT TRACES: programs
+    already jitted keep the mode they were traced under (jax's jit
+    cache is keyed on the function object, not on this module state).
+    Pin BEFORE the first flagged dispatch — the bench runner enters its
+    region before any cell builds; the shaper's kernel cache keys on
+    the resolution so a re-pin there builds a fresh kernel rather than
+    silently serving the old mode's executable.
+    """
+    global _FORCED_INTERPRET
+    _FORCED_INTERPRET = value
+
+
+@contextlib.contextmanager
+def interpret_mode(value: bool = True):
+    """Pin interpreter mode for a region. The bench runner enters ONE
+    such context around the whole cell loop — re-entering per cell
+    would re-resolve (and on a mixed-backend host, re-trace) every
+    kernel per cell for no reason."""
+    global _FORCED_INTERPRET
+    prev = _FORCED_INTERPRET
+    _FORCED_INTERPRET = bool(value)
+    try:
+        yield
+    finally:
+        _FORCED_INTERPRET = prev
+
+
+# -- host-side telemetry seam (names live in the obs contract) -------------
+
+
+def record_dispatch(obs, n: int = 1) -> None:
+    """Count ``n`` host dispatches of Pallas-bearing programs."""
+    if obs is not None:
+        from .. import obs as _obs
+
+        obs.counter(_obs.PALLAS_KERNEL_DISPATCHES).inc(n)
+
+
+def record_fallback(obs, reason: str) -> None:
+    """Count one dispatch routed to the XLA twin (budget miss /
+    unsupported shape), with a flight event naming the reason."""
+    if obs is not None:
+        from .. import obs as _obs
+        from ..obs import flight as _flight
+
+        obs.counter(_obs.PALLAS_FALLBACKS).inc()
+        fl = getattr(obs, "flight", None)
+        if fl is not None:
+            fl.record(_flight.PALLAS_FALLBACK, reason, 1)
+
+
+from .sort_split import (  # noqa: E402
+    SORT_KEY_BITS,
+    build_pallas_sort_split,
+    sort_span_fits,
+)
+from .seg_fold import (  # noqa: E402
+    BF16_EPS,
+    build_segment_fold,
+    packed_tolerance,
+    row_fold,
+    sparse_row_fold,
+)
+
+__all__ = [
+    "backend_is_tpu",
+    "BF16_EPS",
+    "build_pallas_sort_split",
+    "build_segment_fold",
+    "interpret_mode",
+    "packed_tolerance",
+    "record_dispatch",
+    "record_fallback",
+    "resolve_interpret",
+    "row_fold",
+    "set_interpret",
+    "sort_span_fits",
+    "sparse_row_fold",
+    "SORT_KEY_BITS",
+]

@@ -472,6 +472,14 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
         self._n_chunks, self._rc = n_chunks, Rc
         first_lw = max(0, P - max_lateness)
         red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
+        #: Pallas segmented-reduce fold for the per-chunk lifts
+        #: (EngineConfig.pallas_slice_merge — ROADMAP item 4; default
+        #: off keeps the keyed step byte-identical)
+        pallas_fold = bool(getattr(self.config, "pallas_slice_merge",
+                                   False))
+        pallas_packed = pallas_fold and bool(
+            getattr(self.config, "pallas_packed", False))
+        self._pallas_in_step = pallas_fold
 
         def gen_vals(kg):
             """[K, S, Rc] generated values. The RNG is the measured
@@ -493,7 +501,26 @@ class KeyedAlignedPipeline(FusedPipelineDriver):
                 flat = vals.reshape(-1)                  # [K*S*Rc]
                 new_parts = []
                 for aspec, acc in zip(aggs, parts_c):
-                    if aspec.is_sparse:
+                    if pallas_fold:
+                        # Pallas segmented-reduce fold: the [K*S] slice
+                        # rows are equal Rc-lane segments by
+                        # construction — lane blocks stream HBM→VMEM,
+                        # multi-cell sketch lifts densify in VMEM
+                        # instead of the flat per-row scatter below
+                        from .. import pallas as _spl
+
+                        if aspec.is_sparse:
+                            col, v = aspec.lift_sparse(flat)
+                            upd = _spl.sparse_row_fold(
+                                col, v, K * S, Rc, aspec.width,
+                                aspec.kind, aspec.identity).reshape(
+                                    K, S, aspec.width)
+                        else:
+                            upd = _spl.row_fold(
+                                aspec.lift_dense(flat), K * S, Rc,
+                                aspec.kind, aspec.identity,
+                                packed=pallas_packed).reshape(K, S, -1)
+                    elif aspec.is_sparse:
                         # flat per-row scatter (the aligned pipeline's
                         # generic sketch fold): one f32 scatter lane per
                         # generated tuple — multi-cell sketches (count-

@@ -213,15 +213,39 @@ def build_keyed_round(n_keys: int, round_size: int):
 _KERNELS: dict = {}
 
 
-def sort_split_kernel(batch_size: int, late_capacity: int):
-    """Jitted, cached :func:`build_sort_split` (stats donated)."""
+def sort_split_kernel(batch_size: int, late_capacity: int,
+                      pallas: bool = False):
+    """Jitted, cached :func:`build_sort_split` (stats donated).
+
+    ``pallas=True`` returns the bucketed bitonic Pallas twin
+    (:func:`scotty_tpu.pallas.build_pallas_sort_split`) instead — same
+    outputs lane for lane, one extra trailing ``lo`` argument (the
+    host-known lower timestamp bound the bucket keys are relative to).
+    Raises ``ValueError`` when the batch size cannot take the Pallas
+    network (not a power of two) — callers fall back to the XLA twin
+    and count it.
+    """
     import jax
 
-    key = ("sort_split", batch_size, late_capacity)
+    key = ("sort_split", batch_size, late_capacity, bool(pallas))
+    if pallas:
+        # the interpret resolution is baked in at trace time, so a
+        # kernel cached under one mode must not serve a region pinned
+        # to the other (pallas.interpret_mode) — key on the resolution
+        from ..pallas import resolve_interpret
+
+        key = key + (resolve_interpret(None),)
     hit = _KERNELS.get(key)
     if hit is None:
-        hit = jax.jit(build_sort_split(batch_size, late_capacity),
-                      donate_argnums=0)
+        if pallas:
+            from ..pallas import build_pallas_sort_split
+
+            hit = jax.jit(
+                build_pallas_sort_split(batch_size, late_capacity),
+                donate_argnums=0)
+        else:
+            hit = jax.jit(build_sort_split(batch_size, late_capacity),
+                          donate_argnums=0)
         _KERNELS[key] = hit
     return hit
 

@@ -75,6 +75,13 @@ class ShaperConfig:
       (the engine's pre-shaper fallback — mainly an A/B lever).
     * ``batch_size`` — host coalescing block size (``None`` = the
       operator's ``config.batch_size``).
+    * ``pallas_sort_split`` — route device batches through the Pallas
+      bucketed bitonic sort-split (ROADMAP item 4) instead of the XLA
+      ``lax.sort`` kernel. ``None`` (default) inherits the operator's
+      ``EngineConfig.pallas_sort_split`` — so the flag stays OFF (and
+      the dispatched programs byte-identical) unless a config turns it
+      on. Batches whose host-known span exceeds the 31-bit bucket
+      budget fall back per batch (``pallas_fallbacks``).
     """
 
     slack_ms: int = 0
@@ -82,6 +89,7 @@ class ShaperConfig:
     late_capacity: int = 0
     late_routing: str = "split"
     batch_size: Optional[int] = None
+    pallas_sort_split: Optional[bool] = None
 
     def __post_init__(self):
         if self.late_routing not in ("split", "combined"):
@@ -133,6 +141,14 @@ class StreamShaper:
             keyed=keyed, value_dtype=value_dtype)
         self._dev_stats = None          # lazily-allocated device pytree
         self._valid_all = None          # cached all-true device lane mask
+        p = self.config.pallas_sort_split
+        if p is None:
+            cfg = getattr(op, "config", None)
+            p = bool(getattr(cfg, "pallas_sort_split", False))
+        #: resolved Pallas routing for device batches; flips False once
+        #: on a build-time shape miss (counted), per-batch span misses
+        #: fall back per dispatch
+        self._pallas_sort = bool(p)
         self._stats_folded: dict = {}   # last obs-folded telemetry values
         self._feeding = False
         self._held_hw_recorded = 0
@@ -300,10 +316,37 @@ class StreamShaper:
         # combined routing) cut = I64_MIN makes the kernel a pure sort.
         cut = np.int64(met_pre) if (late_possible and not combined) \
             else np.int64(_dev.I64_MIN)
-        kern = _dev.sort_split_kernel(B, self.late_capacity)
-        (self._dev_stats, io_ts, io_vals, io_valid,
-         l_ts, l_vals, l_valid) = kern(self._dev_stats, ts, vals, valid,
-                                       cut, seed)
+        kern = None
+        if self._pallas_sort:
+            from .. import pallas as _pl
+
+            if not _pl.sort_span_fits(int(ts_max) - int(ts_min)):
+                # this batch's span overflows the 31-bit bucket key —
+                # per-batch fallback to the XLA twin, counted
+                _pl.record_fallback(self.obs, "sort_split_span")
+            else:
+                try:
+                    kern = _dev.sort_split_kernel(
+                        B, self.late_capacity, pallas=True)
+                except ValueError:
+                    # batch size can't take the bitonic network (not a
+                    # power of two): a build-time property of this
+                    # shaper — disable for the run, count once
+                    self._pallas_sort = False
+                    _pl.record_fallback(self.obs, "sort_split_shape")
+        if kern is not None:
+            from .. import pallas as _pl
+
+            _pl.record_dispatch(self.obs)
+            (self._dev_stats, io_ts, io_vals, io_valid,
+             l_ts, l_vals, l_valid) = kern(
+                 self._dev_stats, ts, vals, valid, cut, seed,
+                 np.int64(ts_min))
+        else:
+            kern = _dev.sort_split_kernel(B, self.late_capacity)
+            (self._dev_stats, io_ts, io_vals, io_valid,
+             l_ts, l_vals, l_valid) = kern(self._dev_stats, ts, vals,
+                                           valid, cut, seed)
         if not late_possible:
             # provably nothing late: the sorted batch is fully in-order
             op.ingest_device_batch(io_vals, io_ts, ts_min, ts_max,

@@ -1,0 +1,269 @@
+"""Micro-batched streamed emission (ISSUE 15): ``run_streamed`` must
+bit-match whole-interval ``run()`` on every fused pipeline + mesh, keep
+the step loop clean under ``jax.transfer_guard("disallow")``, resume a
+mid-interval checkpoint of the micro-batched carry bit-identically, and
+keep the LatencyTracer conservation identity exact over the streamed
+stamps."""
+
+import numpy as np
+import pytest
+
+import scotty_tpu.obs as obs_mod
+from scotty_tpu import (
+    SessionWindow,
+    SlidingWindow,
+    SumAggregation,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+
+Time = WindowMeasure.Time
+
+
+def _leaves_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _aligned(micro=4, **flags):
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    return AlignedStreamPipeline(
+        [SlidingWindow(Time, 400, 100)], [SumAggregation()],
+        config=EngineConfig(capacity=1 << 12, annex_capacity=256,
+                            min_trigger_pad=32, micro_batch=micro,
+                            **flags),
+        throughput=2560, wm_period_ms=200, max_lateness=200, seed=3,
+        gc_every=10 ** 9, value_scale=8.0)
+
+
+def test_aligned_microbatch_bit_matches_whole_interval():
+    """Same construction (micro_batch forces the per-(row, sub) keying
+    on BOTH paths): M micro-dispatches + flush == the one-step run."""
+    import jax
+
+    ref = _aligned()
+    r_ref = [jax.device_get(r) for r in ref.run(5)]
+    ref.sync()
+    mb = _aligned()
+    r_mb = mb.run_streamed(5)
+    _leaves_equal(r_ref, r_mb)
+    mb.check_overflow()
+
+
+def test_aligned_microbatch_ooo_late_fold_bit_matches():
+    """The late fold rides micro-batch 0 (lax.cond on the micro index)
+    — out-of-order streams bit-match too."""
+    import jax
+
+    from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+
+    def mk():
+        return AlignedStreamPipeline(
+            [SlidingWindow(Time, 400, 100)], [SumAggregation()],
+            config=EngineConfig(capacity=1 << 12, annex_capacity=256,
+                                min_trigger_pad=32, micro_batch=4),
+            throughput=2560, wm_period_ms=200, max_lateness=200, seed=3,
+            gc_every=10 ** 9, value_scale=8.0, out_of_order_pct=0.05)
+
+    ref = mk()
+    r_ref = [jax.device_get(r) for r in ref.run(4)]
+    ref.sync()
+    mb = mk()
+    r_mb = mb.run_streamed(4)
+    _leaves_equal(r_ref, r_mb)
+
+
+def test_generic_pipeline_streamed_bit_matches():
+    """StreamPipeline has no micro step — run_streamed degrades to
+    per-interval streamed fetches of the SAME step."""
+    import jax
+
+    from scotty_tpu.engine.pipeline import StreamPipeline
+
+    def mk():
+        return StreamPipeline(
+            [TumblingWindow(Time, 100)], [SumAggregation()],
+            config=EngineConfig(capacity=1 << 12, annex_capacity=64,
+                                min_trigger_pad=32),
+            throughput=20_000, wm_period_ms=200, max_lateness=200,
+            seed=1, sub_batch=1 << 10)
+
+    a = mk()
+    ra = [jax.device_get(r) for r in a.run(3)]
+    a.sync()
+    b = mk()
+    rb = b.run_streamed(3)
+    _leaves_equal(ra, rb)
+
+
+def test_session_pipeline_streamed_bit_matches():
+    import jax
+
+    from scotty_tpu.engine.session_pipeline import SessionStreamPipeline
+
+    def mk():
+        return SessionStreamPipeline(
+            [SessionWindow(Time, 1000)], [SumAggregation()],
+            config=EngineConfig(capacity=1 << 12, annex_capacity=8,
+                                min_trigger_pad=32),
+            throughput=4000, wm_period_ms=1000, max_lateness=1000,
+            seed=7,
+            session_config={"count": 6, "minGapMs": 1500,
+                            "maxGapMs": 4000})
+
+    a = mk()
+    ra = [jax.device_get(r) for r in a.run(4)]
+    a.sync()
+    b = mk()
+    rb = b.run_streamed(4)
+    _leaves_equal(ra, rb)
+
+
+def test_count_pipeline_streamed_bit_matches():
+    import jax
+
+    from scotty_tpu.engine.count_pipeline import CountStreamPipeline
+
+    def mk():
+        return CountStreamPipeline(
+            [TumblingWindow(WindowMeasure.Count, 7)], [SumAggregation()],
+            throughput=2000, wm_period_ms=100, max_lateness=100, seed=0,
+            out_of_order_pct=0.2)
+
+    a = mk()
+    ra = [jax.device_get(r) for r in a.run(3)]
+    a.sync()
+    b = mk()
+    rb = b.run_streamed(3)
+    _leaves_equal(ra, rb)
+
+
+def test_mesh_pipeline_streamed_bit_matches():
+    import jax
+
+    from scotty_tpu.mesh import MeshKeyedPipeline
+
+    def mk():
+        return MeshKeyedPipeline(
+            [TumblingWindow(Time, 100)], [SumAggregation()],
+            n_keys=16, n_shards=8,
+            config=EngineConfig(capacity=1 << 10, batch_size=32,
+                                annex_capacity=32, min_trigger_pad=32),
+            throughput=16 * 40, wm_period_ms=200, max_lateness=200,
+            seed=5, gc_every=10 ** 9, value_scale=4.0)
+
+    a = mk()
+    ra = [jax.device_get(r) for r in a.run(3)]
+    a.sync()
+    b = mk()
+    rb = b.run_streamed(3)
+    _leaves_equal(ra, rb)
+
+
+def test_microbatch_clean_under_transfer_guard():
+    """The micro dispatch loop's only host->device movements are the
+    sanctioned explicit device_puts (interval key, interval scalar,
+    micro index); the streamed fetch is an explicit device_get."""
+    import jax
+
+    p = _aligned()
+    p.reset()                      # state init outside the guard
+    with jax.transfer_guard("disallow"):
+        out = p.run_streamed(3)
+    assert len(out) == 3
+    p.check_overflow()
+
+
+def test_microbatch_checkpoint_resume_bit_identical():
+    """Snapshot the micro-batched carry BETWEEN micro-batches, restore
+    into a twin, finish the interval on both — bit-identical results
+    and identical continued streams."""
+    import jax
+
+    a = _aligned()
+    b = _aligned()
+    a.run_streamed(2)
+    b.run_streamed(2)
+    i = a._interval
+    a.micro_start(i)
+    a.micro_push()
+    a.micro_push()
+    snap = a.micro_snapshot()
+    # poison the twin's cursors to prove restore rebuilds them
+    b.micro_start(i)
+    b.micro_restore(snap)
+    while a._micro_m < a._micro_batch:
+        a.micro_push()
+    while b._micro_m < b._micro_batch:
+        b.micro_push()
+    fa = jax.device_get(a.micro_finish())
+    fb = jax.device_get(b.micro_finish())
+    _leaves_equal(fa, fb)
+    a._interval += 1
+    b._interval += 1
+    # the continued stream stays aligned too
+    _leaves_equal(a.run_streamed(2), b.run_streamed(2))
+
+
+def test_microbatch_flushes_counter_and_conservation():
+    """Every streamed interval is one flush (counted), every chain's
+    stage sums telescope EXACTLY to its end-to-end on a ManualClock."""
+    from scotty_tpu.obs.latency import LatencyTracer
+    from scotty_tpu.resilience.clock import ManualClock
+
+    clock = ManualClock()
+    o = obs_mod.Observability()
+    tracer = o.attach_latency(
+        LatencyTracer(clock=clock, sample_every=1, exact_limit=1 << 30))
+    chains = []
+    _fin = tracer._finalize
+
+    def spy(chain):
+        out = _fin(chain)
+        chains.append(out)
+        return out
+
+    tracer._finalize = spy
+    p = _aligned()
+    p.reset()
+    p.set_observability(o)
+    n = 4
+    p.run_streamed(n)
+    tracer._finalize = _fin
+    snap = o.snapshot()
+    assert snap.get("microbatch_flushes") == n
+    assert len(chains) == n
+    for c in chains:
+        gap = abs(sum(c["stages"].values()) - c["end_to_end_ms"])
+        assert gap == 0.0, c
+        assert c["first_emit_ms"] is not None
+
+
+def test_microbatch_rejects_legacy_and_serving():
+    from scotty_tpu.engine.pipeline import (
+        AlignedStreamPipeline,
+        SlotGeometry,
+    )
+
+    with pytest.raises(NotImplementedError):
+        AlignedStreamPipeline(
+            [TumblingWindow(Time, 100)], [SumAggregation()],
+            config=EngineConfig(capacity=1 << 10, annex_capacity=8,
+                                min_trigger_pad=32, micro_batch=4),
+            throughput=2000, wm_period_ms=200, max_lateness=200,
+            legacy_generator=True)
+    with pytest.raises(NotImplementedError):
+        AlignedStreamPipeline(
+            [], [SumAggregation()],
+            config=EngineConfig(capacity=1 << 10, annex_capacity=8,
+                                min_trigger_pad=32, micro_batch=4),
+            throughput=2000, wm_period_ms=200, max_lateness=200,
+            query_slots=SlotGeometry(n_slots=8, triggers_per_slot=4,
+                                     slice_grid=100, max_size=400))
