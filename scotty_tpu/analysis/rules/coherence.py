@@ -36,7 +36,7 @@ _NAME_RE = re.compile(r"^[a-z][a-z0-9_]{3,}$")
 _TOKEN_RE = re.compile(r"[a-z][a-z0-9_]{3,}")
 _DOC_METRIC_RE = re.compile(
     r"\b((?:device|resilience|shaper|serving|ingest_ring|soak|delivery"
-    r"|ckpt|flight|health|latency)_[a-z0-9_]+)")
+    r"|ckpt|flight|health|latency|workload|costmodel)_[a-z0-9_]+)")
 
 
 def _universe(project: Project) -> Tuple[Set[str], Set[str]]:

@@ -448,6 +448,10 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         return run_query_churn_mesh_cell(cfg, window_spec, agg_name,
                                          obs=obs)
 
+    if engine == "WorkloadDrift":
+        return run_workload_drift_cell(cfg, window_spec, agg_name,
+                                       obs=obs)
+
     raise ValueError(f"unknown engine {engine!r}")
 
 
@@ -2077,6 +2081,231 @@ def measure_latency_overhead(seed: int = 0, throughput: int = 4_000_000,
                     / a_times[len(a_times) // 2] - 1.0)
 
 
+def measure_workload_overhead(seed: int = 0, throughput: int = 4_000_000,
+                              intervals: int = 6, pairs: int = 16) -> float:
+    """Interleaved A/B of the ISSUE 16 sensor plane on the aligned
+    pipeline (acceptance: ≤ 2% median): per-pair bare-obs vs
+    obs-with-WorkloadMonitor+DriftDetector wall time over the same timed
+    intervals. The monitor samples at the pipeline's existing
+    ``flight_sync`` drain point (one per ``sync``) with an audit interval
+    short enough that EVERY sample closes an audit window — so the B arm
+    pays the full fold (counter snapshot, feature derivation, gauge
+    writes, drift judging) each sync, the worst case a production
+    ``audit_interval_s`` would amortize. Returns the median overhead in
+    PERCENT (negative = within noise)."""
+    from ..core.aggregates import SumAggregation
+    from ..core.windows import SlidingWindow, WindowMeasure
+    from ..engine import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+    from ..obs.drift import DriftDetector
+
+    windows = [SlidingWindow(WindowMeasure.Time, 8000, 1000)]
+
+    def build(with_monitor: bool):
+        p = AlignedStreamPipeline(
+            windows, [SumAggregation()],
+            config=EngineConfig(capacity=2048, annex_capacity=8,
+                                min_trigger_pad=32),
+            throughput=_round_throughput(
+                throughput, AlignedStreamPipeline.slice_grid(windows,
+                                                             1000)),
+            wm_period_ms=1000, max_lateness=0, seed=seed, gc_every=32)
+        obs = _obs.Observability()
+        if with_monitor:
+            mon = obs.attach_workload(audit_interval_s=1e-9)
+            mon.attach_detector(DriftDetector())
+        p.reset()
+        p.run(2, collect=False)
+        p.sync()
+        p.set_observability(obs)
+        return p
+
+    pa, pb = build(False), build(True)
+
+    def once(p) -> float:
+        t0 = time.perf_counter()
+        p.run(intervals, collect=False)
+        p.sync()
+        return time.perf_counter() - t0
+
+    once(pa), once(pb)                       # warm both step paths
+    a_times, b_times = [], []
+    for i in range(pairs):
+        # alternate within-pair order so slow drift (thermal, other
+        # tenants on a shared core) cancels instead of biasing one arm
+        if i % 2 == 0:
+            a_times.append(once(pa))
+            b_times.append(once(pb))
+        else:
+            b_times.append(once(pb))
+            a_times.append(once(pa))
+    pa.check_overflow()
+    pb.check_overflow()
+    a_times.sort()
+    b_times.sort()
+    return 100.0 * (b_times[len(b_times) // 2]
+                    / a_times[len(a_times) // 2] - 1.0)
+
+
+def run_workload_drift_cell(cfg: BenchmarkConfig, window_spec: str,
+                            agg_name: str,
+                            obs: Optional[_obs.Observability] = None
+                            ) -> BenchResult:
+    """Workload-drift cell (ISSUE 16 acceptance): a seeded 3-phase
+    shifting stream — rate ×8, then a lateness storm, then a key-skew
+    flip — through the host keyed connector operator with the
+    WorkloadMonitor on a ManualClock (one audit window per simulated
+    second, sampled only at the per-watermark ``flight_sync`` drain
+    point). The attached self-baselining :class:`DriftDetector` must
+    fire on EVERY phase transition within a bounded number of audit
+    windows, and a second arm replaying the stable phase for the full
+    duration must fire ZERO events (the false-positive bound). A third
+    arm records the interleaved sensor-plane A/B overhead on the
+    aligned pipeline (:func:`measure_workload_overhead`, ≤ 2% median).
+
+    Recorded per cell: the phase schedule with per-transition detect
+    lags (``drift_detect_lags``, in audit windows), ``drift_events`` /
+    ``drift_fired`` (which features fired when),
+    ``drift_false_positives`` (stable arm), and
+    ``workload_overhead_pct_median`` — plus the closing fingerprint in
+    the ``metrics`` section like every other cell."""
+    from ..connectors.base import (AscendingWatermarks,
+                                   KeyedScottyWindowOperator)
+    from ..obs.drift import DriftDetector
+    from ..obs.workload import WorkloadMonitor
+    from ..resilience.clock import ManualClock
+
+    windows = parse_window_spec(window_spec, seed=cfg.seed)
+    P = cfg.watermark_period_ms            # 1 simulated second per audit
+    r0 = max(256, int(cfg.throughput))     # stable tuples per sim second
+    n_keys = max(8, cfg.n_keys or 64)
+    # phase schedule in simulated seconds == audit windows (audit k folds
+    # second k; second 0 arms the monitor's first window)
+    phases = [("stable", 12, None),        # baseline + stable arm
+              ("rate_x8", 8, "arrival_rate_per_s"),
+              ("late_storm", 8, "late_share"),
+              ("key_skew", 8, "key_top_share")]
+    total_s = sum(n for _, n, _ in phases)
+    rng = np.random.default_rng(cfg.seed)
+
+    def second_stream(phase: str, s: int, wm: int):
+        """(keys, values, ts) for simulated second ``s`` under ``phase``
+        — ts ascending within the second except the lateness storm's
+        injected stragglers (below the current watermark but inside
+        cfg.max_lateness, so the operator repairs rather than drops)."""
+        n = r0 * 8 if phase == "rate_x8" else r0
+        if phase == "key_skew":
+            # 80% of the load lands on one hot key, rest uniform
+            hot = rng.random(n) < 0.80
+            keys = rng.integers(0, n_keys, size=n)
+            keys[hot] = 0
+        else:
+            keys = rng.integers(0, n_keys, size=n)
+        ts = np.sort(rng.integers(0, P, size=n)) + np.int64(s * P)
+        if phase == "late_storm" and wm > 0:
+            # ~30% arrive below the watermark by up to half max_lateness
+            late = rng.random(n) < 0.30
+            age = rng.integers(1, max(2, cfg.max_lateness // 2),
+                               size=n)
+            ts = np.where(late, np.maximum(0, wm - age), ts)
+        vals = (rng.random(n) * 100).astype(np.float64)
+        return keys, vals, ts
+
+    def run_arm(schedule):
+        """One full stream under ``schedule`` ([(phase, seconds)]);
+        returns (detector, monitor, obs, emitted, n_tuples)."""
+        arm_obs = _obs.Observability()
+        clock = ManualClock()
+        mon = arm_obs.attach_workload(
+            WorkloadMonitor(clock=clock, audit_interval_s=1.0,
+                            top_k=max(1, n_keys // 8)))
+        det = DriftDetector()              # self-baseline, confirm=2
+        mon.attach_detector(det)
+        op = KeyedScottyWindowOperator(
+            windows=list(windows),
+            aggregations=[make_aggregation(agg_name)],
+            allowed_lateness=cfg.max_lateness,
+            watermark_policy=AscendingWatermarks(),
+            obs=arm_obs)
+        emitted = 0
+        n_tuples = 0
+        s = 0
+        wm = 0
+        for phase, n_seconds in schedule:
+            for _ in range(n_seconds):
+                keys, vals, ts = second_stream(phase, s, wm)
+                for j in range(len(keys)):
+                    for _key, w in op.process_element(
+                            int(keys[j]), float(vals[j]), int(ts[j])):
+                        emitted += 1
+                n_tuples += len(keys)
+                wm = (s + 1) * P
+                for _key, w in op.process_watermark(wm):
+                    emitted += 1
+                # the keyed/mesh skew feed (the mesh engine's hot-key
+                # drain read does the same fold; host cells feed their
+                # own per-second histogram)
+                mon.observe_key_loads(np.bincount(keys,
+                                                  minlength=n_keys))
+                clock.advance(1.0)
+                arm_obs.flight_sync(watermark=float(wm))
+                s += 1
+        return det, mon, arm_obs, emitted, n_tuples
+
+    # -- drift arm: the 3-phase shifting stream --------------------------
+    t0 = time.perf_counter()
+    schedule = [(ph, n) for ph, n, _ in phases]
+    det, mon, arm_obs, emitted, n_tuples = run_arm(schedule)
+    wall = time.perf_counter() - t0
+    fired_by_feature = {f["feature"]: f["audit"] for f in det.fired}
+    transitions = []
+    lags = {}
+    all_detected = True
+    boundary = 0
+    for phase, n_seconds, expect in phases:
+        start_audit = boundary + (0 if boundary else 1)
+        boundary += n_seconds
+        if expect is None:
+            continue
+        fired_at = fired_by_feature.get(expect)
+        lag = (fired_at - start_audit + 1) if fired_at is not None \
+            else None
+        detected = lag is not None and 0 < lag <= 4
+        all_detected = all_detected and detected
+        lags[phase] = lag
+        transitions.append({"phase": phase, "expect": expect,
+                            "transition_audit": start_audit,
+                            "fired_audit": fired_at, "lag": lag,
+                            "detected": detected})
+
+    # -- stable arm: same duration, phase A only — zero events -----------
+    det_stable, _, _, _, _ = run_arm([("stable", total_s)])
+
+    # -- sensor-plane overhead arm (aligned pipeline A/B) ----------------
+    overhead = round(measure_workload_overhead(seed=cfg.seed), 2)
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=n_tuples / wall if wall > 0 else 0.0,
+        p99_emit_ms=0.0, n_windows_emitted=emitted,
+        n_tuples=n_tuples, wall_s=round(wall, 3))
+    res.workload_phases = [{"phase": ph, "seconds": n,
+                            "expect": expect}
+                           for ph, n, expect in phases]
+    res.drift_events = det.events
+    res.drift_fired = [{"feature": f["feature"], "audit": f["audit"],
+                        "reference": round(f["reference"], 6),
+                        "live": round(f["live"], 6)}
+                       for f in det.fired]
+    res.drift_transitions = transitions
+    res.drift_detect_lags = lags
+    res.drift_all_detected = bool(all_detected and transitions)
+    res.drift_false_positives = det_stable.events
+    res.workload_overhead_pct_median = overhead
+    finalize_observability(res, arm_obs, [], 0)
+    return res
+
+
 def _flags_off_ab_overhead(cfg: BenchmarkConfig, windows, agg_name: str,
                            reps: int = 3) -> float:
     """Interleaved flags-off A/B (ISSUE 15 acceptance). Be precise about
@@ -3067,7 +3296,8 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
                serve_port: Optional[int] = None,
                flight_capacity: Optional[int] = None,
                health_lag_ms: Optional[float] = None,
-               health_first_emit_ms: Optional[float] = None) -> List[dict]:
+               health_first_emit_ms: Optional[float] = None,
+               fingerprint_ref: Optional[str] = None) -> List[dict]:
     """All cells of one config; writes result_<name>.json (each cell row
     carries a ``metrics`` section unless ``collect_metrics=False``). With
     ``obs_dir``, additionally exports a per-config JSONL time series (one
@@ -3083,7 +3313,12 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
     drops surface as the gated ``flight_dropped_events`` counter);
     ``health_lag_ms`` arms the ``/healthz`` watermark-lag check;
     ``health_first_emit_ms`` arms the windowed first-emit p99 check
-    (ISSUE 14 — the unhealthy verdict names the owning stage)."""
+    (ISSUE 14 — the unhealthy verdict names the owning stage);
+    ``fingerprint_ref`` (ISSUE 16) loads a recorded workload fingerprint
+    (any export ``obs drift`` accepts) and attaches a WorkloadMonitor +
+    DriftDetector referencing it to every cell's Observability — live
+    cells then count the gated ``workload_drift_events`` whenever the
+    stream moves off the certified workload point."""
     if echo is None:
         echo = _stdout
     rows = []
@@ -3102,11 +3337,29 @@ def run_config(cfg: BenchmarkConfig, out_dir: str = "bench_results",
              "w").close()
     live = {"obs": None}                 # the endpoint reads the live cell
 
+    ref_fp = None
+    if fingerprint_ref:
+        from ..obs.drift import load_fingerprint
+
+        ref_fp = load_fingerprint(fingerprint_ref)
+        if ref_fp is None:
+            echo(f"  (--fingerprint-ref {fingerprint_ref}: no workload "
+                 "fingerprint found — drift baseline not armed)")
+        else:
+            echo(f"  drift baseline: {fingerprint_ref} "
+                 f"({len(ref_fp.features)} feature(s), "
+                 f"{ref_fp.audits} audit(s))")
+
     def make_obs():
         flight = None
         if flight_capacity:
             flight = _obs.FlightRecorder(capacity=flight_capacity)
         o = _obs.Observability(flight=flight)
+        if ref_fp is not None:
+            from ..obs.drift import DriftDetector
+
+            o.attach_workload().attach_detector(
+                DriftDetector(reference=ref_fp))
         live["obs"] = o
         return o
 
@@ -3223,7 +3476,12 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                               "tuples_per_sec_1shard", "scaling_ratio",
                               "per_shard_occupancy", "rebalance_match",
                               "reshard_retraces", "reshard_timeline",
-                              "reshard_wall_s", "delivery_tags_unique"):
+                              "reshard_wall_s", "delivery_tags_unique",
+                              "workload_phases", "drift_events",
+                              "drift_fired", "drift_transitions",
+                              "drift_detect_lags", "drift_all_detected",
+                              "drift_false_positives",
+                              "workload_overhead_pct_median"):
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)
@@ -3320,6 +3578,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "unhealthy while p99 first-emit latency over "
                          "the recent sample window exceeds MS, naming "
                          "the stage that owns the critical path")
+    ap.add_argument("--fingerprint-ref", default=None, metavar="FILE",
+                    help="arm live workload-drift detection against the "
+                         "fingerprint recorded in FILE (any export "
+                         "`python -m scotty_tpu.obs drift` accepts: a "
+                         "result_<name>.json, a /vars dump, or bare "
+                         "fingerprint JSON); every cell gets a "
+                         "WorkloadMonitor + DriftDetector referencing "
+                         "it, and sustained excursions count the gated "
+                         "workload_drift_events; ignored with --no-obs")
     ap.add_argument("--soak-seconds", default=None, type=float,
                     metavar="S",
                     help="override every config's soakSeconds (the Soak "
@@ -3372,7 +3639,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    serve_port=args.serve_port,
                    flight_capacity=args.flight_capacity,
                    health_lag_ms=args.health_lag_ms,
-                   health_first_emit_ms=args.health_first_emit_ms)
+                   health_first_emit_ms=args.health_first_emit_ms,
+                   fingerprint_ref=args.fingerprint_ref)
         if args.gate:
             if baseline_snap is None:
                 _stdout(f"  gate: no baseline for {cfg.name} — skipped "

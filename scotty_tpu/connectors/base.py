@@ -359,6 +359,11 @@ class KeyedScottyWindowOperator:
                 self.obs.latency.pre(_lat.STAGE_ARRIVAL)
             self.obs.counter(_obs.INGEST_TUPLES).inc()
             wm_cur = self.policy.current_watermark()
+            if wm_cur is not None and ts < wm_cur:
+                # below the stream's watermark: late by the same contract
+                # name the device operator counts at ITS ingest edge —
+                # the workload monitor's late_share reads this (ISSUE 16)
+                self.obs.counter(_obs.LATE_TUPLES).inc()
             if wm_cur is not None \
                     and ts + self.allowed_lateness < wm_cur:
                 # older than watermark - lateness: the operator will not
@@ -612,6 +617,8 @@ class GlobalScottyWindowOperator:
         if self.obs is not None:
             self.obs.counter(_obs.INGEST_TUPLES).inc()
             wm_cur = self.policy.current_watermark()
+            if wm_cur is not None and ts < wm_cur:
+                self.obs.counter(_obs.LATE_TUPLES).inc()
             if wm_cur is not None \
                     and ts + self.allowed_lateness < wm_cur:
                 self.obs.counter(_obs.DROPPED_TUPLES).inc()

@@ -350,6 +350,11 @@ class MeshKeyedEngine(KeyedTpuWindowOperator):
         if self.obs is not None:
             self.obs.gauge(_obs.MESH_SHARD_IMBALANCE).set(
                 float(stats["imbalance_before"]))
+            # workload fingerprint (ISSUE 16): this is already THE
+            # drain-point key_loads read — feed the skew features from
+            # the same host array, zero extra device access
+            if self.obs.workload is not None:
+                self.obs.workload.observe_key_loads(loads)
         if swaps:
             self._count(_obs.MESH_HOT_KEYS, len(stats["hot_keys"]))
             for k in stats["hot_keys"]:

@@ -104,6 +104,28 @@ prints the critical-path attribution):
 ``latency_stamp_dropped``      counter: chains evicted unfinalized /
                                late stamps (gated by ``obs diff``)
 =============================  ===========================================
+
+Workload sensor-plane contract (ISSUE 16 — :mod:`.workload`,
+:mod:`.drift`, :mod:`.costmodel`: the measurement half of ROADMAP
+item 4's self-tuning engine. The fingerprint is sampled only at the
+existing drain points — ``flight_sync`` calls the monitor before it
+even looks at the flight ring — and every feature doubles as a
+``workload_<feature>`` gauge; ``python -m scotty_tpu.obs drift |
+costmodel | trend`` are the offline faces):
+
+=============================  ===========================================
+``workload_<feature>``         gauge: one fingerprint feature per audit
+                               window (arrival_rate_per_s, burst_factor,
+                               late_share, late_age_p50_ms, ooo_fraction,
+                               fill_ratio, key_top_share, key_entropy,
+                               pallas_fallback_share)
+``workload_audits``            counter: fingerprint audit windows folded
+``workload_drift_events``      counter: confirmed drift excursions
+                               (APPEARING gates the default ``obs diff``)
+``costmodel_residual_pct``     gauge: live |measured - predicted|
+                               interval-step residual in percent (gated
+                               past the model's stated bound)
+=============================  ===========================================
 """
 
 from __future__ import annotations
@@ -263,6 +285,32 @@ from .latency import (  # noqa: E402  (contract re-export)
     LATENCY_STAMP_DROPPED,
 )
 
+# workload sensor-plane contract (ISSUE 16 — scotty_tpu.obs.workload /
+# .drift / .costmodel: fingerprint gauges, drift events and the live
+# cost-model residual. Same single-definition discipline as the latency
+# contract above: each name lives in the module that records under it
+# and is re-exported here so METRIC_HELP and the diff gate cannot drift
+# from the recording side. workload_drift_events APPEARING gates the
+# default ``obs diff`` — a certified number whose workload moved must
+# not pass as clean; costmodel_residual_pct past the model's stated
+# bound gates the same way.
+from .costmodel import (  # noqa: E402  (contract re-export)
+    COSTMODEL_RESIDUAL_PCT,
+    RESIDUAL_BOUND_PCT,
+    CostModel,
+)
+from .drift import (  # noqa: E402  (contract re-export)
+    WORKLOAD_DRIFT_EVENTS,
+    DriftDetector,
+)
+from .workload import (  # noqa: E402  (contract re-export)
+    FINGERPRINT_SCHEMA,
+    WORKLOAD_AUDITS,
+    WorkloadFingerprint,
+    WorkloadMonitor,
+    feature_gauge,
+)
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -392,6 +440,30 @@ METRIC_HELP = {
     LATENCY_OPEN_DECLINED:
         "latency lineages declined at max_open in-flight chains "
         "(sampling backpressure — coverage, not loss)",
+    WORKLOAD_AUDITS: "workload fingerprint audit windows folded",
+    WORKLOAD_DRIFT_EVENTS:
+        "confirmed workload-drift excursions (per-feature, latched; "
+        "gated by the default obs diff)",
+    COSTMODEL_RESIDUAL_PCT:
+        "live |measured - predicted| interval-step residual, percent of "
+        "the prediction (gated past the model's stated bound)",
+    "workload_arrival_rate_per_s":
+        "fingerprint: windowed ingest rate (tuples/s)",
+    "workload_burst_factor":
+        "fingerprint: max/mean windowed rate over recent audit windows",
+    "workload_late_share": "fingerprint: late tuples / ingested tuples",
+    "workload_late_age_p50_ms":
+        "fingerprint: median lateness age from the device late-age strata",
+    "workload_ooo_fraction":
+        "fingerprint: shaper-reordered tuples / ingested tuples",
+    "workload_fill_ratio":
+        "fingerprint: windowed mean flushed block size / batch_size",
+    "workload_key_top_share":
+        "fingerprint: top-k logical-key load share (keyed/mesh)",
+    "workload_key_entropy":
+        "fingerprint: normalized key-load entropy (1 = uniform)",
+    "workload_pallas_fallback_share":
+        "fingerprint: pallas fallbacks / (dispatches + fallbacks)",
 }
 
 
@@ -415,7 +487,7 @@ class Observability:
                  annotate: bool = False,
                  flight: Optional[FlightRecorder] = None,
                  postmortem_dir: Optional[str] = None,
-                 latency=None):
+                 latency=None, workload=None):
         self.registry = registry or MetricsRegistry()
         self.spans = spans or SpanRecorder(annotate=annotate)
         self.flight = flight
@@ -424,6 +496,12 @@ class Observability:
         #: stamping seam pays one attribute check, exactly the flight
         #: discipline. Attach with :meth:`attach_latency`.
         self.latency = latency.bind(self) if latency is not None else None
+        #: workload fingerprint monitor (ISSUE 16): None by default —
+        #: same discipline; sampled inside :meth:`flight_sync` (the hook
+        #: every drain point already calls). Attach with
+        #: :meth:`attach_workload`.
+        self.workload = workload.bind(self) if workload is not None \
+            else None
         self._flight_prev: dict = {}
         #: crash-site seam (ISSUE 8): when set, called as
         #: ``flight_hook(kind, name, value)`` BEFORE every flight event
@@ -510,8 +588,15 @@ class Observability:
 
     def flight_sync(self, watermark: Optional[float] = None) -> None:
         """The drain-point hook the engine calls from ``sync()`` /
-        ``check_overflow()``: records the watermark advance (when known)
-        and samples the registry. No-op without a recorder."""
+        ``check_overflow()``: samples the workload monitor (when one is
+        attached — the fingerprint's zero-new-syncs guarantee lives
+        here), records the watermark advance (when known) and samples
+        the registry into the flight ring. The workload sample happens
+        BEFORE the recorder check: a monitor works without a flight
+        ring, and when both ride, the ring's registry sample sees the
+        audit's fresh gauges."""
+        if self.workload is not None:
+            self.workload.sample()
         if self.flight is None:
             return
         from . import flight as _flight
@@ -533,6 +618,20 @@ class Observability:
             tracer = LatencyTracer(**kwargs)
         self.latency = tracer.bind(self)
         return tracer
+
+    # -- workload sensor plane (ISSUE 16) ---------------------------------
+    def attach_workload(self, monitor=None, **kwargs):
+        """Attach (and return) a :class:`.workload.WorkloadMonitor` —
+        construction kwargs (``clock=``, ``audit_interval_s=``, …) pass
+        through when no monitor is given; detach with
+        ``obs.workload = None``. The monitor samples at every
+        :meth:`flight_sync` (i.e. at the existing drain points only)."""
+        from .workload import WorkloadMonitor
+
+        if monitor is None:
+            monitor = WorkloadMonitor(**kwargs)
+        self.workload = monitor.bind(self)
+        return monitor
 
     def record_failure(self, exc: BaseException, kind: str = "overflow",
                        config=None, checkpoint: Optional[str] = None):
@@ -574,8 +673,14 @@ class Observability:
 
     def export(self) -> dict:
         """The structured artifact section: metrics snapshot + span
-        summary (what ``BenchResult.to_dict()`` embeds as ``metrics``)."""
-        return {"metrics": self.snapshot(), "spans": self.spans.summary()}
+        summary (what ``BenchResult.to_dict()`` embeds as ``metrics``),
+        plus the workload fingerprint when a monitor rode the run — so
+        every recorded cell carries the workload it was certified
+        under."""
+        out = {"metrics": self.snapshot(), "spans": self.spans.summary()}
+        if self.workload is not None:
+            out["fingerprint"] = self.workload.fingerprint().to_dict()
+        return out
 
     def write_jsonl(self, path, label: Optional[str] = None) -> dict:
         """Append one snapshot row to a JSONL time-series file."""
@@ -618,6 +723,9 @@ __all__ = [
     "LATENCY_FIRST_EMIT_MS", "LATENCY_ELIGIBILITY_MS",
     "LATENCY_END_TO_END_MS", "LATENCY_LINEAGES", "LATENCY_STAMP_DROPPED",
     "LATENCY_OPEN_DECLINED",
+    "WorkloadMonitor", "WorkloadFingerprint", "DriftDetector", "CostModel",
+    "FINGERPRINT_SCHEMA", "WORKLOAD_AUDITS", "WORKLOAD_DRIFT_EVENTS",
+    "COSTMODEL_RESIDUAL_PCT", "RESIDUAL_BOUND_PCT", "feature_gauge",
     "RESILIENCE_SHED_TUPLES", "RESILIENCE_GROW_EVENTS",
     "RESILIENCE_CHECKPOINTS", "RESILIENCE_RESTARTS",
     "DELIVERY_EMITTED", "DELIVERY_DUPLICATES_SUPPRESSED",

@@ -1,0 +1,420 @@
+"""Per-stage cost model distilled from the recorded bench cells —
+the predictive half of the ISSUE 16 sensor plane (ROADMAP item 4 names
+it the self-tuning controller's prerequisite).
+
+The model is deliberately simple and fully inspectable: per cost
+target, a law ``ms = intercept + per_mtuple_s * rate +
+per_inv_mtuple_s / rate`` fit by least squares over the checked-in
+``bench_results/`` cells (``rate`` in millions of tuples per second —
+the fingerprint's ``arrival_rate_per_s`` scaled). The reciprocal basis
+is the engine's actual physics: a fused cell processes a fixed tuple
+batch per interval, so interval time ~ tuples_per_interval / rate (the
+recorded sliding-count family measures ``interval_step_ms * rate``
+constant to <1%) — and the linear term carries any per-tuple host
+cost on top. Targets are the PR 13 stage histograms
+(``latency_stage_<stage>_ms`` means — the stage-stamped lineage is the
+ground truth the model distills), the host drain faces every cell
+carries (``watermark_dispatch_ms``, ``sync_ms``), the whole-interval
+``interval_step_ms``, and the first-emit p99 headline. Cells that lack
+a target simply don't constrain it; a target seen at only one rate
+degrades to an intercept-only law (the honest fallback — no
+extrapolation is invented from a single point).
+
+``python -m scotty_tpu.obs costmodel fit <cells...> [-o model.json]``
+fits and prints the coefficient table; ``... costmodel predict
+<model.json> <export>`` predicts each cell of an export from its own
+recorded rate and reports per-target residuals — exit 1 when the
+headline residual exceeds the model's stated bound
+(:data:`RESIDUAL_BOUND_PCT`). At runtime the same model rides the
+:class:`~scotty_tpu.obs.workload.WorkloadMonitor`: each audit window's
+live fingerprint predicts the interval step latency, and the residual
+against the measured window lands in the gated
+``costmodel_residual_pct`` gauge — a blown residual means the live
+workload left the regime the model was fit on, which is itself a
+drift signal (the :class:`~scotty_tpu.obs.drift.DriftDetector` judges
+it like any fingerprint feature).
+
+Reporting groups the tracer stages into the engine's cost vocabulary
+(:data:`MODEL_STAGE_GROUPS`): ring (enqueue+dequeue), shaper_sort,
+dispatch, generator_lift (arrival+eligibility), drain_fetch, sink
+(emit+sink) — the PR 13 attribution showed drain_fetch owning 67-71 ms
+of the 70.8 ms first-emit anchor, and a fitted model must reproduce
+that ownership (the acceptance test pins it).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .latency import STAGES, stage_metric
+
+#: schema tag for saved model files
+COSTMODEL_SCHEMA = "scotty_tpu.costmodel/1"
+
+#: registry gauge: live |measured - predicted| interval-step residual, in
+#: percent of the prediction (gated by the default ``obs diff``)
+COSTMODEL_RESIDUAL_PCT = "costmodel_residual_pct"
+
+#: the stated residual bound: a prediction off by more than this many
+#: percent (offline on a held-out cell, or live against the measured
+#: audit window) is out of the fitted regime
+RESIDUAL_BOUND_PCT = 25.0
+
+#: cost-vocabulary grouping of the tracer stages (reporting only — the
+#: model fits per tracer stage; groups sum their members' predictions)
+MODEL_STAGE_GROUPS = {
+    "ring": ("ring_enqueue", "ring_dequeue"),
+    "shaper_sort": ("shaper_flush",),
+    "dispatch": ("dispatch",),
+    "generator_lift": ("arrival", "eligibility"),
+    "drain_fetch": ("drain",),
+    "sink": ("emit", "sink"),
+}
+
+#: non-stage cost targets (flat-metric histogram families, fit on means)
+_HOST_TARGETS = ("watermark_dispatch_ms", "sync_ms", "interval_step_ms")
+_FIRST_EMIT = "latency_first_emit_ms"
+
+
+def model_targets() -> List[str]:
+    """Every metric family the model can fit (histogram base names)."""
+    return [stage_metric(s) for s in STAGES] + list(_HOST_TARGETS) \
+        + [_FIRST_EMIT]
+
+
+def _cell_rate_mtps(flat: dict) -> Optional[float]:
+    """A cell's arrival rate in millions of tuples/s, from the registry
+    export first (the measured-region rate), the cell row as fallback."""
+    for key in ("device_ingest_tuples_per_s", "ingest_tuples_per_s"):
+        v = flat.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v) / 1e6
+    tps = flat.get("tuples_per_sec")
+    if isinstance(tps, (int, float)) and tps > 0:
+        return float(tps) / 1e6
+    tuples, wall = flat.get("tuples"), flat.get("wall_s")
+    if isinstance(tuples, (int, float)) and isinstance(wall, (int, float)) \
+            and wall > 0:
+        return float(tuples) / float(wall) / 1e6
+    return None
+
+
+def _cell_observations(flat: dict) -> Dict[str, float]:
+    """{target: mean_ms} for every model target this cell measured.
+    The first-emit family contributes its p99 (the headline the bench
+    dimension gates on); everything else its mean (the quantity the
+    linear law actually models)."""
+    out = {}
+    for target in model_targets():
+        suffix = "_p99" if target == _FIRST_EMIT else "_mean"
+        v = flat.get(f"{target}{suffix}")
+        if isinstance(v, (int, float)) \
+                and flat.get(f"{target}_count", 0):
+            out[target] = float(v)
+    return out
+
+
+@dataclass
+class CostModel:
+    """The fitted per-target laws + provenance. ``laws`` maps a target
+    family to ``{intercept, per_mtuple_s, per_inv_mtuple_s, n_cells,
+    fit_residual_pct}`` (fit residual = mean |prediction - observed| /
+    observed over the fit cells, in percent; the reciprocal coefficient
+    is ms·Mt/s — tuples-per-interval physics, see module doc)."""
+
+    laws: Dict[str, dict] = field(default_factory=dict)
+    residual_bound_pct: float = RESIDUAL_BOUND_PCT
+    n_cells: int = 0
+    schema: str = COSTMODEL_SCHEMA
+
+    # -- prediction -------------------------------------------------------
+    def predict(self, rate_mtps: float) -> Dict[str, float]:
+        """{target: predicted ms} at one arrival rate (millions/s)."""
+        inv = 1.0 / rate_mtps if rate_mtps > 0 else 0.0
+        return {t: law["intercept"] + law["per_mtuple_s"] * rate_mtps
+                + law.get("per_inv_mtuple_s", 0.0) * inv
+                for t, law in self.laws.items()}
+
+    def predict_features(self, features: Dict[str, float]
+                         ) -> Dict[str, float]:
+        """Predict from a live fingerprint's feature dict."""
+        rate = float(features.get("arrival_rate_per_s", 0.0)) / 1e6
+        return self.predict(rate)
+
+    def predict_interval_ms(self, features: Dict[str, float]
+                            ) -> Optional[float]:
+        """The whole-interval step prediction the runtime residual is
+        judged against: the fitted ``interval_step_ms`` law when
+        present, else the sum of the fitted tracer-stage laws."""
+        pred = self.predict_features(features)
+        if "interval_step_ms" in pred:
+            return pred["interval_step_ms"]
+        stages = [pred[stage_metric(s)] for s in STAGES
+                  if stage_metric(s) in pred]
+        return sum(stages) if stages else None
+
+    def residual_pct(self, features: Dict[str, float],
+                     measured_interval_ms: Optional[float]
+                     ) -> Optional[float]:
+        """Live residual in percent (None when either side is missing
+        — a window with no measured intervals must not fake a 0)."""
+        if measured_interval_ms is None or measured_interval_ms <= 0:
+            return None
+        pred = self.predict_interval_ms(features)
+        if pred is None or pred <= 0:
+            return None
+        return 100.0 * abs(measured_interval_ms - pred) / pred
+
+    def grouped(self, rate_mtps: float) -> Dict[str, float]:
+        """Cost-vocabulary view of one prediction: group name ->
+        predicted ms (only groups with at least one fitted member)."""
+        pred = self.predict(rate_mtps)
+        out = {}
+        for group, members in MODEL_STAGE_GROUPS.items():
+            vals = [pred[stage_metric(m)] for m in members
+                    if stage_metric(m) in pred]
+            if vals:
+                out[group] = sum(vals)
+        return out
+
+    # -- persistence ------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"schema": self.schema,
+                "residual_bound_pct": self.residual_bound_pct,
+                "n_cells": self.n_cells, "laws": self.laws}
+
+    def save(self, path: str) -> None:
+        from ..utils import fsio
+
+        fsio.write_bytes(path,
+                         json.dumps(self.to_dict(), indent=1).encode())
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CostModel":
+        return cls(laws=dict(d.get("laws", {})),
+                   residual_bound_pct=float(
+                       d.get("residual_bound_pct", RESIDUAL_BOUND_PCT)),
+                   n_cells=int(d.get("n_cells", 0)),
+                   schema=str(d.get("schema", COSTMODEL_SCHEMA)))
+
+    @classmethod
+    def load(cls, path: str) -> "CostModel":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def fit(cell_flats: List[dict],
+        residual_bound_pct: float = RESIDUAL_BOUND_PCT) -> CostModel:
+    """Fit per-target linear laws over flat cell metric dicts (the
+    shape ``obs.diff._cells`` loads). Cells without a resolvable rate
+    are skipped; targets observed at fewer than 2 distinct rates get
+    intercept-only laws."""
+    import numpy as np
+
+    points: Dict[str, List[Tuple[float, float]]] = {}
+    used = 0
+    for flat in cell_flats:
+        rate = _cell_rate_mtps(flat)
+        if rate is None:
+            continue
+        obs_targets = _cell_observations(flat)
+        if not obs_targets:
+            continue
+        used += 1
+        for target, ms in obs_targets.items():
+            points.setdefault(target, []).append((rate, ms))
+    laws: Dict[str, dict] = {}
+    for target, pts in points.items():
+        x = np.asarray([p[0] for p in pts], np.float64)
+        y = np.asarray([p[1] for p in pts], np.float64)
+        spread = len(pts) >= 2 and float(np.ptp(x)) > 1e-9
+
+        def _solve(cols) -> tuple:
+            coef, *_ = np.linalg.lstsq(
+                np.stack(cols, axis=1), y, rcond=None)
+            return tuple(float(c) for c in coef)
+
+        def _rel(pred) -> float:
+            with np.errstate(divide="ignore", invalid="ignore"):
+                r = np.abs(pred - y) / np.where(y != 0, np.abs(y),
+                                                np.nan)
+            r = r[np.isfinite(r)]
+            return float(r.mean()) if r.size else 0.0
+
+        b0, b1, b2 = float(y.mean()), 0.0, 0.0
+        if spread:
+            b0, b1 = _solve([np.ones_like(x), x])
+        best = _rel(b0 + b1 * x)
+        # the reciprocal basis (tuples-per-interval physics) — adopted
+        # only when all rates are positive, the system is not exactly
+        # determined by fewer points than coefficients, and it actually
+        # fits better than the affine law (no free win on noise)
+        if spread and len(pts) >= 3 and float(x.min()) > 0:
+            c0, c1, c2 = _solve([np.ones_like(x), x, 1.0 / x])
+            rel3 = _rel(c0 + c1 * x + c2 / x)
+            if rel3 < best:
+                b0, b1, b2, best = c0, c1, c2, rel3
+        laws[target] = {
+            "intercept": b0, "per_mtuple_s": b1, "per_inv_mtuple_s": b2,
+            "n_cells": len(pts),
+            "fit_residual_pct": float(100.0 * best)}
+    return CostModel(laws=laws, residual_bound_pct=residual_bound_pct,
+                     n_cells=used)
+
+
+def fit_paths(paths: List[str],
+              residual_bound_pct: float = RESIDUAL_BOUND_PCT) -> CostModel:
+    """Fit from export files (bench result lists / snapshots / JSONL)."""
+    from .diff import _cells
+
+    flats: List[dict] = []
+    for path in paths:
+        flats.extend(_cells(path).values())
+    return fit(flats, residual_bound_pct=residual_bound_pct)
+
+
+def predict_export(model: CostModel, path: str) -> List[dict]:
+    """Per-cell prediction vs observation over one export: each row
+    carries the cell key, its rate, per-target (predicted, observed,
+    residual_pct), and the headline interval residual."""
+    from .diff import _cells
+
+    rows = []
+    for key, flat in _cells(path).items():
+        rate = _cell_rate_mtps(flat)
+        if rate is None:
+            continue
+        observed = _cell_observations(flat)
+        pred = model.predict(rate)
+        targets = {}
+        for target in sorted(set(observed) & set(pred)):
+            p, o = pred[target], observed[target]
+            targets[target] = {
+                "predicted_ms": p, "observed_ms": o,
+                "residual_pct": 100.0 * abs(p - o) / o if o else 0.0}
+        if not targets:
+            continue
+        # headline: whole-interval first, stage-sum fallback — the same
+        # preference order as the live runtime residual
+        headline = None
+        for target in ("interval_step_ms",):
+            if target in targets:
+                headline = targets[target]["residual_pct"]
+        if headline is None:
+            stage_ts = [t for t in targets
+                        if t.startswith("latency_stage_")]
+            if stage_ts:
+                p = sum(targets[t]["predicted_ms"] for t in stage_ts)
+                o = sum(targets[t]["observed_ms"] for t in stage_ts)
+                headline = 100.0 * abs(p - o) / o if o else 0.0
+            elif "sync_ms" in targets:
+                headline = targets["sync_ms"]["residual_pct"]
+            elif "watermark_dispatch_ms" in targets:
+                headline = targets["watermark_dispatch_ms"][
+                    "residual_pct"]
+        rows.append({"cell": key, "rate_mtps": rate, "targets": targets,
+                     "headline_residual_pct": headline,
+                     "grouped_ms": model.grouped(rate)})
+    return rows
+
+
+def render_fit(model: CostModel) -> str:
+    lines = [f"cost model [{model.schema}] — {model.n_cells} cell(s), "
+             f"residual bound {model.residual_bound_pct:.0f}%",
+             f"  {'target':32s} {'intercept_ms':>13s} "
+             f"{'per_mtuple_s':>13s} {'per_inv_mt_s':>13s} "
+             f"{'cells':>6s} {'fit_res%':>9s}"]
+    for target in model_targets():
+        law = model.laws.get(target)
+        if law is None:
+            continue
+        lines.append(
+            f"  {target:32s} {law['intercept']:13.4f} "
+            f"{law['per_mtuple_s']:13.6f} "
+            f"{law.get('per_inv_mtuple_s', 0.0):13.4f} "
+            f"{law['n_cells']:6d} "
+            f"{law['fit_residual_pct']:9.2f}")
+    return "\n".join(lines)
+
+
+def render_predict(model: CostModel, path: str,
+                   rows: List[dict]) -> str:
+    lines = [f"{path} [cost-model prediction]"]
+    for row in rows:
+        lines.append(f"  cell: {row['cell']} "
+                     f"(rate {row['rate_mtps']:.3f} Mt/s)")
+        for target, t in row["targets"].items():
+            lines.append(
+                f"    {target:32s} predicted {t['predicted_ms']:10.3f} "
+                f"ms  observed {t['observed_ms']:10.3f} ms  "
+                f"residual {t['residual_pct']:6.1f}%")
+        if row["grouped_ms"]:
+            decomp = "  ".join(f"{g}={ms:.1f}ms"
+                               for g, ms in row["grouped_ms"].items())
+            lines.append(f"    decomposition: {decomp}")
+        hr = row["headline_residual_pct"]
+        if hr is not None:
+            verdict = "ok" if hr <= model.residual_bound_pct else "BLOWN"
+            lines.append(f"    headline residual: {hr:.1f}% "
+                         f"({verdict}, bound "
+                         f"{model.residual_bound_pct:.0f}%)")
+    return "\n".join(lines)
+
+
+def costmodel_fit_main(paths: List[str], out: Optional[str] = None,
+                       as_json: bool = False, echo=None) -> int:
+    """``obs costmodel fit``: 0 = fitted, 2 = no usable cells."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    model = fit_paths(paths)
+    if not model.laws:
+        echo("obs costmodel fit: no cell in the given exports carries a "
+             "resolvable rate + cost histogram")
+        return 2
+    if out:
+        model.save(out)
+    if as_json:
+        echo(json.dumps(model.to_dict(), indent=1, default=float))
+    else:
+        echo(render_fit(model))
+        if out:
+            echo(f"  -> {out}")
+    return 0
+
+
+def costmodel_predict_main(model_path: str, export_path: str,
+                           as_json: bool = False, echo=None) -> int:
+    """``obs costmodel predict``: 0 = within the model's residual
+    bound, 1 = headline residual blown, 2 = no usable data."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    model = CostModel.load(model_path)
+    rows = predict_export(model, export_path)
+    if not rows:
+        echo(f"obs costmodel predict: no cell in {export_path} carries "
+             "a resolvable rate + a target the model fit")
+        return 2
+    if as_json:
+        echo(json.dumps({"cells": rows,
+                         "residual_bound_pct":
+                             model.residual_bound_pct},
+                        indent=1, default=float))
+    else:
+        echo(render_predict(model, export_path, rows))
+    blown = any(r["headline_residual_pct"] is not None
+                and r["headline_residual_pct"] > model.residual_bound_pct
+                for r in rows)
+    return 1 if blown else 0
+
+
+__all__ = [
+    "CostModel", "COSTMODEL_RESIDUAL_PCT", "RESIDUAL_BOUND_PCT",
+    "MODEL_STAGE_GROUPS", "fit", "fit_paths", "predict_export",
+    "costmodel_fit_main", "costmodel_predict_main", "model_targets",
+]

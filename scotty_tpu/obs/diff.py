@@ -167,6 +167,17 @@ DEFAULT_THRESHOLDS = {
         # operator endpoint flagged, must not pass as clean.
         "flight_dropped_events": {"direction": "lower", "default": 0},
         "health_unhealthy": {"direction": "lower", "default": 0},
+        # workload sensor-plane contract (ISSUE 16): confirmed drift
+        # events APPEARING between two exports of the same workload
+        # gate — a certified number whose workload moved off its
+        # fingerprint must not pass as clean. The live cost-model
+        # residual gates past the model's stated bound (abs_tol 25 =
+        # costmodel.RESIDUAL_BOUND_PCT): a residual within the bound is
+        # the model working, past it the live stream left the fitted
+        # regime. Both lazily created ("default": 0 gates appearing).
+        "workload_drift_events": {"direction": "lower", "default": 0},
+        "costmodel_residual_pct": {"direction": "lower", "default": 0,
+                                   "abs_tol": 25.0},
     },
     "require_cells": True,
 }

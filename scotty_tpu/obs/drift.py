@@ -1,0 +1,271 @@
+"""Workload drift detection — the online watcher half of the ISSUE 16
+sensor plane (ROADMAP item 4; Megaphone, VLDB 2019, motivates *reacting*
+to workload shift, which first requires *detecting* it).
+
+:class:`DriftDetector` compares each audit window's live
+:class:`~scotty_tpu.obs.workload.WorkloadFingerprint` features against a
+reference — the fingerprint a geometry/bench cell was recorded under
+(``--fingerprint-ref`` on the bench runner threads one through), or a
+baseline the detector captures itself over the first
+``baseline_audits`` windows of the stream. Per-feature thresholds use
+the same both-tolerance semantics as the ``obs diff`` gate (a change
+must exceed BOTH ``rel_tol * |reference|`` and ``abs_tol``), and a
+feature must stay out of band for ``confirm`` CONSECUTIVE audits before
+an event fires — single-window noise on a stable stream must produce
+ZERO false positives (the recorded drift cell's acceptance arm).
+
+On a confirmed excursion the detector:
+
+* counts ``workload_drift_events`` (APPEARING gates the default
+  ``obs diff`` thresholds — a certified number whose workload moved
+  must not pass as clean),
+* flight-records one ``workload_drift`` event per drifted feature
+  (name ``workload_drift_<feature>``, value = the live reading),
+* re-arms only after the feature returns in band (one event per
+  sustained excursion, not one per window — bounded event volume).
+
+``python -m scotty_tpu.obs drift <baseline> <live>`` runs the same
+comparison offline over any two exports that carry a fingerprint
+(bench ``result_*.json`` cells, ``/vars`` dumps, bare fingerprint
+JSON, or ``workload_*`` gauges in a flat snapshot); exit 1 on drift,
+2 when either side carries no fingerprint. The ``/healthz`` face is
+``HealthPolicy``'s drift check: a probe flips unhealthy when
+``workload_drift_events`` advanced since the previous probe.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from .workload import WorkloadFingerprint
+
+#: registry counter: confirmed drift events (gated by ``obs diff``)
+WORKLOAD_DRIFT_EVENTS = "workload_drift_events"
+
+#: per-feature defaults — both-tolerance semantics (see module doc).
+#: Shares/fractions carry absolute tolerances (a 0.0 -> 0.08 late-share
+#: move is real even though the relative change is infinite); rates are
+#: judged relatively. ``costmodel_residual_pct`` appears as a feature
+#: when a cost model rides the monitor (reference 0: ANY residual past
+#: the bound is an excursion).
+DEFAULT_DRIFT_THRESHOLDS: Dict[str, dict] = {
+    "arrival_rate_per_s": {"rel_tol": 0.50, "abs_tol": 1.0},
+    "burst_factor": {"rel_tol": 0.50, "abs_tol": 1.0},
+    "late_share": {"rel_tol": 1.00, "abs_tol": 0.10},
+    "late_age_p50_ms": {"rel_tol": 1.00, "abs_tol": 64.0},
+    "ooo_fraction": {"rel_tol": 1.00, "abs_tol": 0.10},
+    "fill_ratio": {"rel_tol": 0.50, "abs_tol": 0.25},
+    "key_top_share": {"rel_tol": 0.75, "abs_tol": 0.15},
+    "key_entropy": {"rel_tol": 0.50, "abs_tol": 0.15},
+    "pallas_fallback_share": {"rel_tol": 1.00, "abs_tol": 0.05},
+    "costmodel_residual_pct": {"rel_tol": 0.0, "abs_tol": 25.0},
+}
+
+
+def compare_features(reference: Dict[str, float],
+                     live: Dict[str, float],
+                     thresholds: Optional[Dict[str, dict]] = None
+                     ) -> List[dict]:
+    """Per-feature findings over the SHARED feature set (a feature only
+    one side carries cannot be judged). Each finding:
+    ``{feature, reference, live, harm, drifted}`` — ``harm`` is the
+    absolute move, ``drifted`` the both-tolerance verdict."""
+    th = thresholds or DEFAULT_DRIFT_THRESHOLDS
+    findings = []
+    for feature in sorted(set(reference) & set(live)):
+        spec = th.get(feature)
+        if spec is None:
+            continue
+        ref = float(reference[feature])
+        cur = float(live[feature])
+        harm = abs(cur - ref)
+        drifted = (harm > float(spec.get("abs_tol", 0.0))
+                   and harm > float(spec.get("rel_tol", 0.0)) * abs(ref))
+        findings.append({"feature": feature, "reference": ref,
+                         "live": cur, "harm": harm, "drifted": drifted})
+    return findings
+
+
+class DriftDetector:
+    """Online drift watcher over audit-window features (see module doc).
+
+    ``reference`` — a :class:`WorkloadFingerprint`, a bare feature dict,
+    or None to self-capture: the first ``baseline_audits`` windows are
+    averaged into the reference (drift judging starts after that).
+    ``confirm`` — consecutive out-of-band audits required per feature
+    before its event fires (hysteresis against single-window noise).
+    """
+
+    def __init__(self, reference=None,
+                 thresholds: Optional[Dict[str, dict]] = None,
+                 confirm: int = 2, baseline_audits: int = 3):
+        if isinstance(reference, WorkloadFingerprint):
+            reference = dict(reference.features)
+        self.reference: Optional[Dict[str, float]] = \
+            dict(reference) if reference else None
+        self.thresholds = thresholds or DEFAULT_DRIFT_THRESHOLDS
+        self.confirm = max(1, int(confirm))
+        self.baseline_audits = max(1, int(baseline_audits))
+        self.events = 0
+        self.fired: List[dict] = []        # [{audit, feature, ...}]
+        self._audit = 0
+        self._baseline_acc: Dict[str, list] = {}
+        self._streak: Dict[str, int] = {}
+        self._latched: Dict[str, bool] = {}
+
+    def observe(self, features: Dict[str, float], obs=None) -> List[str]:
+        """Judge one audit window; returns the features whose events
+        fired THIS window (usually empty). ``obs`` receives the counted
+        ``workload_drift_events`` + per-feature flight events."""
+        self._audit += 1
+        if self.reference is None:
+            for f, v in features.items():
+                self._baseline_acc.setdefault(f, []).append(float(v))
+            if self._audit >= self.baseline_audits:
+                self.reference = {
+                    f: sum(vs) / len(vs)
+                    for f, vs in self._baseline_acc.items()}
+                # the residual feature references 0 by construction:
+                # any residual past the bound is an excursion
+                if "costmodel_residual_pct" in self.reference:
+                    self.reference["costmodel_residual_pct"] = 0.0
+            return []
+        fired_now: List[str] = []
+        for finding in compare_features(self.reference, features,
+                                        self.thresholds):
+            feature = finding["feature"]
+            if finding["drifted"]:
+                streak = self._streak.get(feature, 0) + 1
+                self._streak[feature] = streak
+                if streak >= self.confirm \
+                        and not self._latched.get(feature):
+                    self._latched[feature] = True
+                    self.events += 1
+                    fired_now.append(feature)
+                    self.fired.append(dict(finding, audit=self._audit))
+                    if obs is not None:
+                        from . import flight as _flight
+
+                        obs.counter(WORKLOAD_DRIFT_EVENTS).inc()
+                        obs.flight_event(
+                            _flight.WORKLOAD_DRIFT,
+                            f"workload_drift_{feature}",
+                            float(finding["live"]))
+            else:
+                self._streak[feature] = 0
+                self._latched[feature] = False
+        return fired_now
+
+
+# ---------------------------------------------------------------------------
+# ``python -m scotty_tpu.obs drift <baseline> <live>``
+# ---------------------------------------------------------------------------
+
+
+def load_fingerprint(path: str) -> Optional[WorkloadFingerprint]:
+    """Fish a fingerprint out of any export this package writes:
+
+    * bare fingerprint JSON (``{"schema": "scotty_tpu.workload/1", ...}``)
+    * an ``Observability.export()`` / ``/vars`` dump (``fingerprint`` key)
+    * a bench ``result_*.json`` cell list (first cell whose ``metrics``
+      section carries a fingerprint)
+    * any flat snapshot/JSONL export via the ``workload_*`` gauges
+
+    Returns None when nothing fingerprint-shaped is present."""
+    with open(path, errors="replace") as f:
+        head = f.read(1)
+        f.seek(0)
+        try:
+            obj = json.load(f)
+        except json.JSONDecodeError:
+            if head == "{":                      # JSONL series: last row
+                f.seek(0)
+                rows = [json.loads(line) for line in f if line.strip()]
+                obj = rows[-1] if rows else {}
+            else:
+                return None
+    if isinstance(obj, list):
+        for cell in obj:
+            m = cell.get("metrics")
+            if isinstance(m, dict) and isinstance(
+                    m.get("fingerprint"), dict):
+                return WorkloadFingerprint.from_dict(m["fingerprint"])
+        from .diff import _cells
+
+        for flat in _cells(path).values():
+            fp = WorkloadFingerprint.from_flat_metrics(flat)
+            if fp.features:
+                return fp
+        return None
+    if not isinstance(obj, dict):
+        return None
+    if "features" in obj:
+        fp = WorkloadFingerprint.from_dict(obj)
+        return fp if fp.features else None
+    if isinstance(obj.get("fingerprint"), dict):
+        return WorkloadFingerprint.from_dict(obj["fingerprint"])
+    m = obj.get("metrics")
+    if isinstance(m, dict):
+        if isinstance(m.get("fingerprint"), dict):
+            return WorkloadFingerprint.from_dict(m["fingerprint"])
+        inner = m.get("metrics", m)
+        fp = WorkloadFingerprint.from_flat_metrics(inner)
+        if fp.features:
+            return fp
+    fp = WorkloadFingerprint.from_flat_metrics(obj)
+    return fp if fp.features else None
+
+
+def render_drift(baseline_path: str, live_path: str,
+                 findings: List[dict]) -> str:
+    lines = [f"{baseline_path} -> {live_path} [workload drift]",
+             f"  {'feature':24s} {'reference':>14s} {'live':>14s} "
+             f"{'harm':>10s}  verdict"]
+    for f in findings:
+        lines.append(
+            f"  {f['feature']:24s} {f['reference']:14.4f} "
+            f"{f['live']:14.4f} {f['harm']:10.4f}  "
+            f"{'DRIFTED' if f['drifted'] else 'ok'}")
+    n = sum(1 for f in findings if f["drifted"])
+    lines.append(f"  {n} drifted feature(s) over "
+                 f"{len(findings)} shared")
+    return "\n".join(lines)
+
+
+def drift_main(baseline: str, live: str,
+               thresholds_path: Optional[str] = None,
+               as_json: bool = False, echo=None) -> int:
+    """The ``obs drift`` entry: 0 = within thresholds, 1 = drift,
+    2 = an input carries no fingerprint (order matched to ``obs fsck``:
+    findings before unusable input)."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    th = None
+    if thresholds_path:
+        with open(thresholds_path) as f:
+            th = json.load(f)
+    base_fp = load_fingerprint(baseline)
+    live_fp = load_fingerprint(live)
+    if base_fp is None or live_fp is None:
+        missing = baseline if base_fp is None else live
+        echo(f"obs drift: no workload fingerprint in {missing} "
+             "(need a fingerprint section or workload_* gauges)")
+        return 2
+    findings = compare_features(base_fp.features, live_fp.features, th)
+    if as_json:
+        echo(json.dumps(
+            {"findings": findings,
+             "drifted": sum(1 for f in findings if f["drifted"])},
+            indent=1, default=float))
+    else:
+        echo(render_drift(baseline, live, findings))
+    return 1 if any(f["drifted"] for f in findings) else 0
+
+
+__all__ = [
+    "DriftDetector", "WORKLOAD_DRIFT_EVENTS", "DEFAULT_DRIFT_THRESHOLDS",
+    "compare_features", "load_fingerprint", "drift_main",
+]

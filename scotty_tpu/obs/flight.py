@@ -52,6 +52,9 @@ Event-kind vocabulary (plain interned strings; recorders pass these,
 ``poison``      a record was dead-lettered (value = poison count so far)
 ``health``      a /healthz probe computed an unhealthy verdict
 ``mark``        free-form user annotation
+``fingerprint``  a workload audit window closed (value = audit index)
+``workload_drift``  a confirmed per-feature drift excursion (name =
+                ``workload_drift_<feature>``, value = live reading)
 ``crash``       generic fatal failure (``record_failure`` when no more
                 specific kind applies)
 ==============  ============================================================
@@ -163,6 +166,15 @@ DUPLICATE_SUPPRESSED = "duplicate_suppressed"
 # flight_hook crash seam: a latency stamp must never become a new
 # crash-point site inside the emission path it is measuring)
 LATENCY_STAGE = "latency_stage"
+# workload sensor-plane events (ISSUE 16, scotty_tpu.obs.workload +
+# .drift): one fingerprint event per closed audit window (name =
+# "audit", value = the audit index) and one workload_drift event per
+# CONFIRMED per-feature excursion (name = workload_drift_<feature>,
+# value = the live reading) — a postmortem timeline shows what the
+# workload was doing, and when it left the certified regime, right up
+# to the crash
+FINGERPRINT = "fingerprint"
+WORKLOAD_DRIFT = "workload_drift"
 #: generic fatal failure recorded by ``record_failure`` when no more
 #: specific kind applies (the postmortem CLI's ``crash`` cause class)
 CRASH = "crash"

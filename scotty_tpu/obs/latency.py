@@ -524,7 +524,8 @@ def _latency_metrics(flat: dict) -> dict:
                "latency_first_emit_ms_count", 0)),
            "eligibility_p99_ms": float(flat.get(
                "latency_eligibility_ms_p99", 0.0)),
-           "stamp_dropped": float(flat.get(LATENCY_STAMP_DROPPED, 0.0))}
+           "stamp_dropped": float(flat.get(LATENCY_STAMP_DROPPED, 0.0)),
+           "open_declined": float(flat.get(LATENCY_OPEN_DECLINED, 0.0))}
     return out
 
 
@@ -614,6 +615,13 @@ def render_latency(path: str, as_json: bool = False,
         if row["stamp_dropped"]:
             lines.append(f"    latency_stamp_dropped: "
                          f"{int(row['stamp_dropped'])} (gated by obs diff)")
+        if row.get("open_declined"):
+            lines.append(
+                f"    WARNING latency_open_declined: "
+                f"{int(row['open_declined'])} lineage(s) declined at "
+                f"max_open — coverage loss, not stamp loss: the p99 "
+                f"above under-samples saturation (raise max_open or "
+                f"sample_every)")
     return "\n".join(lines)
 
 

@@ -148,7 +148,13 @@ def _latency_lines(lat: dict, indent: str = "  ") -> List[str]:
     if not isinstance(lat, dict):
         return []
     if lat.get("note"):
-        return [f"{indent}latency: {lat['note']}"]
+        lines = [f"{indent}latency: {lat['note']}"]
+        if lat.get("open_declined"):
+            lines.append(
+                f"{indent}  WARNING: latency_open_declined="
+                f"{int(lat['open_declined'])} — every lineage was "
+                f"declined at max_open; no coverage at all")
+        return lines
     lines = [f"{indent}latency: end-to-end p99 "
              f"{lat.get('end_to_end_p99_ms', 0.0):.3f} ms over "
              f"{lat.get('samples', 0)} chains"]
@@ -163,6 +169,16 @@ def _latency_lines(lat: dict, indent: str = "  ") -> List[str]:
             f"{lat['owner_share']:.0%} of the stage-p99 sum); "
             f"conservation "
             f"{'ok' if lat.get('conservation_ok') else 'VIOLATED'}")
+    # ISSUE 16 satellite: the deliberately-ungated saturation counter —
+    # declined lineages are COVERAGE loss (the tracer refused to open a
+    # chain at max_open), so the percentiles above silently miss exactly
+    # the saturated tail an operator cares about. Warn, loudly.
+    if lat.get("open_declined"):
+        lines.append(
+            f"{indent}  WARNING: latency_open_declined="
+            f"{int(lat['open_declined'])} — sampled coverage lost at "
+            f"max_open; p99 under-samples saturation (raise max_open "
+            f"or sample_every)")
     return lines
 
 
@@ -278,6 +294,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 "single sealed bundle")
     fp.add_argument("--json", action="store_true",
                     help="machine-readable report instead of the table")
+    wp = sub.add_parser(
+        "drift", help="compare two exports' workload fingerprints "
+                      "feature-by-feature under the per-feature drift "
+                      "thresholds; exit 0 within thresholds / 1 drift "
+                      "/ 2 an input carries no fingerprint")
+    wp.add_argument("baseline", help="reference export (a recorded "
+                                     "cell's result_*.json, a /vars "
+                                     "dump, a bare fingerprint JSON, "
+                                     "or any workload_*-gauged export)")
+    wp.add_argument("live", help="live export to judge against the "
+                                 "reference")
+    wp.add_argument("--thresholds", default=None, metavar="FILE",
+                    help="per-feature {rel_tol, abs_tol} JSON; default "
+                         "is drift.DEFAULT_DRIFT_THRESHOLDS")
+    wp.add_argument("--json", action="store_true",
+                    help="machine-readable finding list")
+    tp = sub.add_parser(
+        "trend", help="reconstruct the bench trajectory across "
+                      "BENCH_r*.json rounds (+ current bench_results "
+                      "cells) and flag round-to-round regressions "
+                      "under the obs diff thresholds; exit 1 on a "
+                      "flagged transition / 2 when no round parsed")
+    tp.add_argument("rounds", nargs="*",
+                    help="BENCH_r*.json round files (default: glob "
+                         "BENCH_r*.json in the current directory)")
+    tp.add_argument("--results", default=None, metavar="DIR",
+                    help="bench_results directory for the "
+                         "current-cells section of the trajectory")
+    tp.add_argument("--json", action="store_true",
+                    help="machine-readable trajectory")
+    cp = sub.add_parser(
+        "costmodel", help="fit per-stage cost coefficients from "
+                          "recorded cells, or predict an export's "
+                          "cells from a fitted model and report "
+                          "residuals")
+    csub = cp.add_subparsers(dest="costcmd", required=True)
+    cf = csub.add_parser(
+        "fit", help="least-squares per-target laws over recorded "
+                    "cells; exit 2 when no cell carries a rate + "
+                    "target")
+    cf.add_argument("cells", nargs="+",
+                    help="recorded exports to fit on "
+                         "(bench_results/result_*.json, snapshots)")
+    cf.add_argument("-o", "--out", default=None, metavar="FILE",
+                    help="write the fitted model JSON here")
+    cf.add_argument("--json", action="store_true",
+                    help="machine-readable coefficient table")
+    cv = csub.add_parser(
+        "predict", help="predict each cell of an export from its own "
+                        "recorded rate; exit 1 when a headline "
+                        "residual exceeds the model's stated bound")
+    cv.add_argument("model", help="fitted model JSON (costmodel fit -o)")
+    cv.add_argument("export", help="export whose cells to predict")
+    cv.add_argument("--json", action="store_true",
+                    help="machine-readable per-cell residuals")
     args = ap.parse_args(argv)
     if args.cmd == "report":
         from ..utils import stdout_echo
@@ -302,4 +373,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .fsck import fsck_main
 
         return fsck_main(args.dir, as_json=args.json)
+    if args.cmd == "drift":
+        from .drift import drift_main
+
+        return drift_main(args.baseline, args.live,
+                          thresholds_path=args.thresholds,
+                          as_json=args.json)
+    if args.cmd == "trend":
+        from .trend import trend_main
+
+        return trend_main(args.rounds or None,
+                          results_dir=args.results, as_json=args.json)
+    if args.cmd == "costmodel":
+        if args.costcmd == "fit":
+            from .costmodel import costmodel_fit_main
+
+            return costmodel_fit_main(args.cells, out=args.out,
+                                      as_json=args.json)
+        from .costmodel import costmodel_predict_main
+
+        return costmodel_predict_main(args.model, args.export,
+                                      as_json=args.json)
     return 2                                            # pragma: no cover

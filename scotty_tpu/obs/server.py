@@ -41,6 +41,7 @@ HEALTH_UNHEALTHY = "health_unhealthy"
 _WATERMARK_LAG_MS = "watermark_lag_ms"
 _STALL_EVENTS = "resilience_stall_events"
 _OVERFLOWS = "overflows"
+_DRIFT_EVENTS = "workload_drift_events"
 
 
 class HealthPolicy:
@@ -59,7 +60,13 @@ class HealthPolicy:
     (``owning_stage``), so an operator paged on emission latency knows
     which layer to look at. The check needs ``obs.latency`` with ≥ 5
     recent samples; without them it reports ok with ``samples`` counted
-    (a disabled tracer must not flap a probe).
+    (a disabled tracer must not flap a probe). ``drift_unhealthy``
+    (ISSUE 16) — unhealthy when ``workload_drift_events`` advanced
+    since the previous probe (the :class:`~.drift.DriftDetector` counts
+    one per confirmed excursion; a probe after a quiet interval
+    recovers — exactly the stall-watchdog shape). The check only
+    appears in the verdict once the counter exists in the registry, so
+    a run without a drift detector probes exactly as before.
 
     ``verdict`` is also callable without a server (tests drive it
     directly) and is safe under concurrent probes (one policy-level lock
@@ -69,13 +76,16 @@ class HealthPolicy:
     def __init__(self, max_watermark_lag_ms: Optional[float] = None,
                  stall_unhealthy: bool = True,
                  overflow_unhealthy: bool = True,
-                 max_first_emit_p99_ms: Optional[float] = None):
+                 max_first_emit_p99_ms: Optional[float] = None,
+                 drift_unhealthy: bool = True):
         self.max_watermark_lag_ms = max_watermark_lag_ms
         self.stall_unhealthy = stall_unhealthy
         self.overflow_unhealthy = overflow_unhealthy
         self.max_first_emit_p99_ms = max_first_emit_p99_ms
+        self.drift_unhealthy = drift_unhealthy
         self._lock = threading.Lock()
         self._last_stalls = 0.0
+        self._last_drift = 0.0
 
     def verdict(self, obs) -> dict:
         reg = obs.registry
@@ -86,6 +96,8 @@ class HealthPolicy:
                       if _STALL_EVENTS in reg.counters else 0.0)
             overflows = (reg.counters[_OVERFLOWS].value
                          if _OVERFLOWS in reg.counters else 0.0)
+            drift = (reg.counters[_DRIFT_EVENTS].value
+                     if _DRIFT_EVENTS in reg.counters else None)
         checks = {}
         healthy = True
         if self.max_watermark_lag_ms is not None:
@@ -106,6 +118,17 @@ class HealthPolicy:
         if self.overflow_unhealthy:
             ok = overflows == 0
             checks["overflow"] = {"ok": ok, "overflows": overflows}
+            healthy = healthy and ok
+        if self.drift_unhealthy and drift is not None:
+            # drift-detector runs only: the counter exists once a
+            # DriftDetector is wired, so a plain run probes unchanged
+            with self._lock:
+                new = drift - self._last_drift
+                self._last_drift = drift
+            ok = new <= 0
+            checks["workload_drift"] = {
+                "ok": ok, "drift_events": drift,
+                "new_since_last_probe": new}
             healthy = healthy and ok
         if self.max_first_emit_p99_ms is not None:
             tracer = getattr(obs, "latency", None)

@@ -1,0 +1,194 @@
+"""``python -m scotty_tpu.obs trend`` — reconstruct the bench
+trajectory from the checked-in round artifacts (ISSUE 16 satellite).
+
+The repo's performance story lives in two artifact families that were,
+until now, only hand-readable: the per-round headline records
+(``BENCH_r<nn>.json`` — ``{n, cmd, rc, tail, parsed}`` with the round's
+headline throughput and emit-latency percentiles in ``parsed``) and the
+current per-cell results (``bench_results/result_*.json`` — where the
+first-emit dimension and the recorded A/B overhead arms live). This
+command stitches them into one trajectory table and judges every
+round-to-round transition under the SAME threshold specs the ``obs
+diff`` CI gate uses (:data:`~scotty_tpu.obs.diff.DEFAULT_THRESHOLDS` —
+throughput must not drop >10%, emit p99 must not rise >50%/2 ms, device
+emit must not rise >25%/1 ms), so a regression between rounds is
+flagged by policy, not eyeball. Exit 1 when any transition regressed,
+2 when no round artifact parsed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Optional
+
+from .diff import DEFAULT_THRESHOLDS, _check
+
+#: BENCH round field -> the obs-diff threshold spec that judges it
+_ROUND_FIELD_SPECS = {
+    "throughput": "tuples_per_sec",
+    "p99_ms": "p99_emit_ms",
+    "emit_ms_device": "emit_ms_device",
+}
+
+#: bench-result cell fields the current-cells section surfaces (the
+#: first-emit + overhead A/B dimensions of the trajectory)
+_CELL_FIELDS = ("tuples_per_sec", "first_emit_p99_ms",
+                "latency_overhead_pct_median", "flags_off_ab_pct_median",
+                "delivery_overhead_pct_median",
+                "workload_overhead_pct_median")
+
+
+def load_round(path: str) -> Optional[dict]:
+    """One BENCH_r*.json -> a trajectory row (None when unparseable)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(obj, dict) or "parsed" not in obj:
+        return None
+    parsed = obj.get("parsed")
+    if not isinstance(parsed, dict):
+        return None
+    row = {"round": int(obj.get("n", 0)), "source": os.path.basename(path),
+           "metric": parsed.get("metric"),
+           "throughput": parsed.get("value"),
+           "p99_ms": parsed.get("p99_window_emit_ms"),
+           "p50_ms": parsed.get("p50_window_emit_ms"),
+           "rtt_floor_ms": parsed.get("rtt_floor_ms"),
+           "emit_ms_device": parsed.get("emit_ms_device")}
+    return row
+
+
+def round_transitions(rounds: List[dict]) -> List[dict]:
+    """Judge every consecutive round pair under the obs-diff specs;
+    one finding per judged field per transition (fields absent on
+    either side — early rounds predate some dimensions — are
+    skipped, exactly the one-sided-metric rule of ``obs diff``)."""
+    specs = DEFAULT_THRESHOLDS["metrics"]
+    findings = []
+    for prev, cur in zip(rounds, rounds[1:]):
+        for fld, spec_name in _ROUND_FIELD_SPECS.items():
+            b, c = prev.get(fld), cur.get(fld)
+            if not isinstance(b, (int, float)) \
+                    or not isinstance(c, (int, float)):
+                continue
+            regressed, rel = _check(specs[spec_name], float(b), float(c))
+            findings.append({
+                "transition": f"r{prev['round']:02d}->r{cur['round']:02d}",
+                "field": fld, "baseline": float(b), "candidate": float(c),
+                "rel_change": rel,
+                "status": "regressed" if regressed else "ok"})
+    return findings
+
+
+def current_cells(results_dir: str) -> List[dict]:
+    """The trajectory's terminal point: every recorded cell's headline
+    dimensions from ``result_*.json`` (first-emit p99 and the recorded
+    A/B overhead arms included where the cell measured them)."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(results_dir,
+                                              "result_*.json"))):
+        try:
+            with open(path) as f:
+                cells = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(cells, list):
+            continue
+        for cell in cells:
+            if not isinstance(cell, dict) or "error" in cell:
+                continue
+            row = {"config": os.path.basename(path),
+                   "cell": " ".join(str(cell.get(k, "")) for k in
+                                    ("name", "windows", "engine",
+                                     "aggregation"))}
+            for fld in _CELL_FIELDS:
+                if isinstance(cell.get(fld), (int, float)):
+                    row[fld] = cell[fld]
+            rows.append(row)
+    return rows
+
+
+def build_trend(paths: Optional[List[str]] = None,
+                results_dir: Optional[str] = None) -> dict:
+    if not paths:
+        paths = sorted(glob.glob("BENCH_r*.json"))
+    rounds = [r for r in (load_round(p) for p in sorted(paths))
+              if r is not None]
+    rounds.sort(key=lambda r: r["round"])
+    out = {"rounds": rounds, "transitions": round_transitions(rounds)}
+    if results_dir:
+        out["cells"] = current_cells(results_dir)
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and abs(v) < 1e4:
+        return f"{v:,.2f}"
+    return f"{v:,.0f}" if isinstance(v, (int, float)) else str(v)
+
+
+def render_trend(trend: dict) -> str:
+    lines = ["bench trajectory"]
+    lines.append(f"  {'round':>6s} {'throughput t/s':>18s} "
+                 f"{'p99_ms':>10s} {'p50_ms':>10s} {'rtt_floor':>10s} "
+                 f"{'emit_dev':>9s}  metric")
+    for r in trend["rounds"]:
+        lines.append(
+            f"  {'r%02d' % r['round']:>6s} {_fmt(r['throughput']):>18s} "
+            f"{_fmt(r['p99_ms']):>10s} {_fmt(r['p50_ms']):>10s} "
+            f"{_fmt(r['rtt_floor_ms']):>10s} "
+            f"{_fmt(r['emit_ms_device']):>9s}  {r['metric'] or ''}")
+    regressions = [f for f in trend["transitions"]
+                   if f["status"] == "regressed"]
+    lines.append(f"  transitions: {len(trend['transitions'])} checks, "
+                 f"{len(regressions)} regression(s) under the obs diff "
+                 "thresholds")
+    for f in trend["transitions"]:
+        if f["status"] != "regressed":
+            continue
+        chg = (f"{f['rel_change']:+.1%}"
+               if f["rel_change"] != float("inf") else "inf")
+        lines.append(
+            f"    {f['transition']} {f['field']}: "
+            f"{_fmt(f['baseline'])} -> {_fmt(f['candidate'])} "
+            f"({chg}) REGRESSED")
+    cells = trend.get("cells")
+    if cells:
+        lines.append(f"  current cells ({len(cells)}):")
+        for row in cells:
+            extras = "  ".join(
+                f"{fld}={_fmt(row[fld])}" for fld in _CELL_FIELDS
+                if fld in row)
+            lines.append(f"    {row['cell']:58s} {extras}")
+    return "\n".join(lines)
+
+
+def trend_main(paths: Optional[List[str]] = None,
+               results_dir: Optional[str] = None,
+               as_json: bool = False, echo=None) -> int:
+    """The ``obs trend`` entry: 0 = trajectory clean, 1 = a transition
+    regressed under the obs-diff thresholds, 2 = no round parsed."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    trend = build_trend(paths, results_dir=results_dir)
+    if not trend["rounds"]:
+        echo("obs trend: no BENCH_r*.json round artifact found/parsed")
+        return 2
+    if as_json:
+        echo(json.dumps(trend, indent=1, default=float))
+    else:
+        echo(render_trend(trend))
+    return 1 if any(f["status"] == "regressed"
+                    for f in trend["transitions"]) else 0
+
+
+__all__ = ["build_trend", "trend_main", "load_round",
+           "round_transitions", "current_cells"]

@@ -1,0 +1,362 @@
+"""Workload fingerprints — the sensor half of ROADMAP item 4 (ISSUE 16).
+
+Every recorded headline number was bought by hand-tuning geometry knobs
+against ONE workload point, and nothing in the repo said *what workload
+a number was recorded under* or *when the live stream drifted off it*.
+This module closes the first gap: a :class:`WorkloadMonitor` attached to
+an :class:`~scotty_tpu.obs.Observability` distills the registry's
+existing telemetry into a compact, versioned
+:class:`WorkloadFingerprint` — sampled ONLY at the existing drain
+points (``Observability.flight_sync`` calls :meth:`WorkloadMonitor.
+sample` exactly where a device round trip already happens, so the
+sensor plane adds zero device syncs), paced on the injectable
+:class:`~scotty_tpu.resilience.clock.Clock` (ManualClock tests drive
+audit windows deterministically), and embedded in every
+``BenchResult.to_dict()`` / ``/vars`` export so each recorded cell
+carries the workload it was certified under.
+
+Fingerprint features (each also a ``workload_<feature>`` gauge in the
+registry, refreshed per audit window — all derived from counters other
+layers already fold at drain points):
+
+==========================  ================================================
+``arrival_rate_per_s``      windowed ingest rate (``device_ingest_tuples``
+                            preferred, ``ingest_tuples`` /
+                            ``ingest_ring_delivered`` fallbacks)
+``burst_factor``            max / mean windowed rate over the recent audit
+                            windows (1.0 = perfectly steady)
+``late_share``              late tuples / ingested tuples in the window
+``late_age_p50_ms``         median lateness age, folded from the PR 2
+                            ``device_late_age_ms_le_<e>`` strata deltas
+``ooo_fraction``            shaper-reordered tuples / ingested tuples
+                            (present only when a shaper fed the window)
+``fill_ratio``              windowed mean of the ``shaper_fill_ratio``
+                            histogram (flushed block size / batch_size)
+``key_top_share``           top-k logical-key load share (keyed/mesh —
+                            fed by :meth:`observe_key_loads` at the mesh
+                            hot-key drain read)
+``key_entropy``             normalized load entropy over keys (1.0 =
+                            uniform, 0.0 = one key owns everything)
+``pallas_fallback_share``   pallas_fallbacks / (dispatches + fallbacks)
+                            in the window (ISSUE 15 pressure signal)
+==========================  ================================================
+
+Per audit window the monitor flight-records a ``fingerprint`` event,
+counts ``workload_audits``, and — when a :class:`~scotty_tpu.obs.drift.
+DriftDetector` and/or :class:`~scotty_tpu.obs.costmodel.CostModel` is
+attached — feeds them the fresh features (the detector emits the gated
+``workload_drift_events``; the model folds the live prediction residual
+into the gated ``costmodel_residual_pct`` gauge).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..resilience.clock import Clock, SystemClock, wall_time
+from .device import LATE_AGE_EDGES_MS, late_bucket_names
+
+#: schema tag — bump when the feature layout changes incompatibly;
+#: readers accept any ``scotty_tpu.workload/<n>`` they can parse
+FINGERPRINT_SCHEMA = "scotty_tpu.workload/1"
+
+#: the versioned feature vocabulary (order = display order)
+FEATURES = (
+    "arrival_rate_per_s",
+    "burst_factor",
+    "late_share",
+    "late_age_p50_ms",
+    "ooo_fraction",
+    "fill_ratio",
+    "key_top_share",
+    "key_entropy",
+    "pallas_fallback_share",
+)
+
+#: registry gauge prefix: one ``workload_<feature>`` gauge per feature
+WORKLOAD_GAUGE_PREFIX = "workload_"
+
+#: registry counter: audit windows folded by the monitor
+WORKLOAD_AUDITS = "workload_audits"
+
+# counter names the monitor reads (not creates) — kept as local constants
+# so the derivation below stays greppable against the obs contract
+_DEVICE_INGEST = "device_ingest_tuples"
+_INGEST = "ingest_tuples"
+_RING_DELIVERED = "ingest_ring_delivered"
+_DEVICE_LATE = "device_late_tuples"
+_LATE = "late_tuples"
+_REORDERED = "shaper_reordered_tuples"
+_FILL_RATIO = "shaper_fill_ratio"
+_INTERVAL_STEP = "interval_step_ms"
+_PALLAS_DISPATCHES = "pallas_kernel_dispatches"
+_PALLAS_FALLBACKS = "pallas_fallbacks"
+
+
+def feature_gauge(feature: str) -> str:
+    """Registry gauge name for one fingerprint feature."""
+    return f"{WORKLOAD_GAUGE_PREFIX}{feature}"
+
+
+@dataclass
+class WorkloadFingerprint:
+    """One compact workload characterization: the versioned feature dict
+    plus provenance (wall timestamp, audit windows folded). Absent
+    features (no shaper in the path, no keyed engine) are simply missing
+    from ``features`` — drift comparison only judges shared features."""
+
+    features: Dict[str, float] = field(default_factory=dict)
+    ts: float = 0.0
+    audits: int = 0
+    schema: str = FINGERPRINT_SCHEMA
+
+    def to_dict(self) -> dict:
+        return {"schema": self.schema, "ts": self.ts,
+                "audits": self.audits, "features": dict(self.features)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkloadFingerprint":
+        feats = {k: float(v) for k, v in (d.get("features") or {}).items()
+                 if isinstance(v, (int, float))}
+        return cls(features=feats, ts=float(d.get("ts", 0.0)),
+                   audits=int(d.get("audits", 0)),
+                   schema=str(d.get("schema", FINGERPRINT_SCHEMA)))
+
+    @classmethod
+    def from_flat_metrics(cls, flat: dict) -> "WorkloadFingerprint":
+        """Reconstruct from a flat metrics snapshot (the ``workload_*``
+        gauges a registry export carries) — the fallback for exports
+        that predate the structured ``fingerprint`` section."""
+        feats = {}
+        for f in FEATURES:
+            v = flat.get(feature_gauge(f))
+            if isinstance(v, (int, float)):
+                feats[f] = float(v)
+        return cls(features=feats,
+                   audits=int(flat.get(WORKLOAD_AUDITS, 0)))
+
+
+def _late_age_p50(bucket_deltas: Dict[str, float]) -> float:
+    """Median late-age (ms) from the cumulative-bucket deltas of the
+    PR 2 ``device_late_age_ms_le_<e>`` strata. Buckets are per-bucket
+    counts (not cumulative across edges), so a simple cumulative walk
+    finds the bucket holding the median; the bucket's upper edge is the
+    conservative estimate (the inf bucket reports 2x the last edge)."""
+    names = late_bucket_names()
+    total = sum(max(0.0, bucket_deltas.get(n, 0.0)) for n in names)
+    if total <= 0:
+        return 0.0
+    half = total / 2.0
+    acc = 0.0
+    for name, edge in zip(names, tuple(LATE_AGE_EDGES_MS) + (None,)):
+        acc += max(0.0, bucket_deltas.get(name, 0.0))
+        if acc >= half:
+            if edge is None:                       # the +inf stratum
+                return float(2 * LATE_AGE_EDGES_MS[-1])
+            return float(edge)
+    return float(2 * LATE_AGE_EDGES_MS[-1])        # pragma: no cover
+
+
+class WorkloadMonitor:
+    """Drain-point workload sampler. Attach with
+    ``Observability(workload=...)`` or ``obs.attach_workload(...)``;
+    every ``flight_sync`` (the hook the engine already calls from its
+    sync/check_overflow drain points) invokes :meth:`sample`, which is
+    a single clock read until ``audit_interval_s`` has elapsed — then
+    one audit folds counter deltas into fresh feature gauges.
+
+    ``clock`` paces audits (ManualClock in tests); ``burst_window``
+    bounds the recent-rate memory behind ``burst_factor``; ``top_k``
+    is the key-skew head size. ``detector`` / ``costmodel`` (attach
+    any time) receive each audit's features."""
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 audit_interval_s: float = 1.0,
+                 burst_window: int = 8, top_k: int = 8):
+        self.clock = clock or SystemClock()
+        self.audit_interval_s = float(audit_interval_s)
+        self.burst_window = int(burst_window)
+        self.top_k = int(top_k)
+        self.obs = None
+        self.detector = None            # a drift.DriftDetector, optional
+        self.costmodel = None           # a costmodel.CostModel, optional
+        self.audits = 0
+        self._lock = threading.RLock()
+        self._t_last: Optional[float] = None
+        self._prev: Dict[str, float] = {}
+        self._prev_hist: Dict[str, tuple] = {}
+        self._rates: list = []
+        self._key_skew: Optional[tuple] = None     # (top_share, entropy)
+        self._features: Dict[str, float] = {}
+
+    # -- wiring -----------------------------------------------------------
+    def bind(self, obs) -> "WorkloadMonitor":
+        self.obs = obs
+        return self
+
+    def attach_detector(self, detector) -> "WorkloadMonitor":
+        self.detector = detector
+        return self
+
+    def attach_costmodel(self, model) -> "WorkloadMonitor":
+        self.costmodel = model
+        return self
+
+    # -- the keyed/mesh skew feed ----------------------------------------
+    def observe_key_loads(self, loads) -> None:
+        """Fold one per-logical-key load read (the mesh engine's
+        ``detect_hot_keys`` drain read calls this; keyed bench cells
+        may feed their own histograms). Computes top-k share +
+        normalized entropy on the host array — no device access."""
+        import numpy as np
+
+        arr = np.asarray(loads, dtype=np.float64).ravel()
+        total = float(arr.sum())
+        if arr.size == 0 or total <= 0:
+            return
+        p = arr / total
+        k = min(self.top_k, arr.size)
+        top_share = float(np.sort(p)[::-1][:k].sum())
+        nz = p[p > 0]
+        if arr.size > 1:
+            entropy = float(-(nz * np.log(nz)).sum() / np.log(arr.size))
+        else:
+            entropy = 1.0
+        with self._lock:
+            self._key_skew = (top_share, entropy)
+
+    # -- the drain-point hook --------------------------------------------
+    def sample(self) -> bool:
+        """Called at every existing drain point (via ``flight_sync``).
+        Returns True when an audit window closed. Cheap off-audit: one
+        clock read + one comparison."""
+        now = self.clock.now()
+        with self._lock:
+            if self._t_last is None:
+                # arm the first window: baseline counter values, no audit
+                self._t_last = now
+                self._snap_prev()
+                return False
+            if now - self._t_last < self.audit_interval_s:
+                return False
+            dt = now - self._t_last
+            self._t_last = now
+            return self._audit(dt)
+
+    def _snap_prev(self) -> None:
+        obs = self.obs
+        if obs is None:
+            return
+        reg = obs.registry
+        with reg._lock:
+            self._prev = {n: c.value for n, c in reg.counters.items()}
+            self._prev_hist = {
+                n: (reg.histograms[n].sum, reg.histograms[n].count)
+                for n in (_FILL_RATIO, _INTERVAL_STEP)
+                if n in reg.histograms}
+
+    def _audit(self, dt: float) -> bool:
+        obs = self.obs
+        if obs is None:
+            return False
+        reg = obs.registry
+        with reg._lock:
+            cur = {n: c.value for n, c in reg.counters.items()}
+            cur_hist = {
+                n: (reg.histograms[n].sum, reg.histograms[n].count)
+                for n in (_FILL_RATIO, _INTERVAL_STEP)
+                if n in reg.histograms}
+        prev, self._prev = self._prev, cur
+        prev_hist, self._prev_hist = self._prev_hist, cur_hist
+
+        def delta(name: str) -> float:
+            return cur.get(name, 0.0) - prev.get(name, 0.0)
+
+        def hist_window_mean(name: str) -> Optional[float]:
+            s, c = cur_hist.get(name, (0.0, 0))
+            ps, pc = prev_hist.get(name, (0.0, 0))
+            return (s - ps) / (c - pc) if c > pc else None
+
+        feats: Dict[str, float] = {}
+        # arrival rate + burst factor
+        if _DEVICE_INGEST in cur:
+            d_in = delta(_DEVICE_INGEST)
+        elif _INGEST in cur:
+            d_in = delta(_INGEST)
+        else:
+            d_in = delta(_RING_DELIVERED)
+        rate = d_in / dt if dt > 0 else 0.0
+        self._rates.append(rate)
+        if len(self._rates) > self.burst_window:
+            del self._rates[:len(self._rates) - self.burst_window]
+        mean_rate = sum(self._rates) / len(self._rates)
+        feats["arrival_rate_per_s"] = rate
+        feats["burst_factor"] = (max(self._rates) / mean_rate
+                                 if mean_rate > 0 else 1.0)
+        # lateness strata
+        d_late = delta(_DEVICE_LATE) if _DEVICE_LATE in cur \
+            else delta(_LATE)
+        feats["late_share"] = d_late / max(d_in, 1.0)
+        bucket_deltas = {n: delta(n) for n in late_bucket_names()
+                         if n in cur}
+        if bucket_deltas:
+            feats["late_age_p50_ms"] = _late_age_p50(bucket_deltas)
+        elif d_late:
+            # host-only paths count lateness without age strata; report
+            # the share alone rather than inventing an age
+            pass
+        # OOO / reorder fraction + batch fill (shaper-fed paths only)
+        if _REORDERED in cur:
+            feats["ooo_fraction"] = delta(_REORDERED) / max(d_in, 1.0)
+        fill = hist_window_mean(_FILL_RATIO)
+        if fill is not None:
+            feats["fill_ratio"] = fill
+        # key skew (keyed/mesh drain reads)
+        if self._key_skew is not None:
+            feats["key_top_share"], feats["key_entropy"] = self._key_skew
+        # Pallas pressure
+        if _PALLAS_DISPATCHES in cur or _PALLAS_FALLBACKS in cur:
+            d_f = delta(_PALLAS_FALLBACKS)
+            d_d = delta(_PALLAS_DISPATCHES)
+            feats["pallas_fallback_share"] = d_f / max(d_f + d_d, 1.0)
+
+        self._features = feats
+        self.audits += 1
+        for f, v in feats.items():
+            obs.gauge(feature_gauge(f)).set(float(v))
+        obs.counter(WORKLOAD_AUDITS).inc()
+        from . import flight as _flight
+
+        obs.flight_event(_flight.FINGERPRINT, "audit", float(self.audits))
+        # the live cost-model residual (a blown residual is itself a
+        # drift signal — the detector below judges it like any feature)
+        model = self.costmodel
+        if model is not None:
+            step_ms = hist_window_mean(_INTERVAL_STEP)
+            residual = model.residual_pct(feats, step_ms)
+            if residual is not None:
+                from .costmodel import COSTMODEL_RESIDUAL_PCT
+
+                obs.gauge(COSTMODEL_RESIDUAL_PCT).set(residual)
+                feats = dict(feats,
+                             costmodel_residual_pct=residual)
+        det = self.detector
+        if det is not None:
+            det.observe(feats, obs=obs)
+        return True
+
+    # -- export -----------------------------------------------------------
+    def features(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._features)
+
+    def fingerprint(self) -> WorkloadFingerprint:
+        """The current fingerprint (last closed audit window's features;
+        empty before the first audit). ``ts`` is a wall stamp via the
+        sanctioned :func:`~scotty_tpu.resilience.clock.wall_time`."""
+        with self._lock:
+            return WorkloadFingerprint(features=dict(self._features),
+                                       ts=wall_time(),
+                                       audits=self.audits)
