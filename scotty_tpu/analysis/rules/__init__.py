@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     donation,
     flightkind,
     fsio_rule,
+    geometry_discipline,
     hostsync,
     hygiene,
     silentdrop,

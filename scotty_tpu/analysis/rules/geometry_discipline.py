@@ -1,0 +1,89 @@
+"""``geometry-discipline`` — coupled retunable knobs derive from one
+:class:`~scotty_tpu.autotune.EngineGeometry`, never co-constructed raw
+(the ISSUE 18 config refactor's inverse guard).
+
+The engine's tuning surface lives in one frozen value: ``EngineGeometry``
+keys the warm-step cache, commits as the ``geometry.json`` checkpoint
+sidecar, and is what ``apply_geometry`` moves atomically. A function
+that hand-builds two or more of :class:`~scotty_tpu.engine.config.
+EngineConfig` / :class:`~scotty_tpu.shaper.ShaperConfig` /
+:class:`~scotty_tpu.ingest.RingConfig` with retunable kwargs has
+re-scattered that surface — its knobs can drift apart (a batch size the
+ring's block no longer matches, a late lane sized for a different batch
+span), and the resulting engine runs at a geometry no sidecar or cache
+key describes. Derive instead::
+
+    geom = EngineGeometry(capacity=..., batch_size=..., late_capacity=...)
+    op = TpuWindowOperator(config=geom.engine_config(base))
+    shaper = StreamShaper(op, geom.shaper_config())
+
+A single config class with retunable kwargs is fine (nothing to couple);
+non-retunable kwargs (overflow policy, annex capacity, routing, dtypes)
+never count — their source of truth stays the per-module config.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Rule, SourceFile, register
+
+#: config class -> the kwargs EngineGeometry owns (the retunable knobs;
+#: passing any of these marks the construction as geometry-carrying)
+RETUNABLE_KWARGS = {
+    "EngineConfig": frozenset({
+        "capacity", "batch_size", "min_trigger_pad", "micro_batch",
+        "pallas_sort_split", "pallas_slice_merge", "pallas_packed"}),
+    "ShaperConfig": frozenset({
+        "slack_ms", "late_capacity", "pallas_sort_split"}),
+    "RingConfig": frozenset({"depth", "block_size"}),
+}
+
+
+def _config_call(node: ast.Call):
+    """(class name, offending retunable kwargs) for a retunable-knob
+    config construction, else None."""
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    if name not in RETUNABLE_KWARGS:
+        return None
+    knobs = {kw.arg for kw in node.keywords
+             if kw.arg} & RETUNABLE_KWARGS[name]
+    return (name, knobs) if knobs else None
+
+
+@register
+class GeometryDiscipline(Rule):
+    name = "geometry-discipline"
+    doc = ("two or more config classes (EngineConfig/ShaperConfig/"
+           "RingConfig) hand-built with retunable kwargs in one "
+           "function — derive them from a single EngineGeometry so the "
+           "coupled knobs cannot drift apart")
+    include = ("scotty_tpu",)
+    #: the geometry's own derivation methods necessarily construct the
+    #: per-module configs
+    exclude = ("scotty_tpu/autotune/",)
+
+    def check(self, src: SourceFile):
+        for fn in src.walk:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            hits = []                 # (class name, knobs, call node)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    hit = _config_call(node)
+                    if hit is not None:
+                        hits.append((hit[0], hit[1], node))
+            if len({h[0] for h in hits}) < 2:
+                continue
+            for cls_name, knobs, node in hits:
+                yield self.finding(
+                    self.name, src, node,
+                    f"{cls_name}({', '.join(sorted(knobs))}=...) "
+                    f"co-constructed with other retunable configs in "
+                    f"{fn.name}() — derive both from one EngineGeometry "
+                    "(geometry.engine_config()/shaper_config()/"
+                    "ring_config()) so the coupled knobs move as a "
+                    "single cacheable, sidecar-committable value")

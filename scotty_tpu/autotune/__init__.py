@@ -1,0 +1,46 @@
+"""scotty_tpu.autotune — the actuation plane of the self-tuning engine
+(ISSUE 18; ROADMAP item 4's second half).
+
+PR 16 built the sensor plane: workload fingerprints, per-stage cost
+laws, gated drift events. This package closes the loop — safely:
+
+* :class:`EngineGeometry` (:mod:`.geometry`) — ONE frozen serializable
+  value for every retunable knob that used to be scattered across
+  EngineConfig / ShaperConfig / RingConfig / the chunk regroup; the
+  per-module configs are DERIVED from it (``engine_config()`` /
+  ``shaper_config()`` / ``ring_config()``), it keys the warm-step
+  cache, and it commits as a checkpoint sidecar.
+* :func:`apply_geometry` (:mod:`.retune`) — live retune as a
+  checkpoint-boundary operation: drain → one atomic manifest-sealed
+  bundle (state + geometry sidecar + sink ledger) → rebuild through
+  the :class:`~scotty_tpu.serving.cache.GeometryCache` (warm bucket =
+  zero compiles; new = itemized ``autotune_retraces``) → restore FROM
+  the bundle. A retuned run bit-matches a never-retuned run; a crash
+  at any instrumented site restores the committed side of the
+  boundary with exactly-once tags intact (the crash-point sweep
+  certifies both).
+* :class:`GeometryController` (:mod:`.controller`) — rule-based online
+  decisions over a bounded candidate set: drift-gated, confirm-
+  hysteresis, cooldown, cost-model-ranked; zero steady-state retunes;
+  decisions AND rejections flight-recorded.
+* :class:`DegradationLadder` (:mod:`.degrade`) — when nothing admits
+  the offered load, shed in counted rungs (late stratum → sampled
+  admission with deterministic survivors → backpressure), edge-
+  triggered through /healthz and the flight recorder, exact
+  ``offered == admitted + shed`` conservation throughout.
+"""
+
+from .controller import ControllerPolicy, GeometryController
+from .degrade import (RUNG_BACKPRESSURE, RUNG_LATE_SHED, RUNG_NAMES,
+                      RUNG_NONE, RUNG_SAMPLED, DegradationLadder)
+from .geometry import SHAPE_AFFECTING, EngineGeometry, GeometryError
+from .retune import (apply_geometry, apply_geometry_operator,
+                     run_retuned_pipeline)
+
+__all__ = [
+    "EngineGeometry", "GeometryError", "SHAPE_AFFECTING",
+    "apply_geometry", "apply_geometry_operator", "run_retuned_pipeline",
+    "ControllerPolicy", "GeometryController",
+    "DegradationLadder", "RUNG_NONE", "RUNG_LATE_SHED", "RUNG_SAMPLED",
+    "RUNG_BACKPRESSURE", "RUNG_NAMES",
+]

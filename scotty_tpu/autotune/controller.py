@@ -1,0 +1,159 @@
+"""``GeometryController`` — the online rule-based half of the loop.
+
+The sensor plane (PR 16) produces a fingerprint feature vector every
+audit window and confirmed per-feature drift events; this controller
+turns them into retune DECISIONS over a bounded, named candidate set —
+and nothing else. Design constraints, each load-bearing:
+
+* **No thrash.** A retune costs a drain + a commit (and possibly a
+  compile), so the controller only considers moving while the workload
+  is in a drift excursion (a drift event fired recently) or the current
+  geometry has become inadmissible for the offered load. In steady
+  state it proposes nothing — the bench's stable arm asserts zero
+  retunes over a full run.
+* **Confirm-hysteresis + cooldown.** A candidate must win
+  ``policy.confirm`` consecutive audits before it is decided
+  (single-audit blips propose, hold, and expire), and after any
+  decision the controller sits out ``policy.cooldown`` audits so the
+  new geometry's own transient can settle without being mistaken for
+  drift.
+* **Every decision AND rejection is flight-recorded** (kind
+  ``autotune``: ``propose:<name>`` → ``hold:<name>`` → ``decide:
+  <name>``; ``cooldown`` and ``no_admissible`` for the rejections), so
+  a postmortem shows why the engine did — or pointedly did not — move.
+* **Ranking is the fitted cost model's job.** ``admission(geometry,
+  features)`` returns the candidate's load headroom (admissible
+  capacity minus offered load; <= 0 means inadmissible) — callers
+  derive it from the PR 16 per-stage cost laws measured on THIS box.
+  The controller itself stays a rule engine: highest headroom wins,
+  candidate-order breaks ties deterministically.
+
+When NO candidate is admissible the controller exposes
+``saturated=True`` — the cue for the :class:`~.degrade.
+DegradationLadder` to start shedding in counted rungs instead of the
+engine falling over at its static capacity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from ..obs import flight as _fl
+from .geometry import EngineGeometry, GeometryError
+
+
+@dataclass(frozen=True)
+class ControllerPolicy:
+    """Hysteresis knobs. ``confirm`` — consecutive audits a candidate
+    must stay preferred before the controller decides; ``cooldown`` —
+    audits to sit out after a decision; ``drift_window`` — audits a
+    drift event keeps the controller willing to consider moving."""
+
+    confirm: int = 2
+    cooldown: int = 4
+    drift_window: int = 3
+
+    def __post_init__(self):
+        if self.confirm < 1 or self.cooldown < 0 or self.drift_window < 1:
+            raise GeometryError(
+                f"bad ControllerPolicy {self!r}: confirm >= 1, "
+                "cooldown >= 0, drift_window >= 1 required")
+
+
+class GeometryController:
+    """See module docstring. ``candidates`` is the bounded named set
+    (insertion order is the deterministic tie-break); ``current`` names
+    the geometry the engine starts at; ``admission(geometry, features)
+    -> float`` is the headroom rule (<= 0 inadmissible)."""
+
+    def __init__(self, candidates: Dict[str, EngineGeometry],
+                 admission: Callable[[EngineGeometry, dict], float],
+                 current: str,
+                 policy: Optional[ControllerPolicy] = None):
+        if not candidates:
+            raise GeometryError("candidate set must not be empty")
+        if current not in candidates:
+            raise GeometryError(
+                f"current geometry {current!r} not in candidate set "
+                f"{sorted(candidates)}")
+        self.candidates = dict(candidates)
+        self.admission = admission
+        self.current = current
+        self.policy = policy or ControllerPolicy()
+        self.decisions = 0             # lifetime decided retunes
+        self.saturated = False         # no admissible candidate
+        self._pending: Optional[str] = None
+        self._pending_streak = 0
+        self._cooldown_left = 0
+        self._drift_left = 0
+
+    @property
+    def geometry(self) -> EngineGeometry:
+        """The geometry the controller believes the engine runs at."""
+        return self.candidates[self.current]
+
+    def _flight(self, obs, name: str, value: float = 0.0) -> None:
+        if obs is not None:
+            obs.flight_event(_fl.AUTOTUNE, name, value)
+
+    def observe(self, features: dict, drifted: bool = False,
+                obs=None) -> Optional[EngineGeometry]:
+        """Fold one audit window. ``features`` is the PR 16 fingerprint
+        dict; ``drifted`` is whether a confirmed drift event fired this
+        window. Returns the geometry to retune to (the caller applies
+        it at the next checkpoint boundary via ``apply_geometry``) or
+        None — which is the answer on the vast majority of audits."""
+        headroom = {name: float(self.admission(g, features))
+                    for name, g in self.candidates.items()}
+        self.saturated = all(h <= 0 for h in headroom.values())
+        if drifted:
+            self._drift_left = self.policy.drift_window
+        elif self._drift_left > 0:
+            self._drift_left -= 1
+        if self._cooldown_left > 0:
+            # settling after a decision: the new geometry's transient
+            # must not read as fresh drift
+            self._cooldown_left -= 1
+            self._pending, self._pending_streak = None, 0
+            self._flight(obs, "cooldown", float(self._cooldown_left))
+            return None
+        # steady state: no drift excursion and the current geometry
+        # still admits the offered load — nothing to consider (and no
+        # flight noise: a quiet controller writes nothing)
+        if self._drift_left <= 0 and headroom[self.current] > 0:
+            self._pending, self._pending_streak = None, 0
+            return None
+        admissible = {n: h for n, h in headroom.items() if h > 0}
+        if not admissible:
+            # the ladder's cue, itemized — NOT a retune
+            self._pending, self._pending_streak = None, 0
+            self._flight(obs, "no_admissible",
+                         float(headroom[self.current]))
+            return None
+        best = max(admissible, key=lambda n: admissible[n])
+        if best == self.current:
+            self._pending, self._pending_streak = None, 0
+            return None
+        if best != self._pending:
+            self._pending, self._pending_streak = best, 1
+            self._flight(obs, f"propose:{best}", admissible[best])
+            if self.policy.confirm > 1:
+                return None
+        else:
+            self._pending_streak += 1
+            if self._pending_streak < self.policy.confirm:
+                self._flight(obs, f"hold:{best}",
+                             float(self._pending_streak))
+                return None
+        # confirmed for `confirm` consecutive audits: decide
+        self.current = best
+        self.decisions += 1
+        self._pending, self._pending_streak = None, 0
+        self._cooldown_left = self.policy.cooldown
+        self._drift_left = 0
+        self._flight(obs, f"decide:{best}", float(self.decisions))
+        return self.candidates[best]
+
+
+__all__ = ["ControllerPolicy", "GeometryController"]

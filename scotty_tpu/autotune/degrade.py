@@ -1,0 +1,151 @@
+"""``DegradationLadder`` — counted, ordered overload shedding.
+
+When the controller reports ``saturated`` (no candidate geometry admits
+the offered load) the engine must not fall over at its static capacity
+— and must not shed silently either. The ladder degrades in DEFINED
+rungs, each strictly gentler than an overflow raise and strictly
+harsher than the one below:
+
+====  ==================  =============================================
+rung  name                admission rule (cumulative)
+====  ==================  =============================================
+0     none                everything admitted
+1     late shed           tuples below the watermark dropped (the late
+                          stratum is the cheapest loss: those windows
+                          already fired)
+2     sampled admission   additionally, on-time tuples admitted 1-in-
+                          ``sample_mod`` by GLOBAL offered position —
+                          deterministic, so an oracle replay of the
+                          same offered stream reproduces the survivor
+                          set bit-exactly
+3     backpressure        ``backpressure`` turns True — the source
+                          holds; rung-2 filtering still guards what
+                          arrives anyway
+====  ==================  =============================================
+
+Rung transitions are EDGE-TRIGGERED through the flight recorder
+(``degrade`` kind, ``enter:<rung>``/``exit:<rung>``) and level-exposed
+through the ``degrade_active_rung`` gauge (the /healthz ``degradation``
+check); every refused tuple counts ``degrade_shed_tuples``. Accounting
+is exact at every audit: ``offered == admitted + shed`` as integers —
+the ManualClock soak asserts it while crashing the engine mid-retune.
+
+Escalation is load-driven: each ``audit(budget)`` window that offered
+more than ``budget`` steps one rung up; ``relax_after`` consecutive
+within-budget windows step one rung down — full recovery (rung 0,
+counters quiescent) once the excursion passes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import obs as _obs
+from ..obs import flight as _fl
+from .geometry import GeometryError
+
+RUNG_NONE = 0
+RUNG_LATE_SHED = 1
+RUNG_SAMPLED = 2
+RUNG_BACKPRESSURE = 3
+
+#: rung -> name (flight events and the /healthz verdict use the number;
+#: docs and rendered postmortems use this)
+RUNG_NAMES = ("none", "late_shed", "sampled", "backpressure")
+
+
+class DegradationLadder:
+    """See module docstring. ``sample_mod`` — rung-2 keeps one tuple in
+    ``sample_mod`` by global offered position; ``relax_after`` —
+    consecutive within-budget audits per downward step."""
+
+    def __init__(self, sample_mod: int = 4, relax_after: int = 2,
+                 obs=None):
+        if sample_mod < 2:
+            raise GeometryError(
+                f"sample_mod must be >= 2, got {sample_mod}")
+        if relax_after < 1:
+            raise GeometryError(
+                f"relax_after must be >= 1, got {relax_after}")
+        self.sample_mod = int(sample_mod)
+        self.relax_after = int(relax_after)
+        self.obs = obs
+        self.rung = RUNG_NONE
+        self.offered = 0               # lifetime, exact
+        self.admitted = 0
+        self.shed = 0
+        self._window_offered = 0       # since the last audit
+        self._ok_streak = 0
+        if obs is not None:            # the gauge existing IS the
+            obs.gauge(_obs.DEGRADE_ACTIVE_RUNG).set(  # /healthz opt-in
+                float(self.rung))
+
+    # -- admission (the hot path) ------------------------------------------
+    def admit(self, timestamps, watermark: int) -> np.ndarray:
+        """The keep-mask for one offered batch under the active rung.
+        Deterministic in (rung, global offered position, timestamps,
+        watermark) — the oracle-replay contract. Updates the exact
+        offered/admitted/shed accounting."""
+        ts = np.asarray(timestamps).reshape(-1)
+        n = int(ts.shape[0])
+        base = self.offered
+        keep = np.ones(n, dtype=bool)
+        if self.rung >= RUNG_LATE_SHED:
+            keep &= ts >= int(watermark)
+        if self.rung >= RUNG_SAMPLED:
+            keep &= (base + np.arange(n)) % self.sample_mod == 0
+        kept = int(np.count_nonzero(keep))
+        self.offered += n
+        self._window_offered += n
+        self.admitted += kept
+        self.shed += n - kept
+        if n - kept and self.obs is not None:
+            self.obs.counter(_obs.DEGRADE_SHED_TUPLES).inc(n - kept)
+        return keep
+
+    @property
+    def backpressure(self) -> bool:
+        """True while the source should hold (rung 3)."""
+        return self.rung >= RUNG_BACKPRESSURE
+
+    @property
+    def conserved(self) -> bool:
+        """The exact-accounting invariant the soak audits."""
+        return self.offered == self.admitted + self.shed
+
+    # -- escalation/relaxation (one step per audit window) -----------------
+    def audit(self, budget: float) -> int:
+        """Fold one audit window: escalate one rung when the window
+        offered more than ``budget`` tuples, relax one rung after
+        ``relax_after`` consecutive within-budget windows. Returns the
+        active rung. Transitions are edge-triggered in the flight
+        recorder; the rung gauge is refreshed every audit."""
+        offered = self._window_offered
+        self._window_offered = 0
+        before = self.rung
+        if offered > budget:
+            self._ok_streak = 0
+            if self.rung < RUNG_BACKPRESSURE:
+                self.rung += 1
+        else:
+            self._ok_streak += 1
+            if self.rung > RUNG_NONE \
+                    and self._ok_streak >= self.relax_after:
+                self.rung -= 1
+                self._ok_streak = 0
+        if self.obs is not None:
+            if self.rung > before:
+                self.obs.flight_event(_fl.DEGRADE,
+                                      f"enter:{self.rung}",
+                                      float(self.rung))
+            elif self.rung < before:
+                self.obs.flight_event(_fl.DEGRADE,
+                                      f"exit:{before}",
+                                      float(self.rung))
+            self.obs.gauge(_obs.DEGRADE_ACTIVE_RUNG).set(
+                float(self.rung))
+        return self.rung
+
+
+__all__ = ["DegradationLadder", "RUNG_NONE", "RUNG_LATE_SHED",
+           "RUNG_SAMPLED", "RUNG_BACKPRESSURE", "RUNG_NAMES"]

@@ -1,0 +1,211 @@
+"""``EngineGeometry`` — one frozen, serializable value holding every
+retunable knob (ISSUE 18, ROADMAP item 4's config refactor).
+
+The engine's tuning surface was scattered across four modules:
+:class:`~scotty_tpu.engine.config.EngineConfig` (batch size, trigger-pad
+bucket, micro-batch M, Pallas flags, capacity),
+:class:`~scotty_tpu.shaper.ShaperConfig` (reorder slack, late-lane
+capacity), :class:`~scotty_tpu.ingest.RingConfig` (ring depth/block) and
+the pipeline's chunk shape (``set_rows_per_chunk``). A live retune must
+move them as ONE value — a geometry is committed into a checkpoint
+sidecar, hashed into the warm-step cache, and compared for shape safety,
+none of which works on loose kwargs. ``EngineGeometry`` is that value:
+
+* **frozen + hashable** — usable directly as a
+  :class:`~scotty_tpu.serving.cache.GeometryCache` key (a seen geometry
+  is a warm bucket, zero compiles).
+* **serializable** — ``to_dict``/``from_dict`` round-trip through JSON;
+  the supervisor's ``geometry.json`` checkpoint sidecar is exactly this
+  (restart after a committed retune resumes AT the retuned geometry).
+* **a derivation point, not a copy** — ``engine_config()`` /
+  ``shaper_config()`` / ``ring_config()`` produce the per-module configs
+  by ``dataclasses.replace`` over a base, so non-retunable fields
+  (overflow policy, dtypes, annex capacity …) keep their source of
+  truth. The ``geometry-discipline`` analysis rule enforces the inverse:
+  coupled retunable knobs must be derived here, not co-constructed raw.
+
+Shape discipline: :data:`SHAPE_AFFECTING` names the knobs that change
+state/step SHAPES (capacity, batch span, trigger-pad bucket, interval
+span). A retune across a shape-affecting delta must transplant state
+grow-style (``resilience.policy.pad_tree``); a shape-neutral delta
+(micro-batch, chunk regroup, Pallas flags, shaper/ring knobs) restores
+bit-exactly into the committed leaf shapes. ``apply_geometry`` consults
+:meth:`EngineGeometry.shape_delta` to pick the path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+
+class GeometryError(ValueError):
+    """An inadmissible geometry, delta, or sidecar: the retune path
+    raises this instead of committing a bundle it cannot restore."""
+
+
+#: knobs whose change alters state or step shapes (transplant required;
+#: everything else restores into the committed shapes bit-exactly)
+SHAPE_AFFECTING = frozenset(
+    {"capacity", "batch_size", "min_trigger_pad", "wm_period_ms"})
+
+
+@dataclass(frozen=True)
+class EngineGeometry:
+    """The complete retunable-knob vector. Field defaults mirror the
+    per-module config defaults so ``EngineGeometry()`` describes the
+    stock engine; ``0`` means "module default / engine heuristic" for
+    the knobs whose configs use that convention (``ring_block``,
+    ``late_capacity``, ``rows_per_chunk``, ``micro_batch``,
+    ``wm_period_ms`` = interval span owned elsewhere)."""
+
+    capacity: int = 1 << 17        # slice-store rows (state-shaping)
+    batch_size: int = 1 << 15      # ingest launch span (state-shaping)
+    min_trigger_pad: int = 256     # trigger-pad bucket floor
+    micro_batch: int = 0           # streamed-emission M (0 = off)
+    rows_per_chunk: int = 0        # chunk regroup (0 = heuristic)
+    wm_period_ms: int = 0          # interval span (0 = operator-owned)
+    ring_depth: int = 8            # ingest ring slots
+    ring_block: int = 0            # ring block rows (0 = batch-derived)
+    slack_ms: int = 0              # shaper reorder slack
+    late_capacity: int = 0         # shaper late lane (0 = derived)
+    pallas_sort_split: bool = False
+    pallas_slice_merge: bool = False
+    pallas_packed: bool = False
+
+    def __post_init__(self):
+        for f in ("capacity", "batch_size", "min_trigger_pad"):
+            if int(getattr(self, f)) < 1:
+                raise GeometryError(f"{f} must be >= 1, got "
+                                    f"{getattr(self, f)!r}")
+        for f in ("micro_batch", "rows_per_chunk", "wm_period_ms",
+                  "ring_block", "slack_ms", "late_capacity"):
+            if int(getattr(self, f)) < 0:
+                raise GeometryError(f"{f} must be >= 0, got "
+                                    f"{getattr(self, f)!r}")
+        if int(self.ring_depth) < 2:
+            raise GeometryError(
+                f"ring_depth must be >= 2, got {self.ring_depth!r}")
+
+    # -- per-module config derivation -------------------------------------
+    def engine_config(self, base=None):
+        """An :class:`EngineConfig` carrying this geometry's knobs over
+        ``base`` (non-retunable fields — overflow policy, dtypes, annex
+        capacity, growth bounds — keep the base's values)."""
+        from ..engine.config import EngineConfig
+
+        return dataclasses.replace(
+            base if base is not None else EngineConfig(),
+            capacity=int(self.capacity),
+            batch_size=int(self.batch_size),
+            min_trigger_pad=int(self.min_trigger_pad),
+            micro_batch=int(self.micro_batch),
+            pallas_sort_split=bool(self.pallas_sort_split),
+            pallas_slice_merge=bool(self.pallas_slice_merge),
+            pallas_packed=bool(self.pallas_packed))
+
+    def shaper_config(self, base=None):
+        """A :class:`ShaperConfig` at this geometry's slack/late-lane
+        knobs (``batch_size=None`` stays — the shaper inherits the
+        operator's batch span, which this geometry also sets)."""
+        from ..shaper import ShaperConfig
+
+        return dataclasses.replace(
+            base if base is not None else ShaperConfig(),
+            slack_ms=int(self.slack_ms),
+            late_capacity=int(self.late_capacity),
+            pallas_sort_split=bool(self.pallas_sort_split) or None)
+
+    def ring_config(self, base=None):
+        """A :class:`RingConfig` at this geometry's depth/block knobs
+        (``ring_block=0`` keeps the ring's batch-derived default)."""
+        from ..ingest import RingConfig
+
+        return dataclasses.replace(
+            base if base is not None else RingConfig(),
+            depth=int(self.ring_depth),
+            block_size=int(self.ring_block) or None)
+
+    # -- derivation FROM live objects -------------------------------------
+    @classmethod
+    def from_configs(cls, engine=None, shaper=None, ring=None,
+                     wm_period_ms: int = 0,
+                     rows_per_chunk: int = 0) -> "EngineGeometry":
+        """Collect the knob vector from per-module configs (each may be
+        None → that module's defaults)."""
+        kw = {}
+        if engine is not None:
+            kw.update(capacity=int(engine.capacity),
+                      batch_size=int(engine.batch_size),
+                      min_trigger_pad=int(engine.min_trigger_pad),
+                      micro_batch=int(getattr(engine, "micro_batch", 0)),
+                      pallas_sort_split=bool(engine.pallas_sort_split),
+                      pallas_slice_merge=bool(engine.pallas_slice_merge),
+                      pallas_packed=bool(engine.pallas_packed))
+        if shaper is not None:
+            kw.update(slack_ms=int(shaper.slack_ms),
+                      late_capacity=int(shaper.late_capacity))
+        if ring is not None:
+            kw.update(ring_depth=int(ring.depth),
+                      ring_block=int(ring.block_size or 0))
+        return cls(wm_period_ms=int(wm_period_ms),
+                   rows_per_chunk=int(rows_per_chunk), **kw)
+
+    @classmethod
+    def from_pipeline(cls, pipeline) -> "EngineGeometry":
+        """The geometry a live fused pipeline is running at (its config,
+        interval span and current chunk regroup)."""
+        return cls.from_configs(
+            engine=pipeline.config,
+            wm_period_ms=int(getattr(pipeline, "wm_period_ms", 0)),
+            rows_per_chunk=int(getattr(pipeline, "rows_per_chunk", 0)))
+
+    @classmethod
+    def from_operator(cls, op) -> "EngineGeometry":
+        """The geometry a live :class:`TpuWindowOperator` is running at
+        (its config plus the attached shaper's knobs, when present)."""
+        sh = getattr(op, "_shaper", None)
+        return cls.from_configs(
+            engine=op.config,
+            shaper=getattr(sh, "config", None))
+
+    # -- serialization (the geometry.json sidecar) ------------------------
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, obj: dict) -> "EngineGeometry":
+        if not isinstance(obj, dict):
+            raise GeometryError(
+                f"geometry sidecar must be a JSON object, got "
+                f"{type(obj).__name__}")
+        names = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(obj) - names
+        if unknown:
+            raise GeometryError(
+                f"geometry sidecar has unknown knobs {sorted(unknown)} "
+                f"(known: {sorted(names)})")
+        return cls(**obj)
+
+    # -- shape discipline --------------------------------------------------
+    def shape_delta(self, other: "EngineGeometry") -> frozenset:
+        """The shape-affecting knobs on which ``self`` and ``other``
+        differ (empty → a bit-exact in-shape restore is possible)."""
+        return frozenset(
+            f for f in SHAPE_AFFECTING
+            if getattr(self, f) != getattr(other, f))
+
+    def delta(self, other: "EngineGeometry") -> frozenset:
+        """All knobs on which the two geometries differ."""
+        return frozenset(
+            f.name for f in dataclasses.fields(self)
+            if getattr(self, f.name) != getattr(other, f.name))
+
+    def replace(self, **kw) -> "EngineGeometry":
+        """A copy with the given knobs changed (``dataclasses.replace``
+        face — candidate sets are usually built this way)."""
+        return dataclasses.replace(self, **kw)
+
+
+__all__ = ["EngineGeometry", "GeometryError", "SHAPE_AFFECTING"]

@@ -1,0 +1,306 @@
+"""``apply_geometry`` — live retune as a checkpoint-boundary operation.
+
+A geometry change is exactly the PR 10 rebalance / PR 12 reshard shape:
+drain the device, commit ONE atomic manifest-sealed bundle (engine
+state + the geometry sidecar + the sink's epoch ledger, every byte
+through the fault-injectable fsio layer), rebuild the step at the new
+geometry, and restore FROM that bundle — so the bundle, not the live
+object graph, is the source of truth the instant the ``fsio.replace``
+lands. The crash story falls out of the ordering, not of any cleanup
+code:
+
+* a crash ANYWHERE before the rename leaves only a ``.tmp`` staging
+  dir; the lineage walk restores the committed pre-retune bundle at
+  the pre-retune geometry and the deterministic replay re-reaches the
+  boundary and re-applies the retune;
+* a crash AFTER the rename restores the retune bundle, whose geometry
+  sidecar rebuilds the step at the retuned knobs (supervisor
+  ``_build``) — the PR 3 config-sidecar discipline extended to the
+  full knob vector;
+* the sink's ledger commits INSIDE the same bundle, so replayed
+  emissions are suppressed exactly-once in both cases — zero duplicate
+  ``(epoch, seq)`` tags through any crash point (the ISSUE 18 fuzzer
+  arms every instrumented site below).
+
+Compile cost is itemized, never silent: a geometry already in the
+:class:`~scotty_tpu.serving.cache.GeometryCache` is a warm bucket
+(``flight autotune/warm`` — zero compiles, asserted by the zero-retrace
+test); a genuinely new one counts ``autotune_retraces`` (``flight
+autotune/retrace``). State moves grow-style
+(:func:`~scotty_tpu.resilience.policy.pad_tree` corner-paste): an
+equal-shape delta passes leaves through bit-exactly, a capacity growth
+embeds them in the larger buffers, a shrink raises
+:class:`~.geometry.GeometryError` before anything commits.
+
+``run_retuned_pipeline`` is the supervised driver: ``Supervisor.
+run_pipeline`` plus a ``{boundary_pos: EngineGeometry}`` schedule (the
+controller produces one online; tests pin one) and optional
+exactly-once emission through ``supervisor.sink``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, Optional
+
+from .. import obs as _obs
+from ..obs import flight as _fl
+from .geometry import EngineGeometry, GeometryError
+
+
+def _flight(obs, name: str, value: float = 0.0) -> None:
+    if obs is not None:
+        obs.flight_event(_fl.AUTOTUNE, name, value)
+
+
+def apply_geometry(pipeline, geometry: EngineGeometry, *,
+                   factory: Callable, supervisor, pos: int,
+                   cache=None, obs=None):
+    """Retune a live fused pipeline to ``geometry`` at checkpoint
+    position ``pos``; returns the replacement pipeline (the input must
+    not be used afterwards — its state buffers were transplanted).
+
+    ``factory`` is the supervisor's pipeline factory
+    (``factory(config=...)``, optionally geometry-aware). ``cache``
+    maps :class:`EngineGeometry` to a warm pipeline object. The commit
+    this performs IS the boundary checkpoint at ``pos`` — callers skip
+    their ordinary commit for that position.
+    """
+    import jax
+
+    from ..resilience.policy import pad_tree
+    from ..utils.checkpoint import (_device_copy, _pipeline_tree,
+                                    restore_pipeline, save_pipeline)
+
+    obs = obs if obs is not None else getattr(pipeline, "obs", None)
+    current = EngineGeometry.from_pipeline(pipeline)
+    if geometry == current:
+        return pipeline
+    delta = current.shape_delta(geometry)
+    if "capacity" in delta \
+            and geometry.capacity < current.capacity:
+        raise GeometryError(
+            f"retune cannot shrink capacity {current.capacity} -> "
+            f"{geometry.capacity}: live slices would not embed "
+            "(grow-style corner-paste only)")
+    span = obs.span(_obs.AUTOTUNE_RETUNE_SPAN) if obs is not None \
+        else contextlib.nullcontext()
+    with span:
+        pipeline.sync()                  # drain: the boundary is quiet
+        _flight(obs, "begin", float(pos))
+        # -- rebuild the step (warm bucket or itemized retrace) -----------
+        replacement = cache.get(geometry) if cache is not None else None
+        if replacement is pipeline:      # returning to our own key
+            replacement = None
+        if replacement is not None:
+            _flight(obs, "warm", float(pos))
+        else:
+            replacement = _construct(factory, geometry,
+                                     base_config=pipeline.config)
+            if obs is not None:
+                obs.counter(_obs.AUTOTUNE_RETRACES).inc()
+            _flight(obs, "retrace", float(pos))
+        if type(replacement) is not type(pipeline):
+            raise GeometryError(
+                f"retune factory built {type(replacement).__name__}, "
+                f"expected {type(pipeline).__name__}")
+        if obs is not None and hasattr(replacement, "set_observability"):
+            replacement.set_observability(obs)
+        # -- transplant the live carry (grow_pipeline discipline) ---------
+        old_leaves = jax.device_get(
+            jax.tree.flatten(_pipeline_tree(pipeline))[0])
+        replacement.reset()
+        try:
+            restored = pad_tree(old_leaves,
+                                _pipeline_tree(replacement))
+        except ValueError as e:
+            raise GeometryError(
+                f"geometry delta {sorted(delta)} does not embed the "
+                f"live state: {e}") from e
+        replacement.state = restored["state"]
+        if restored["sessions"]:
+            replacement.sess_states = restored["sessions"]
+        replacement._interval = pipeline._interval
+        replacement._root = pipeline._root
+        if getattr(pipeline, "dm", None) is not None:
+            replacement.dm = _device_copy(pipeline.dm)
+        replacement._dm_host = getattr(pipeline, "_dm_host", None)
+        replacement._dm_folded = getattr(pipeline, "_dm_folded", None)
+        # -- THE atomic retune commit (state + geometry sidecar + sink
+        # ledger in one manifest-sealed bundle) ---------------------------
+        supervisor._commit(
+            pos, lambda d, _p=replacement: save_pipeline(_p, d),
+            config=replacement.config, geometry=geometry,
+            flight_name="retune")
+        # -- the bundle is the truth: resume FROM it ----------------------
+        ckpt = supervisor._verified_ckpt()
+        restore_pipeline(replacement, ckpt, verify=False)
+    if cache is not None:
+        cache.put(current, pipeline)     # the old bucket stays warm
+        cache.put(geometry, replacement)
+    if obs is not None:
+        obs.counter(_obs.AUTOTUNE_RETUNES).inc()
+    _flight(obs, "commit", float(pos))
+    return replacement
+
+
+def _construct(factory: Callable, geometry: EngineGeometry, *,
+               base_config=None):
+    """Build a fresh pipeline/operator at ``geometry`` through the
+    supervisor factory protocol: a geometry-aware factory gets the full
+    vector; a plain one gets the derived EngineConfig plus a direct
+    chunk regroup (the one shape-neutral knob outside the config)."""
+    import inspect
+
+    try:
+        accepts = "geometry" in inspect.signature(factory).parameters
+    except (TypeError, ValueError):
+        accepts = False
+    if accepts:
+        return factory(config=geometry.engine_config(base_config),
+                       geometry=geometry)
+    built = factory(config=geometry.engine_config(base_config))
+    if geometry.rows_per_chunk and hasattr(built, "set_rows_per_chunk"):
+        built.set_rows_per_chunk(geometry.rows_per_chunk)
+    return built
+
+
+def apply_geometry_operator(op, geometry: EngineGeometry, *,
+                            build: Callable, supervisor, pos: int,
+                            offset: Optional[int] = None,
+                            cache=None, obs=None):
+    """Retune a live :class:`TpuWindowOperator` to ``geometry`` at
+    source position ``pos`` — same discipline as :func:`apply_geometry`
+    (drain+save → one atomic bundle carrying the NEW geometry → restore
+    the replacement from it). ``build(geometry)`` constructs an operator
+    with the same windows/aggregations at that geometry.
+
+    The operator's device state (slice grid / sessions / records) is
+    shaped by ``capacity``, not by the launch/shaper knobs, so any
+    capacity-preserving delta restores bit-exactly; a capacity change
+    must go through the GROW policy instead and raises here.
+    """
+    from ..utils.checkpoint import (restore_engine_operator,
+                                    save_engine_operator)
+
+    obs = obs if obs is not None else getattr(op, "obs", None)
+    current = EngineGeometry.from_operator(op)
+    if geometry == current:
+        return op
+    if geometry.capacity != current.capacity:
+        raise GeometryError(
+            f"operator retune cannot change capacity "
+            f"{current.capacity} -> {geometry.capacity} (state-shaping; "
+            "use the resilience GROW policy)")
+    span = obs.span(_obs.AUTOTUNE_RETUNE_SPAN) if obs is not None \
+        else contextlib.nullcontext()
+    with span:
+        _flight(obs, "begin", float(pos))
+        # save_engine_operator drains: it flushes the shaper and the
+        # pending launch queue before snapshotting — the OLD state with
+        # the NEW geometry sidecar is exactly the retune bundle
+        supervisor._commit(
+            pos, lambda d: save_engine_operator(op, d),
+            offset=offset, config=geometry.engine_config(op.config),
+            geometry=geometry, flight_name="retune")
+        replacement = cache.get(geometry) if cache is not None else None
+        if replacement is op:
+            replacement = None
+        if replacement is not None:
+            _flight(obs, "warm", float(pos))
+        else:
+            replacement = build(geometry)
+            if obs is not None:
+                obs.counter(_obs.AUTOTUNE_RETRACES).inc()
+            _flight(obs, "retrace", float(pos))
+        if obs is not None and replacement.obs is None:
+            replacement.set_observability(obs)
+        ckpt = supervisor._verified_ckpt()
+        restore_engine_operator(replacement, ckpt, verify=False)
+    if cache is not None:
+        cache.put(current, op)
+        cache.put(geometry, replacement)
+    if obs is not None:
+        obs.counter(_obs.AUTOTUNE_RETUNES).inc()
+    _flight(obs, "commit", float(pos))
+    return replacement
+
+
+def run_retuned_pipeline(factory: Callable, n_intervals: int, supervisor,
+                         schedule: Optional[Dict[int, EngineGeometry]]
+                         = None,
+                         cache=None,
+                         fault: Optional[Callable[[int], None]] = None,
+                         collect: Optional[Callable] = None) -> list:
+    """``Supervisor.run_pipeline`` with scheduled live retunes.
+
+    ``schedule`` maps a checkpoint-boundary position (completed
+    intervals) to the geometry to retune to there; the retune commit IS
+    that boundary's checkpoint. When ``supervisor.sink`` is attached,
+    every lowered row is sequenced through it as ``(interval, row_idx,
+    row)`` and delivered items go to ``collect`` (crash-safe
+    ``drain_into`` batching, replays suppressed exactly-once); the
+    per-interval rows are returned either way. ``fault(completed)`` is
+    the chaos hook, exactly as in ``run_pipeline``.
+
+    Replay semantics: a committed retune is never re-applied (a restart
+    resumes PAST its boundary, and an equal geometry is a no-op); an
+    uncommitted one is re-reached and re-applied by the deterministic
+    replay — both directions are what the crash-point sweep certifies.
+    """
+    from ..utils.checkpoint import save_pipeline
+
+    schedule = dict(schedule or {})
+    results: dict = {}
+    p = _start(supervisor, factory)
+    while True:
+        try:
+            i = int(getattr(p, "_interval", 0))
+            while i < n_intervals:
+                out = p.run(1)[0]
+                rows = p.lowered_results(out)
+                results[i] = rows
+                sink = supervisor.sink
+                if sink is not None:
+                    items = [(i, j, row) for j, row in enumerate(rows)]
+                    sink.drain_into(
+                        items, collect if collect is not None
+                        else (lambda item: None))
+                i += 1
+                if fault is not None:
+                    fault(i)
+                if i % supervisor.checkpoint_every == 0 \
+                        or i == n_intervals:
+                    p = p.enforce_overflow_policy(
+                        factory=factory, obs=supervisor.obs)
+                    target = schedule.get(i)
+                    if target is not None \
+                            and target != EngineGeometry.from_pipeline(p):
+                        # the retune commit IS this boundary's ckpt
+                        p = apply_geometry(
+                            p, target, factory=factory,
+                            supervisor=supervisor, pos=i, cache=cache,
+                            obs=supervisor.obs)
+                    else:
+                        supervisor._commit(
+                            i, lambda d, _p=p: save_pipeline(_p, d),
+                            config=p.config, flight_name="interval")
+            return [results[k] for k in range(n_intervals)]
+        except Exception as e:        # noqa: BLE001 — supervised edge
+            if isinstance(e, AssertionError):
+                raise                 # a failed audit is a verdict
+            supervisor._backoff(e)
+            p = _start(supervisor, factory)
+
+
+def _start(supervisor, factory: Callable):
+    """Restart path: restore the pipeline AND rewind the sink to the
+    same bundle's ledger (the exactly-once horizon)."""
+    ckpt = supervisor._verified_ckpt()
+    if supervisor.sink is not None:
+        supervisor.sink.restore(ckpt)
+    return supervisor._pipeline_start(factory)
+
+
+__all__ = ["apply_geometry", "apply_geometry_operator",
+           "run_retuned_pipeline"]

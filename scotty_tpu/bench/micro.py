@@ -287,15 +287,20 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     # arrival order) with a bounded back-reach into the previous batch's
     # range, taken through StreamShaper.shape_device_batch — sort-split
     # + dense/in-order ingest + the small late-residue dispatch
+    from ..autotune import EngineGeometry
     from ..engine import TpuWindowOperator
-    from ..shaper import ShaperConfig, StreamShaper
+    from ..shaper import StreamShaper
 
     from ..core.windows import TumblingWindow
 
     span = 2 * B                    # event-ms per batch (ingest_scatter's)
     back = max(1, span // 32)       # bounded inter-batch disorder reach
-    op_sh = TpuWindowOperator(config=EngineConfig(
-        capacity=C, annex_capacity=A, batch_size=B, min_trigger_pad=32))
+    # the shaped arm's engine + shaper configs derive from one geometry
+    # (geometry-discipline): coupled knobs move as a single value
+    geom_sh = EngineGeometry(capacity=C, batch_size=B,
+                             min_trigger_pad=32, late_capacity=late_cap)
+    op_sh = TpuWindowOperator(config=geom_sh.engine_config(
+        EngineConfig(annex_capacity=A)))
     # a window whose grid keeps ~iters un-GC'd batches inside `capacity`
     # (the timed loop never watermarks; the grid-1 sliding spec of the
     # scatter cell would blow the slice buffer at full shapes)
@@ -303,7 +308,7 @@ def run_micro(small: bool = False, iters: int = 20, seed: int = 0) -> dict:
     op_sh.add_window_assigner(TumblingWindow(WindowMeasure.Time, w_grid))
     op_sh.add_aggregation(SumAggregation())
     op_sh.set_max_lateness(span + back)
-    shaper = StreamShaper(op_sh, ShaperConfig(late_capacity=late_cap))
+    shaper = StreamShaper(op_sh, geom_sh.shaper_config())
     ts_sh = rng.integers(0, span + back, size=B).astype(np.int64)
     sh2 = {"i": 1}                  # start a span in so ts never go < 0
 

@@ -452,6 +452,10 @@ def run_cell(cfg: BenchmarkConfig, window_spec: str, agg_name: str,
         return run_workload_drift_cell(cfg, window_spec, agg_name,
                                        obs=obs)
 
+    if engine == "AutotuneShift":
+        return run_autotune_shift_cell(cfg, window_spec, agg_name,
+                                       obs=obs)
+
     raise ValueError(f"unknown engine {engine!r}")
 
 
@@ -762,6 +766,16 @@ def run_query_churn_mesh_cell(cfg: BenchmarkConfig, window_spec: str,
     if obs is not None:
         svc.set_observability(obs)
         obs.registry.reset_clock()
+        # served-cell sensor plane (ISSUE 18 satellite): the workload_*
+        # fingerprint gauges and the drift counter that the /healthz
+        # workload_drift check reads ride the served mesh cell exactly
+        # like the single-device connector loops do — audit cadence is
+        # wall-time-paced, so keep it short against ms-scale intervals
+        from ..obs.drift import DriftDetector
+        from ..obs.workload import WorkloadMonitor
+        monitor = WorkloadMonitor(audit_interval_s=0.05)
+        monitor.attach_detector(DriftDetector())
+        obs.attach_workload(monitor)
     # TemporaryDirectory, not mkdtemp: at 64 K keys each committed
     # bundle is 100s of MB, and the live + oracle reshards commit
     # several — cleanup() runs on the success path below and the
@@ -793,12 +807,21 @@ def run_query_churn_mesh_cell(cfg: BenchmarkConfig, window_spec: str,
         for rid in sorted(slot_map):
             sink.emit((i, rid,
                        tuple(map(tuple, g_rows.get(slot_map[rid], ())))))
+        if obs is not None:
+            # the served loop's drain point: monitor sampled first,
+            # then the flight ring — same contract as run_supervised_mesh
+            obs.flight_sync(watermark=float((i + 1) * P))
     svc.sync()
     wall = time.perf_counter() - t0 - reshard_wall_s
     svc.check_overflow()
     retraces = svc.retraces_since_warm
     n_tuples = n_timed * svc.pipeline.tuples_per_interval
+    health_verdict = None
     if obs is not None:
+        # probe the served health verdict while the registry is still
+        # live — the same verdict /healthz would have served
+        from ..obs.server import HealthPolicy
+        health_verdict = HealthPolicy().verdict(obs)
         obs.registry.stop_clock()
         svc.set_observability(None)
 
@@ -891,6 +914,11 @@ def run_query_churn_mesh_cell(cfg: BenchmarkConfig, window_spec: str,
          else [i, "c", cmd[1]])
         for i, cmds in enumerate(schedule) for cmd in cmds]
     res.churn_seed = int(cfg.seed)
+    if health_verdict is not None:
+        res.served_health_ok = bool(health_verdict.get("healthy", False))
+        res.served_drift_events = int(
+            health_verdict.get("checks", {})
+            .get("workload_drift", {}).get("drift_events", 0))
     finalize_observability(res, obs, lats, emitted, n_tuples=n_tuples)
     tmpdir.cleanup()
     return res
@@ -913,8 +941,9 @@ def run_shaped_ooo_cell(cfg: BenchmarkConfig, window_spec: str,
     import jax
     import jax.numpy as jnp
 
+    from ..autotune import EngineGeometry
     from ..engine import EngineConfig, TpuWindowOperator
-    from ..shaper import ShaperConfig, StreamShaper
+    from ..shaper import StreamShaper
 
     windows = parse_window_spec(window_spec, seed=cfg.seed)
     B = cfg.batch_size
@@ -935,13 +964,6 @@ def run_shaped_ooo_cell(cfg: BenchmarkConfig, window_spec: str,
         vals = (rng.random(B) * 10_000).astype(np.float32)
         pool.append((jax.device_put(vals), jax.device_put(ts)))
 
-    op = TpuWindowOperator(config=EngineConfig(
-        capacity=cfg.capacity, batch_size=B,
-        overflow_policy=cfg.overflow_policy))
-    for w in windows:
-        op.add_window_assigner(w)
-    op.add_aggregation(make_aggregation(agg_name))
-    op.set_max_lateness(max(cfg.max_lateness, back + int(span)))
     # default residue lanes at B/4: the adversarial stream's expected
     # late fraction is back/(span+back) ≈ 11%, so the static late block
     # runs near half-full — exercised every batch, never overflowing
@@ -958,7 +980,17 @@ def run_shaped_ooo_cell(cfg: BenchmarkConfig, window_spec: str,
             f"{exp_late:.0f} tuples ≥ late_capacity {late_cap} — lower "
             "throughput (longer span per batch), shrink shaperBackMs, or "
             "raise shaperLateCapacity")
-    shaper = StreamShaper(op, ShaperConfig(late_capacity=late_cap))
+    # one geometry derives both module configs (geometry-discipline):
+    # the coupled engine/shaper knobs move as a single value
+    geom = EngineGeometry(capacity=cfg.capacity, batch_size=B,
+                          late_capacity=late_cap)
+    op = TpuWindowOperator(config=geom.engine_config(
+        EngineConfig(overflow_policy=cfg.overflow_policy)))
+    for w in windows:
+        op.add_window_assigner(w)
+    op.add_aggregation(make_aggregation(agg_name))
+    op.set_max_lateness(max(cfg.max_lateness, back + int(span)))
+    shaper = StreamShaper(op, geom.shaper_config())
 
     def feed(i: int) -> int:
         # batch i covers [i*span - back, i*span + span): shuffled within,
@@ -1128,8 +1160,9 @@ def run_ring_fed_cell(cfg: BenchmarkConfig, window_spec: str,
     — quantifying exactly how much of the headline the generator is."""
     import jax
 
+    from ..autotune import EngineGeometry
     from ..engine import EngineConfig, TpuWindowOperator
-    from ..ingest import LineRateFeed, RingConfig
+    from ..ingest import LineRateFeed
 
     windows = parse_window_spec(window_spec, seed=cfg.seed)
     B = cfg.batch_size
@@ -1154,15 +1187,18 @@ def run_ring_fed_cell(cfg: BenchmarkConfig, window_spec: str,
         lo = off0 + int(i * span)
         return vals, ts + np.int64(lo), off0 + int((i + 1) * span)
 
-    op = TpuWindowOperator(config=EngineConfig(
-        capacity=cfg.capacity, batch_size=B,
-        overflow_policy=cfg.overflow_policy))
+    # one geometry derives the engine + ring configs (geometry-
+    # discipline): the coupled retunable knobs move as a single value
+    geom = EngineGeometry(capacity=cfg.capacity, batch_size=B,
+                          ring_depth=cfg.ring_depth or 8,
+                          ring_block=cfg.ring_block_size or B)
+    op = TpuWindowOperator(config=geom.engine_config(
+        EngineConfig(overflow_policy=cfg.overflow_policy)))
     for w in windows:
         op.add_window_assigner(w)
     op.add_aggregation(make_aggregation(agg_name))
     op.set_max_lateness(cfg.max_lateness)
-    feed = LineRateFeed(op, ring=RingConfig(
-        depth=cfg.ring_depth or 8, block_size=cfg.ring_block_size or B))
+    feed = LineRateFeed(op, ring=geom.ring_config())
 
     warm_hi = 0
     for i in (0, 1):
@@ -1834,9 +1870,9 @@ def run_ingest_external_cell(cfg: BenchmarkConfig, window_spec: str,
     certification (this cell records the platform alongside)."""
     import jax
 
+    from ..autotune import EngineGeometry
     from ..engine import EngineConfig, TpuWindowOperator
-    from ..ingest import LineRateFeed, RingConfig
-    from ..shaper import ShaperConfig
+    from ..ingest import LineRateFeed
 
     windows = parse_window_spec(window_spec, seed=cfg.seed)
     B = cfg.batch_size
@@ -1853,6 +1889,12 @@ def run_ingest_external_cell(cfg: BenchmarkConfig, window_spec: str,
             f"{exp_late:.0f} tuples ≥ late_capacity {late_cap} — lower "
             "throughput, shrink shaperBackMs, or raise "
             "shaperLateCapacity")
+    # one geometry derives the engine/ring/shaper configs for BOTH arms
+    # (geometry-discipline): coupled knobs move as a single value
+    geom = EngineGeometry(capacity=cfg.capacity, batch_size=B,
+                          ring_depth=cfg.ring_depth or 8,
+                          ring_block=cfg.ring_block_size or B,
+                          late_capacity=late_cap)
 
     # pregenerate the HOST-resident chunks (stream origin is host RAM;
     # generation is the load generator's cost, excluded as everywhere)
@@ -1865,9 +1907,8 @@ def run_ingest_external_cell(cfg: BenchmarkConfig, window_spec: str,
         chunks.append((vals, ts, lo, int((i + 1) * span) + int(span)))
 
     def mk_op():
-        op = TpuWindowOperator(config=EngineConfig(
-            capacity=cfg.capacity, batch_size=B,
-            overflow_policy=cfg.overflow_policy))
+        op = TpuWindowOperator(config=geom.engine_config(
+            EngineConfig(overflow_policy=cfg.overflow_policy)))
         for w in windows:
             op.add_window_assigner(w)
         op.add_aggregation(make_aggregation(agg_name))
@@ -1876,9 +1917,7 @@ def run_ingest_external_cell(cfg: BenchmarkConfig, window_spec: str,
 
     op = mk_op()
     feed = LineRateFeed(
-        op, ring=RingConfig(depth=cfg.ring_depth or 8,
-                            block_size=cfg.ring_block_size or B),
-        shaper=ShaperConfig(late_capacity=late_cap))
+        op, ring=geom.ring_config(), shaper=geom.shaper_config())
 
     # warmup: compiles sort-split + ingest + watermark kernels
     for i in (0, 1):
@@ -1926,7 +1965,7 @@ def run_ingest_external_cell(cfg: BenchmarkConfig, window_spec: str,
     op2 = mk_op()
     from ..shaper import StreamShaper
 
-    StreamShaper(op2, ShaperConfig(late_capacity=late_cap))
+    StreamShaper(op2, geom.shaper_config())
     base_n = int(min(n_tuples, 200_000))
     t0 = time.perf_counter()
     fed = 0
@@ -2306,6 +2345,343 @@ def run_workload_drift_cell(cfg: BenchmarkConfig, window_spec: str,
     return res
 
 
+def measure_autotune_overhead(seed: int = 0, throughput: int = 4_000_000,
+                              intervals: int = 6, pairs: int = 16) -> float:
+    """Interleaved A/B of the ISSUE 18 actuation plane in STEADY STATE
+    (acceptance: ≤ 2% median): both arms run the full PR 16 sensor
+    plane (monitor + detector, audit every sync); the B arm additionally
+    folds the :class:`GeometryController` and :class:`DegradationLadder`
+    once per interval — the controller with every candidate admissible
+    and no drift, so every ``observe`` takes the steady-state
+    short-circuit and decides NOTHING (asserted), which is exactly the
+    cost a production loop pays on the vast majority of audits. Returns
+    the median overhead in PERCENT (negative = within noise)."""
+    from ..autotune import (ControllerPolicy, DegradationLadder,
+                            EngineGeometry, GeometryController)
+    from ..core.aggregates import SumAggregation
+    from ..core.windows import SlidingWindow, WindowMeasure
+    from ..engine import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+    from ..obs.drift import DriftDetector
+
+    windows = [SlidingWindow(WindowMeasure.Time, 8000, 1000)]
+
+    def build(with_actuation: bool):
+        p = AlignedStreamPipeline(
+            windows, [SumAggregation()],
+            config=EngineConfig(capacity=2048, annex_capacity=8,
+                                min_trigger_pad=32),
+            throughput=_round_throughput(
+                throughput, AlignedStreamPipeline.slice_grid(windows,
+                                                             1000)),
+            wm_period_ms=1000, max_lateness=0, seed=seed, gc_every=32)
+        obs = _obs.Observability()
+        mon = obs.attach_workload(audit_interval_s=1e-9)
+        mon.attach_detector(DriftDetector())
+        p.reset()
+        p.run(2, collect=False)
+        p.sync()
+        p.set_observability(obs)
+        ctrl = ladder = None
+        if with_actuation:
+            base = EngineGeometry.from_pipeline(p)
+            ctrl = GeometryController(
+                {"base": base,
+                 "alt": base.replace(batch_size=base.batch_size * 2)},
+                lambda g, f: 1e9, current="base",
+                policy=ControllerPolicy(confirm=2, cooldown=2,
+                                        drift_window=3))
+            ladder = DegradationLadder(sample_mod=4, relax_after=2,
+                                       obs=obs)
+        return p, mon, ctrl, ladder, obs
+
+    pa, mon_a, _, _, _ = build(False)
+    pb, mon_b, ctrl_b, ladder_b, obs_b = build(True)
+
+    def once(p, mon, ctrl, ladder, obs) -> float:
+        t0 = time.perf_counter()
+        for _ in range(intervals):
+            p.run(1, collect=False)
+            if ctrl is not None:
+                ladder.audit(budget=float("inf"))
+                ctrl.observe(mon.features(), drifted=False, obs=obs)
+        p.sync()
+        return time.perf_counter() - t0
+
+    def once_a() -> float:
+        return once(pa, mon_a, None, None, None)
+
+    def once_b() -> float:
+        return once(pb, mon_b, ctrl_b, ladder_b, obs_b)
+
+    once_a(), once_b()                       # warm both step paths
+    a_times, b_times = [], []
+    for i in range(pairs):
+        # alternate within-pair order so slow drift (thermal, other
+        # tenants on a shared core) cancels instead of biasing one arm
+        if i % 2 == 0:
+            a_times.append(once_a())
+            b_times.append(once_b())
+        else:
+            b_times.append(once_b())
+            a_times.append(once_a())
+    pa.check_overflow()
+    pb.check_overflow()
+    assert ctrl_b.decisions == 0, \
+        "steady-state overhead arm must decide nothing"
+    a_times.sort()
+    b_times.sort()
+    return 100.0 * (b_times[len(b_times) // 2]
+                    / a_times[len(a_times) // 2] - 1.0)
+
+
+def run_autotune_shift_cell(cfg: BenchmarkConfig, window_spec: str,
+                            agg_name: str,
+                            obs: Optional[_obs.Observability] = None
+                            ) -> BenchResult:
+    """Autotune-shift cell (ISSUE 18 acceptance): the CLOSED loop —
+    sensor plane (PR 16 WorkloadMonitor + DriftDetector on a
+    ManualClock) → :class:`GeometryController` → real
+    :func:`apply_geometry` retunes on a live supervised aligned
+    pipeline — driven by a seeded 3-phase offered-load stream (stable →
+    rate ×8 → lateness storm) and scored as THROUGHPUT UNDER A LATENCY
+    SLO: each simulated second a geometry admits at most
+    ``min(batch_size·4, late_capacity·8 / late_share)`` tuples inside
+    the watermark interval (the PR 16 cost-law shape: the batch span
+    bounds the on-time lane, the late lane bounds repair drains), and
+    the :class:`DegradationLadder` guards every arm with that same
+    budget, so overload degrades in counted rungs instead of falling
+    over.
+
+    Arms, all over the IDENTICAL seeded offered stream:
+
+    * **adaptive** — controller on (bounded candidate set small / big /
+      late), each decision actuated by a REAL ``apply_geometry`` retune
+      (atomic manifest-sealed commit through a Supervisor) on the live
+      pipeline vehicle; decisions land in the flight recorder.
+    * **small / big / late** — every static candidate, controller off:
+      each is mis-sized for at least one phase (small saturates at
+      rate ×8, big's late lane collapses in the storm, late gives up
+      on-time headroom), which is WHY the cell exists — no static
+      geometry wins every phase, the adaptive arm must beat them ALL
+      on total SLO-admitted tuples (``autotune_beats_all_statics``).
+    * **stable** — the full-duration stable stream with the controller
+      ON: zero decisions, zero retunes (the no-thrash contract).
+    * **overhead** — :func:`measure_autotune_overhead`, the interleaved
+      steady-state controller-on vs controller-off A/B (≤ 2% median).
+
+    The actuation vehicle is a small aligned pipeline (its batch span
+    retunes both directions bit-exactly — the twin-guarantee tests own
+    that proof); the offered stream and SLO account are host-modeled so
+    the cell stays deterministic and CPU-runnable, with ``platform``
+    recorded alongside like every other certification cell."""
+    import tempfile
+
+    import jax
+
+    from ..autotune import (ControllerPolicy, DegradationLadder,
+                            EngineGeometry, GeometryController,
+                            apply_geometry)
+    from ..core.aggregates import SumAggregation
+    from ..core.windows import TumblingWindow, WindowMeasure
+    from ..engine import EngineConfig
+    from ..engine.pipeline import AlignedStreamPipeline
+    from ..obs.drift import DriftDetector
+    from ..obs.workload import WorkloadMonitor
+    from ..resilience.clock import ManualClock
+    from ..resilience.supervisor import Supervisor
+    from ..serving.cache import GeometryCache
+
+    P = cfg.watermark_period_ms            # 1 simulated second per audit
+    r0 = max(256, int(cfg.throughput))     # stable tuples per sim second
+    # phase schedule in simulated seconds == audit windows; each shifted
+    # phase is sized so its mis-matched statics pay for longer than the
+    # adaptive arm's detect+confirm+relax transient
+    phases = [("stable", 12, r0, 0.0),
+              ("rate_x8", 8, r0 * 8, 0.0),
+              ("late_storm", 12, r0 * 4, 0.5)]
+    total_s = sum(n for _, n, _, _ in phases)
+
+    # -- the actuation vehicle: a live supervised aligned pipeline -------
+    pipe_windows = [TumblingWindow(WindowMeasure.Time, 50)]
+
+    def factory(config=None):
+        return AlignedStreamPipeline(
+            pipe_windows, [SumAggregation()],
+            config=config if config is not None else EngineConfig(
+                capacity=1 << 12, batch_size=1024, annex_capacity=256,
+                min_trigger_pad=32),
+            throughput=20_000, wm_period_ms=100, max_lateness=100,
+            seed=cfg.seed, gc_every=10 ** 9, value_scale=1024.0,
+            collect_device_metrics=False)
+
+    p0 = factory()
+    p0.reset()
+    base = EngineGeometry.from_pipeline(p0)
+    # the bounded candidate set: one geometry per workload regime
+    candidates = {
+        "small": base.replace(late_capacity=256),         # batch 1024
+        "big": base.replace(batch_size=8192, late_capacity=32),
+        "late": base.replace(batch_size=2048, late_capacity=1024),
+    }
+
+    SLA_BATCHES = 4      # batches the step clears inside one interval
+    LATE_DRAINS = 8      # late-lane repair drains per interval
+    LATE_FLOOR = 1.0 / 64
+
+    def sla_capacity(g: EngineGeometry, feats: dict) -> float:
+        late_share = max(float(feats.get("late_share", 0.0)), LATE_FLOOR)
+        return min(float(g.batch_size * SLA_BATCHES),
+                   g.late_capacity * LATE_DRAINS / late_share)
+
+    def admission(g: EngineGeometry, feats: dict) -> float:
+        return sla_capacity(g, feats) \
+            - float(feats.get("arrival_rate_per_s", 0.0))
+
+    def second_stream(rng, phase: str, rate: int, late_frac: float,
+                      s: int, wm: int):
+        """(timestamps, n_late) for simulated second ``s`` — the storm's
+        stragglers land below the current watermark but inside
+        cfg.max_lateness (repairable, never silently droppable)."""
+        ts = np.sort(rng.integers(0, P, size=rate)) + np.int64(s * P)
+        n_late = 0
+        if late_frac and wm > 0:
+            late = rng.random(rate) < late_frac
+            age = rng.integers(1, max(2, cfg.max_lateness // 2),
+                               size=rate)
+            ts = np.where(late, np.maximum(0, np.int64(wm) - age), ts)
+            n_late = int(late.sum())
+        return ts, n_late
+
+    def run_arm(static_name, schedule, pipeline=None, supervisor=None):
+        """One arm over ``schedule``; controller on iff ``static_name``
+        is None, real retunes iff a pipeline vehicle is passed."""
+        rng = np.random.default_rng(cfg.seed)   # identical offered
+        arm_obs = _obs.Observability()          # stream in every arm
+        clock = ManualClock()
+        mon = arm_obs.attach_workload(
+            WorkloadMonitor(clock=clock, audit_interval_s=1.0))
+        det = DriftDetector()
+        mon.attach_detector(det)
+        ladder = DegradationLadder(sample_mod=4, relax_after=2,
+                                   obs=arm_obs)
+        ctrl = None
+        if static_name is None:
+            ctrl = GeometryController(
+                candidates, admission, current="small",
+                policy=ControllerPolicy(confirm=2, cooldown=2,
+                                        drift_window=3))
+        p = pipeline
+        cache = GeometryCache() if p is not None else None
+        sla = offered_total = within = transitions = last_rung = 0
+        decisions_log = []
+        s = 0
+        for phase, n_seconds, rate, late_frac in schedule:
+            for _ in range(n_seconds):
+                wm = s * P
+                ts, n_late = second_stream(rng, phase, rate, late_frac,
+                                           s, wm)
+                n = int(ts.shape[0])
+                offered_total += n
+                geom = ctrl.geometry if ctrl is not None \
+                    else candidates[static_name]
+                # the SLO account uses the second's EXACT stream stats
+                # (identical across arms); only the controller runs on
+                # the monitor's sensed features
+                exact = {"arrival_rate_per_s": float(n),
+                         "late_share": n_late / float(n)}
+                cap = sla_capacity(geom, exact)
+                keep = ladder.admit(ts, wm)
+                kept = int(np.count_nonzero(keep))
+                sla += min(kept, int(cap))
+                if kept <= cap:
+                    within += 1
+                arm_obs.counter("ingest_tuples").inc(n)
+                if n_late:
+                    arm_obs.counter("late_tuples").inc(n_late)
+                if p is not None:
+                    p.run(1, collect=False)
+                ev0 = det.events
+                clock.advance(1.0)
+                arm_obs.flight_sync(watermark=float((s + 1) * P))
+                rung = ladder.audit(budget=cap)
+                if rung != last_rung:
+                    transitions += 1
+                    last_rung = rung
+                if ctrl is not None and mon.features():
+                    g = ctrl.observe(mon.features(),
+                                     drifted=det.events > ev0,
+                                     obs=arm_obs)
+                    if g is not None:
+                        decisions_log.append({"second": s,
+                                              "to": ctrl.current})
+                        if p is not None:
+                            p = apply_geometry(
+                                p, g, factory=factory,
+                                supervisor=supervisor,
+                                pos=int(p._interval), cache=cache,
+                                obs=arm_obs)
+                            # detach: the arm's sensor counters model
+                            # the OFFERED stream, not the vehicle's
+                            p.set_observability(None)
+                s += 1
+        if p is not None:
+            p.sync()
+            p.check_overflow()
+        assert ladder.conserved, "ladder accounting must be exact"
+        return {"obs": arm_obs, "ctrl": ctrl, "ladder": ladder,
+                "sla": sla, "offered": offered_total, "within": within,
+                "transitions": transitions, "decisions": decisions_log}
+
+    # -- adaptive arm: controller + real retunes on the live vehicle -----
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        sup = Supervisor(ckpt_dir, checkpoint_every=10 ** 9)
+        adaptive = run_arm(None, phases, pipeline=p0, supervisor=sup)
+    wall = time.perf_counter() - t0
+    a_obs = adaptive["obs"]
+    retunes = int(a_obs.counter(_obs.AUTOTUNE_RETUNES).value)
+    retraces = int(a_obs.counter(_obs.AUTOTUNE_RETRACES).value)
+
+    # -- every static candidate, controller off --------------------------
+    statics = {name: run_arm(name, phases) for name in candidates}
+
+    # -- stable arm: controller on, zero decisions is the contract -------
+    stable = run_arm(None, [("stable", total_s, r0, 0.0)])
+
+    # -- steady-state actuation-plane overhead ---------------------------
+    overhead = round(measure_autotune_overhead(seed=cfg.seed), 2)
+
+    res = BenchResult(
+        name=cfg.name, windows=window_spec, aggregation=agg_name,
+        tuples_per_sec=adaptive["offered"] / wall if wall > 0 else 0.0,
+        p99_emit_ms=0.0, n_windows_emitted=adaptive["sla"],
+        n_tuples=adaptive["offered"], wall_s=round(wall, 3))
+    res.autotune_phases = [{"phase": ph, "seconds": n, "rate": rate,
+                            "late_frac": lf}
+                           for ph, n, rate, lf in phases]
+    res.autotune_decisions = adaptive["ctrl"].decisions
+    res.autotune_retunes = retunes
+    res.autotune_retraces = retraces
+    res.autotune_schedule = adaptive["decisions"]
+    res.adaptive_admitted = adaptive["sla"]
+    res.static_admitted = {name: arm["sla"]
+                           for name, arm in statics.items()}
+    res.autotune_beats_all_statics = bool(
+        adaptive["sla"] > max(arm["sla"] for arm in statics.values()))
+    res.stable_decisions = stable["ctrl"].decisions
+    res.stable_retunes = int(
+        stable["obs"].counter(_obs.AUTOTUNE_RETUNES).value)
+    res.degrade_transitions = adaptive["transitions"]
+    res.degrade_shed_tuples = adaptive["ladder"].shed
+    res.sla_ms = float(P)
+    res.sla_met = round(adaptive["within"] / float(total_s), 4)
+    res.autotune_overhead_pct_median = overhead
+    res.platform = jax.devices()[0].platform
+    finalize_observability(res, a_obs, [], 0)
+    return res
+
+
 def _flags_off_ab_overhead(cfg: BenchmarkConfig, windows, agg_name: str,
                            reps: int = 3) -> float:
     """Interleaved flags-off A/B (ISSUE 15 acceptance). Be precise about
@@ -2380,9 +2756,10 @@ def run_latency_headline_cell(cfg: BenchmarkConfig, window_spec: str,
     emitted windows against the host simulator on the same stream."""
     import jax
 
+    from ..autotune import EngineGeometry
     from ..delivery import TransactionalSink
     from ..engine import EngineConfig, TpuWindowOperator
-    from ..ingest import LineRateFeed, RingConfig
+    from ..ingest import LineRateFeed
     from ..obs.latency import CONSERVATION_TOL_MS, LatencyTracer
 
     windows = parse_window_spec(window_spec, seed=cfg.seed)
@@ -2408,20 +2785,23 @@ def run_latency_headline_cell(cfg: BenchmarkConfig, window_spec: str,
         obs = _obs.Observability()
     tracer = obs.attach_latency(
         LatencyTracer(sample_every=1, exact_limit=1 << 30))
-    op = TpuWindowOperator(config=EngineConfig(
-        capacity=cfg.capacity, batch_size=B,
-        overflow_policy=cfg.overflow_policy,
-        pallas_sort_split=cfg.pallas_sort_split,
-        pallas_slice_merge=cfg.pallas_slice_merge))
+    # the measured arm's engine + ring configs derive from one geometry
+    # (geometry-discipline); the comparator arms below intentionally run
+    # at their OWN single-config shapes
+    geom = EngineGeometry(capacity=cfg.capacity, batch_size=B,
+                          ring_depth=cfg.ring_depth or 8,
+                          ring_block=cfg.ring_block_size or B,
+                          pallas_sort_split=cfg.pallas_sort_split,
+                          pallas_slice_merge=cfg.pallas_slice_merge)
+    op = TpuWindowOperator(config=geom.engine_config(
+        EngineConfig(overflow_policy=cfg.overflow_policy)))
     for w in windows:
         op.add_window_assigner(w)
     op.add_aggregation(make_aggregation(agg_name))
     op.set_max_lateness(cfg.max_lateness)
     # obs passed explicitly: the ring/feed stamps must be live from the
     # first offered block (the operator's obs attaches post-warmup)
-    feed = LineRateFeed(op, ring=RingConfig(
-        depth=cfg.ring_depth or 8, block_size=cfg.ring_block_size or B),
-        obs=obs)
+    feed = LineRateFeed(op, ring=geom.ring_config(), obs=obs)
 
     delivered = []
     sink = TransactionalSink(deliver=lambda w, e, s: delivered.append(w),
@@ -3481,7 +3861,18 @@ def _run_config_cells(cfg, out_dir, echo, collect_metrics, obs_dir,
                               "drift_fired", "drift_transitions",
                               "drift_detect_lags", "drift_all_detected",
                               "drift_false_positives",
-                              "workload_overhead_pct_median"):
+                              "workload_overhead_pct_median",
+                              "served_health_ok", "served_drift_events",
+                              "autotune_phases", "autotune_decisions",
+                              "autotune_retunes", "autotune_retraces",
+                              "autotune_schedule",
+                              "adaptive_admitted", "static_admitted",
+                              "autotune_beats_all_statics",
+                              "stable_retunes", "stable_decisions",
+                              "autotune_overhead_pct_median",
+                              "degrade_transitions",
+                              "degrade_shed_tuples",
+                              "sla_ms", "sla_met"):
                     if hasattr(res, extra):
                         cell[extra] = getattr(res, extra)
                 rows.append(cell)

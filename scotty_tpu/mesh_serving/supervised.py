@@ -79,14 +79,24 @@ def run_supervised_mesh(make_service: Callable[[int], object],
                         churn: Optional[Dict[int, Sequence]] = None,
                         reshard_at: Optional[Dict[int, int]] = None,
                         initial_shards: Optional[int] = None,
-                        checkpoint_every: int = 2) -> List:
+                        checkpoint_every: int = 2,
+                        obs=None) -> List:
     """Drive ``make_service(n_shards)`` for ``n_intervals`` under
     supervision with transactional delivery (module docstring). Returns
     every item actually delivered downstream across all restarts — the
     consumer's exact view. Items are
     ``(interval, slot, gen, global_rows)`` per active slot per
     interval; the sink tags each ``(epoch, seq)`` and the loop audits
-    that no tag is ever delivered twice."""
+    that no tag is ever delivered twice.
+
+    ``obs`` (ISSUE 18 satellite) threads the sensor plane through the
+    mesh loop the way the single-device kafka/asyncio loops already do:
+    each interval ends in ``obs.flight_sync(watermark=...)``, which
+    samples the attached :class:`~scotty_tpu.obs.WorkloadMonitor` first
+    — so the ``workload_*`` fingerprint gauges, the drift counter the
+    ``/healthz`` drift check reads, and the flight ring all stay live
+    for a served mesh. Passing ``obs`` never changes delivered output.
+    """
     import jax
 
     churn = churn or {}
@@ -154,6 +164,13 @@ def run_supervised_mesh(make_service: Callable[[int], object],
                     for item in items:
                         sink.emit(item)
                     i += 1
+                    if obs is not None:
+                        # the mesh loop's drain point: workload monitor
+                        # sampled FIRST, then the flight ring — the
+                        # same contract as the connector run loops
+                        obs.flight_sync(
+                            watermark=float(i * getattr(
+                                svc, "wm_period_ms", 1)))
                     if i % checkpoint_every == 0 or i == n_intervals:
                         svc.check_overflow()
                         supervisor.commit_checkpoint(i, svc.save)

@@ -126,6 +126,24 @@ costmodel | trend`` are the offline faces):
                                interval-step residual in percent (gated
                                past the model's stated bound)
 =============================  ===========================================
+
+Actuation-plane contract (ISSUE 18 — :mod:`scotty_tpu.autotune`: the
+other half of ROADMAP item 4. Retune commits, itemized recompiles and
+the overload degradation ladder; all four names APPEARING gates the
+default ``obs diff`` — a certified number that retuned or shed
+mid-measure must not pass as clean):
+
+=============================  ===========================================
+``autotune_retunes``           counter: committed live retunes
+``autotune_retraces``          counter: retunes that compiled a
+                               genuinely-new geometry (a warm
+                               GeometryCache bucket costs zero)
+``degrade_active_rung``        gauge: the ladder's current rung (0 =
+                               none, 1 = late shed, 2 = sampled
+                               admission, 3 = backpressure)
+``degrade_shed_tuples``        counter: tuples the ladder refused
+                               (exact: offered = admitted + shed)
+=============================  ===========================================
 """
 
 from __future__ import annotations
@@ -325,6 +343,17 @@ RESILIENCE_RESTORE_SPAN = "resilience_restore"
 RESILIENCE_BACKOFF_SPAN = "resilience_backoff"
 RESILIENCE_GROW_SPAN = "resilience_grow"
 
+# actuation-plane contract (ISSUE 18 — scotty_tpu.autotune: retune
+# commits, itemized retraces, degradation rungs). Defined HERE like the
+# resilience names — the autotune package records via ``from .. import
+# obs`` and the diff gate / METRIC_HELP must share one spelling.
+AUTOTUNE_RETUNES = "autotune_retunes"
+AUTOTUNE_RETRACES = "autotune_retraces"
+DEGRADE_ACTIVE_RUNG = "degrade_active_rung"
+DEGRADE_SHED_TUPLES = "degrade_shed_tuples"
+# actuation spans
+AUTOTUNE_RETUNE_SPAN = "autotune_retune"
+
 #: Prometheus HELP text for the contract metrics (``/metrics`` serves it;
 #: :func:`.exporters.prometheus_text` escapes it per the exposition format)
 METRIC_HELP = {
@@ -464,6 +493,18 @@ METRIC_HELP = {
         "fingerprint: normalized key-load entropy (1 = uniform)",
     "workload_pallas_fallback_share":
         "fingerprint: pallas fallbacks / (dispatches + fallbacks)",
+    AUTOTUNE_RETUNES:
+        "committed live retunes (checkpoint-boundary geometry changes; "
+        "APPEARING gates the default obs diff)",
+    AUTOTUNE_RETRACES:
+        "retunes that compiled a genuinely-new geometry (warm "
+        "GeometryCache buckets cost zero; gated by the default obs diff)",
+    DEGRADE_ACTIVE_RUNG:
+        "degradation-ladder rung in force (0 none, 1 late shed, "
+        "2 sampled admission, 3 backpressure; gated by the obs diff)",
+    DEGRADE_SHED_TUPLES:
+        "tuples the degradation ladder refused (exact conservation: "
+        "offered = admitted + shed; gated by the default obs diff)",
 }
 
 
@@ -735,4 +776,6 @@ __all__ = [
     "RESILIENCE_STALL_EVENTS", "RESILIENCE_CHECKPOINT_SPAN",
     "RESILIENCE_RESTORE_SPAN", "RESILIENCE_BACKOFF_SPAN",
     "RESILIENCE_GROW_SPAN",
+    "AUTOTUNE_RETUNES", "AUTOTUNE_RETRACES", "AUTOTUNE_RETUNE_SPAN",
+    "DEGRADE_ACTIVE_RUNG", "DEGRADE_SHED_TUPLES",
 ]

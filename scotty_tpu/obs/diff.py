@@ -178,6 +178,16 @@ DEFAULT_THRESHOLDS = {
         "workload_drift_events": {"direction": "lower", "default": 0},
         "costmodel_residual_pct": {"direction": "lower", "default": 0,
                                    "abs_tol": 25.0},
+        # actuation-plane contract (ISSUE 18): a retune, a fresh
+        # compile, an active degradation rung or shed tuples APPEARING
+        # between two exports gate — a certified number measured while
+        # the engine was re-tuning itself or refusing load must not
+        # pass as clean. All lazily created ("default": 0 gates
+        # appearing).
+        "autotune_retunes": {"direction": "lower", "default": 0},
+        "autotune_retraces": {"direction": "lower", "default": 0},
+        "degrade_active_rung": {"direction": "lower", "default": 0},
+        "degrade_shed_tuples": {"direction": "lower", "default": 0},
     },
     "require_cells": True,
 }

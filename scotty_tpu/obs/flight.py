@@ -55,6 +55,11 @@ Event-kind vocabulary (plain interned strings; recorders pass these,
 ``fingerprint``  a workload audit window closed (value = audit index)
 ``workload_drift``  a confirmed per-feature drift excursion (name =
                 ``workload_drift_<feature>``, value = live reading)
+``autotune``    a controller decision/rejection or a retune-commit
+                milestone (name = ``decide:<cand>`` / ``begin`` /
+                ``warm`` / ``retrace`` / ``commit`` …)
+``degrade``     a degradation-ladder rung transition, edge-triggered
+                (name = ``enter:<rung>``/``exit:<rung>``, value = rung)
 ``crash``       generic fatal failure (``record_failure`` when no more
                 specific kind applies)
 ==============  ============================================================
@@ -175,6 +180,16 @@ LATENCY_STAGE = "latency_stage"
 # to the crash
 FINGERPRINT = "fingerprint"
 WORKLOAD_DRIFT = "workload_drift"
+# actuation-plane kinds (ISSUE 18 — scotty_tpu.autotune): every
+# controller decision AND rejection rides the autotune kind (name =
+# "propose:<cand>"/"hold:<cand>"/"decide:<cand>"/"cooldown"/
+# "no_admissible", plus the retune commit path's "begin"/"warm"/
+# "retrace"/"commit" milestones — each an instrumented crash site);
+# degrade records EDGE-TRIGGERED rung transitions only (name =
+# "enter:<rung>"/"exit:<rung>", value = the active rung) — a quiet
+# ladder writes nothing
+AUTOTUNE = "autotune"
+DEGRADE = "degrade"
 #: generic fatal failure recorded by ``record_failure`` when no more
 #: specific kind applies (the postmortem CLI's ``crash`` cause class)
 CRASH = "crash"

@@ -42,6 +42,7 @@ _WATERMARK_LAG_MS = "watermark_lag_ms"
 _STALL_EVENTS = "resilience_stall_events"
 _OVERFLOWS = "overflows"
 _DRIFT_EVENTS = "workload_drift_events"
+_DEGRADE_RUNG = "degrade_active_rung"
 
 
 class HealthPolicy:
@@ -67,6 +68,14 @@ class HealthPolicy:
     recovers — exactly the stall-watchdog shape). The check only
     appears in the verdict once the counter exists in the registry, so
     a run without a drift detector probes exactly as before.
+    ``degrade_unhealthy`` (ISSUE 18) — unhealthy while the
+    ``degrade_active_rung`` gauge is nonzero (the autotune degradation
+    ladder is refusing load; the verdict names the rung so a pager
+    knows whether the engine is shedding late strata, sampling, or
+    holding the source). Level-triggered on purpose — unlike drift, an
+    active rung IS the ongoing condition, and the verdict recovers the
+    moment the ladder steps back to rung 0. Appears only once the
+    gauge exists (a ladder was wired), like the drift check.
 
     ``verdict`` is also callable without a server (tests drive it
     directly) and is safe under concurrent probes (one policy-level lock
@@ -77,12 +86,14 @@ class HealthPolicy:
                  stall_unhealthy: bool = True,
                  overflow_unhealthy: bool = True,
                  max_first_emit_p99_ms: Optional[float] = None,
-                 drift_unhealthy: bool = True):
+                 drift_unhealthy: bool = True,
+                 degrade_unhealthy: bool = True):
         self.max_watermark_lag_ms = max_watermark_lag_ms
         self.stall_unhealthy = stall_unhealthy
         self.overflow_unhealthy = overflow_unhealthy
         self.max_first_emit_p99_ms = max_first_emit_p99_ms
         self.drift_unhealthy = drift_unhealthy
+        self.degrade_unhealthy = degrade_unhealthy
         self._lock = threading.Lock()
         self._last_stalls = 0.0
         self._last_drift = 0.0
@@ -98,6 +109,8 @@ class HealthPolicy:
                          if _OVERFLOWS in reg.counters else 0.0)
             drift = (reg.counters[_DRIFT_EVENTS].value
                      if _DRIFT_EVENTS in reg.counters else None)
+            rung = (reg.gauges[_DEGRADE_RUNG].value
+                    if _DEGRADE_RUNG in reg.gauges else None)
         checks = {}
         healthy = True
         if self.max_watermark_lag_ms is not None:
@@ -129,6 +142,13 @@ class HealthPolicy:
             checks["workload_drift"] = {
                 "ok": ok, "drift_events": drift,
                 "new_since_last_probe": new}
+            healthy = healthy and ok
+        if self.degrade_unhealthy and rung is not None:
+            # ladder runs only: the gauge exists once a
+            # DegradationLadder is wired, so a plain run probes
+            # unchanged; level-triggered — recovers at rung 0
+            ok = rung == 0
+            checks["degradation"] = {"ok": ok, "active_rung": rung}
             healthy = healthy and ok
         if self.max_first_emit_p99_ms is not None:
             tracer = getattr(obs, "latency", None)

@@ -14,6 +14,16 @@ throughput must not drop >10%, emit p99 must not rise >50%/2 ms, device
 emit must not rise >25%/1 ms), so a regression between rounds is
 flagged by policy, not eyeball. Exit 1 when any transition regressed,
 2 when no round artifact parsed.
+
+ISSUE 18 satellite: the walk now also versions the per-cell artifacts
+themselves. A checked-in ``result_<base>-r<nn>.json`` is the ``<base>``
+config's cells as recorded at round ``nn``; the unsuffixed
+``result_<base>.json`` is current. For every base with more than one
+version, matching cells (same name/windows/engine/aggregation) across
+consecutive versions are judged under the same ``obs diff`` specs and
+surfaced with regression flags — so superseding a recorded artifact
+with a slower one fails ``obs trend`` exactly like a bad round
+transition does (exit 1).
 """
 
 from __future__ import annotations
@@ -21,7 +31,8 @@ from __future__ import annotations
 import glob
 import json
 import os
-from typing import List, Optional
+import re
+from typing import Dict, List, Optional, Tuple
 
 from .diff import DEFAULT_THRESHOLDS, _check
 
@@ -37,7 +48,18 @@ _ROUND_FIELD_SPECS = {
 _CELL_FIELDS = ("tuples_per_sec", "first_emit_p99_ms",
                 "latency_overhead_pct_median", "flags_off_ab_pct_median",
                 "delivery_overhead_pct_median",
-                "workload_overhead_pct_median")
+                "workload_overhead_pct_median",
+                "autotune_overhead_pct_median")
+
+#: result_<base>[-r<nn>].json — <nn> versions the artifact; unsuffixed
+#: is current (sorts after every numbered version)
+_RESULT_VERSION_RE = re.compile(
+    r"^result_(?P<base>.+?)(?:-r(?P<nn>\d+))?\.json$")
+
+#: per-cell fields judged across artifact versions, each under its
+#: obs-diff threshold spec of the same name
+_CELL_SPEC_FIELDS = ("tuples_per_sec", "p99_emit_ms", "emit_ms_device",
+                     "first_emit_p99_ms")
 
 
 def load_round(path: str) -> Optional[dict]:
@@ -112,6 +134,75 @@ def current_cells(results_dir: str) -> List[dict]:
     return rows
 
 
+def _versioned_results(results_dir: str) -> Dict[str, List[Tuple]]:
+    """Group ``result_*.json`` by base config name. Values are
+    ``(version, label, path)`` sorted oldest -> current, where a
+    ``-r<nn>`` suffix is version ``nn`` and the unsuffixed artifact is
+    current (sorts last)."""
+    by_base: Dict[str, List[Tuple]] = {}
+    for path in glob.glob(os.path.join(results_dir, "result_*.json")):
+        m = _RESULT_VERSION_RE.match(os.path.basename(path))
+        if m is None:
+            continue
+        nn = m.group("nn")
+        version = (float("inf"), "current") if nn is None \
+            else (int(nn), f"r{int(nn):02d}")
+        by_base.setdefault(m.group("base"), []).append(
+            (version[0], version[1], path))
+    for versions in by_base.values():
+        versions.sort(key=lambda v: v[0])
+    return by_base
+
+
+def _cells_by_key(path: str) -> dict:
+    """One cell-list artifact keyed by (name, windows, engine,
+    aggregation); {} for note-shaped or unreadable artifacts."""
+    try:
+        with open(path) as f:
+            cells = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if not isinstance(cells, list):
+        return {}
+    out = {}
+    for cell in cells:
+        if not isinstance(cell, dict) or "error" in cell:
+            continue
+        out[tuple(str(cell.get(k, "")) for k in
+                  ("name", "windows", "engine", "aggregation"))] = cell
+    return out
+
+
+def cell_transitions(results_dir: str) -> List[dict]:
+    """Judge matching cells across consecutive artifact versions of the
+    same base config under the obs-diff specs (module docstring). Bool
+    and None field values — and cells absent on either side — are
+    skipped, the one-sided-metric rule again."""
+    specs = DEFAULT_THRESHOLDS["metrics"]
+    findings = []
+    for base, versions in sorted(_versioned_results(results_dir).items()):
+        if len(versions) < 2:
+            continue
+        for (_va, la, pa), (_vb, lb, pb) in zip(versions, versions[1:]):
+            prev, cur = _cells_by_key(pa), _cells_by_key(pb)
+            for key in sorted(prev.keys() & cur.keys()):
+                for fld in _CELL_SPEC_FIELDS:
+                    b, c = prev[key].get(fld), cur[key].get(fld)
+                    if not isinstance(b, (int, float)) \
+                            or not isinstance(c, (int, float)) \
+                            or isinstance(b, bool) or isinstance(c, bool):
+                        continue
+                    regressed, rel = _check(specs[fld], float(b),
+                                            float(c))
+                    findings.append({
+                        "config": base, "cell": " ".join(key),
+                        "transition": f"{la}->{lb}", "field": fld,
+                        "baseline": float(b), "candidate": float(c),
+                        "rel_change": rel,
+                        "status": "regressed" if regressed else "ok"})
+    return findings
+
+
 def build_trend(paths: Optional[List[str]] = None,
                 results_dir: Optional[str] = None) -> dict:
     if not paths:
@@ -122,6 +213,7 @@ def build_trend(paths: Optional[List[str]] = None,
     out = {"rounds": rounds, "transitions": round_transitions(rounds)}
     if results_dir:
         out["cells"] = current_cells(results_dir)
+        out["cell_transitions"] = cell_transitions(results_dir)
     return out
 
 
@@ -166,6 +258,19 @@ def render_trend(trend: dict) -> str:
                 f"{fld}={_fmt(row[fld])}" for fld in _CELL_FIELDS
                 if fld in row)
             lines.append(f"    {row['cell']:58s} {extras}")
+    ct = trend.get("cell_transitions")
+    if ct is not None:
+        regressed = [f for f in ct if f["status"] == "regressed"]
+        lines.append(f"  cell versions: {len(ct)} checks, "
+                     f"{len(regressed)} regression(s) under the obs "
+                     "diff thresholds")
+        for f in regressed:
+            chg = (f"{f['rel_change']:+.1%}"
+                   if f["rel_change"] != float("inf") else "inf")
+            lines.append(
+                f"    {f['config']} [{f['cell']}] {f['transition']} "
+                f"{f['field']}: {_fmt(f['baseline'])} -> "
+                f"{_fmt(f['candidate'])} ({chg}) REGRESSED")
     return "\n".join(lines)
 
 
@@ -186,9 +291,13 @@ def trend_main(paths: Optional[List[str]] = None,
         echo(json.dumps(trend, indent=1, default=float))
     else:
         echo(render_trend(trend))
-    return 1 if any(f["status"] == "regressed"
-                    for f in trend["transitions"]) else 0
+    regressed = any(f["status"] == "regressed"
+                    for f in trend["transitions"])
+    regressed = regressed or any(
+        f["status"] == "regressed"
+        for f in trend.get("cell_transitions", ()))
+    return 1 if regressed else 0
 
 
 __all__ = ["build_trend", "trend_main", "load_round",
-           "round_transitions", "current_cells"]
+           "round_transitions", "current_cells", "cell_transitions"]

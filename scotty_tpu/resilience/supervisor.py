@@ -95,6 +95,13 @@ class Supervisor:
         #: optional :class:`~scotty_tpu.delivery.sink.TransactionalSink`
         #: whose epoch ledger commits inside every checkpoint bundle
         self.sink = None
+        #: the committed :class:`~scotty_tpu.autotune.EngineGeometry`
+        #: (ISSUE 18): set by the first retune commit (or restored from
+        #: the sidecar), then re-written into EVERY later bundle so a
+        #: restart N checkpoints after a retune still rebuilds at the
+        #: retuned geometry — the PR 3 config-sidecar bug class, closed
+        #: for the full knob vector
+        self.geometry = None
         self._rng = np.random.default_rng(seed)
         self.restarts = 0          # consecutive failed recoveries
         self.total_restarts = 0    # lifetime (telemetry mirror)
@@ -277,14 +284,18 @@ class Supervisor:
             self._flight(_fl.CKPT_GC, os.path.basename(p))
 
     def _commit(self, pos: int, save_fn: Callable[[str], None],
-                offset: Optional[int] = None, config=None,
+                offset: Optional[int] = None, config=None, geometry=None,
                 flight_name: str = "offset") -> None:
         """The one commit path every mode uses (see the section comment
         for the atomicity story). ``flight_name`` keeps the per-mode
         flight vocabulary: pipeline-mode checkpoints progress by
-        "interval", everything else by "offset"."""
+        "interval", everything else by "offset"; retune commits pass
+        ``geometry`` (ISSUE 18) and the geometry sidecar then rides
+        every subsequent bundle."""
         from ..utils.checkpoint import finalize_checkpoint
 
+        if geometry is not None:
+            self.geometry = geometry
         with self._span(_obs.RESILIENCE_CHECKPOINT_SPAN):
             final = os.path.join(self.dir, f"ckpt-{pos}")
             tmp = final + ".tmp"
@@ -293,6 +304,8 @@ class Supervisor:
             save_fn(tmp)
             if config is not None:
                 self._save_config_sidecar(tmp, config)
+            if self.geometry is not None:
+                self._save_geometry_sidecar(tmp, self.geometry)
             if offset is not None:
                 fsio.write_bytes(os.path.join(tmp, "offset.json"),
                                  json.dumps({"offset": int(offset)})
@@ -340,6 +353,25 @@ class Supervisor:
 
         with open(path) as f:
             return EngineConfig(**json.load(f))
+
+    def _save_geometry_sidecar(self, path: str, geometry) -> None:
+        """The full retunable-knob vector rides the bundle (ISSUE 18):
+        the config sidecar above already carries the EngineConfig half,
+        but a retune also moves shaper/ring/chunk knobs — a restart
+        must resume at the COMMITTED geometry, not the factory's."""
+        fsio.write_bytes(os.path.join(path, "geometry.json"),
+                         json.dumps(geometry.to_dict()).encode())
+
+    def _load_geometry_sidecar(self, ckpt: Optional[str]):
+        if ckpt is None:
+            return None
+        path = os.path.join(ckpt, "geometry.json")
+        if not os.path.exists(path):
+            return None
+        from ..autotune.geometry import EngineGeometry
+
+        with open(path) as f:
+            return EngineGeometry.from_dict(json.load(f))
 
     # -- custom streaming loops (ISSUE 7: the soak harness) ----------------
     def commit_checkpoint(self, pos: int, save_fn: Callable[[str], None],
@@ -421,7 +453,10 @@ class Supervisor:
         from ..utils.checkpoint import restore_pipeline
 
         ckpt = self._verified_ckpt()
-        p = factory(config=self._load_config_sidecar(ckpt))
+        g = self._load_geometry_sidecar(ckpt)
+        if g is not None:
+            self.geometry = g      # later commits keep carrying it
+        p = self._build(factory, self._load_config_sidecar(ckpt), g)
         if self.obs is not None and hasattr(p, "set_observability"):
             p.set_observability(self.obs)
         if ckpt is not None:
@@ -430,6 +465,31 @@ class Supervisor:
                 restore_pipeline(p, ckpt, verify=False)
             self._flight("restore", os.path.basename(ckpt))
         return p
+
+    @staticmethod
+    def _build(factory: Callable, config, geometry):
+        """Construct through the factory, handing it the committed
+        geometry when its signature takes one (``factory(config=...,
+        geometry=...)``). A geometry-unaware factory still rebuilds at
+        the retuned ENGINE knobs via the config sidecar; the remaining
+        shape-neutral knob (chunk regroup) is re-applied directly."""
+        import inspect
+
+        built = None
+        if geometry is not None:
+            try:
+                accepts = "geometry" in inspect.signature(
+                    factory).parameters
+            except (TypeError, ValueError):
+                accepts = False
+            if accepts:
+                built = factory(config=config, geometry=geometry)
+        if built is None:
+            built = factory(config=config)
+            if geometry is not None and geometry.rows_per_chunk \
+                    and hasattr(built, "set_rows_per_chunk"):
+                built.set_rows_per_chunk(geometry.rows_per_chunk)
+        return built
 
     # -- operator + source mode --------------------------------------------
     def run_operator(self, make_operator: Callable, events: Sequence,
@@ -489,7 +549,10 @@ class Supervisor:
         from ..utils.checkpoint import restore_engine_operator
 
         ckpt = self._verified_ckpt()
-        op = make_operator(config=self._load_config_sidecar(ckpt))
+        g = self._load_geometry_sidecar(ckpt)
+        if g is not None:
+            self.geometry = g      # later commits keep carrying it
+        op = self._build(make_operator, self._load_config_sidecar(ckpt), g)
         offset = 0
         if ckpt is not None:
             with self._span(_obs.RESILIENCE_RESTORE_SPAN):

@@ -1,0 +1,26 @@
+"""Clean twin: the coupled knobs live in one EngineGeometry and the
+per-module configs are DERIVED — and a single config class with
+retunable kwargs passes (nothing to couple), as do constructions whose
+kwargs are all non-retunable (their source of truth stays per-module)."""
+
+from scotty_tpu.autotune import EngineGeometry
+from scotty_tpu.engine.config import EngineConfig
+from scotty_tpu.shaper import ShaperConfig
+
+
+def build_engine(capacity, batch):
+    geom = EngineGeometry(capacity=capacity, batch_size=batch,
+                          late_capacity=max(64, batch // 8))
+    return geom.engine_config(), geom.shaper_config()
+
+
+def build_single(capacity):
+    # one class alone: no coupling to drift
+    return EngineConfig(capacity=capacity, annex_capacity=8)
+
+
+def build_non_retunable():
+    # non-retunable kwargs never count, even across two classes
+    econf = EngineConfig(overflow_policy="grow", annex_capacity=16)
+    sconf = ShaperConfig(late_routing="combined")
+    return econf, sconf

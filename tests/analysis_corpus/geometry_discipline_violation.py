@@ -1,0 +1,20 @@
+"""Seeded violation: coupled retunable knobs co-constructed raw (the
+ISSUE 18 config-scatter shape) — the batch span is spelled twice and
+the ring block a third time, free to drift apart, and the resulting
+engine runs at a geometry no cache key or checkpoint sidecar names."""
+
+from scotty_tpu.engine.config import EngineConfig
+from scotty_tpu.ingest import RingConfig
+from scotty_tpu.shaper import ShaperConfig
+
+
+def build_engine(capacity, batch):
+    econf = EngineConfig(capacity=capacity, batch_size=batch)
+    sconf = ShaperConfig(late_capacity=max(64, batch // 8))
+    return econf, sconf
+
+
+def build_feed(batch, depth):
+    ring = RingConfig(depth=depth, block_size=batch)
+    econf = EngineConfig(batch_size=batch, micro_batch=4)
+    return ring, econf

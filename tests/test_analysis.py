@@ -34,6 +34,8 @@ PAIRS = {
                     "flight_kind_clean.py", 4),
     "silent-drop": ("silent_drop_violation.py",
                     "silent_drop_clean.py", 2),
+    "geometry-discipline": ("geometry_discipline_violation.py",
+                            "geometry_discipline_clean.py", 4),
 }
 
 

@@ -1,0 +1,497 @@
+"""ISSUE 18: the actuation plane. EngineGeometry is one frozen
+serializable knob vector (sidecar-committable, cache-keyable);
+``apply_geometry`` retunes a LIVE pipeline as a checkpoint-boundary
+operation whose twin guarantee — a retuned run bit-matches the
+never-retuned oracle — holds across shape-neutral deltas, batch-span
+moves in BOTH directions, and capacity growth; the GeometryController
+decides retunes with confirm-hysteresis + cooldown and is provably
+silent in steady state; the DegradationLadder sheds overload in counted
+deterministic rungs with exact conservation, surfaced through /healthz
+and the flight recorder."""
+
+import json
+
+import numpy as np
+import pytest
+
+from scotty_tpu import SumAggregation, TumblingWindow, WindowMeasure
+from scotty_tpu import obs as _obs
+from scotty_tpu.autotune import (
+    RUNG_BACKPRESSURE,
+    RUNG_NAMES,
+    RUNG_NONE,
+    ControllerPolicy,
+    DegradationLadder,
+    EngineGeometry,
+    GeometryController,
+    GeometryError,
+    apply_geometry,
+    apply_geometry_operator,
+    run_retuned_pipeline,
+)
+from scotty_tpu.autotune.geometry import SHAPE_AFFECTING
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.operator import TpuWindowOperator
+from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+from scotty_tpu.ingest import RingConfig
+from scotty_tpu.obs.server import HealthPolicy
+from scotty_tpu.resilience import ELEMENTS, WATERMARK, ManualClock, Supervisor
+from scotty_tpu.serving.cache import GeometryCache
+from scotty_tpu.shaper import ShaperConfig
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=1 << 12, batch_size=256, annex_capacity=256,
+                   min_trigger_pad=32)
+
+
+def pipeline_factory(config=None):
+    return AlignedStreamPipeline(
+        [TumblingWindow(Time, 50)], [SumAggregation()],
+        config=config or CFG, throughput=20_000, wm_period_ms=100,
+        max_lateness=100, seed=5, gc_every=10 ** 9, value_scale=1024.0)
+
+
+def _autotune_events(obs):
+    return [e["name"] for e in obs.flight.events()
+            if e["kind"] == "autotune"]
+
+
+# -- the geometry value ------------------------------------------------------
+
+def test_geometry_defaults_mirror_module_configs():
+    assert EngineGeometry.from_configs(
+        engine=EngineConfig(), shaper=ShaperConfig(),
+        ring=RingConfig()) == EngineGeometry()
+
+
+def test_geometry_serde_roundtrip():
+    g = EngineGeometry(capacity=1 << 13, batch_size=512,
+                       min_trigger_pad=64, micro_batch=4,
+                       rows_per_chunk=128, wm_period_ms=100,
+                       ring_depth=4, ring_block=256, slack_ms=50,
+                       late_capacity=128, pallas_sort_split=True)
+    assert EngineGeometry.from_dict(
+        json.loads(json.dumps(g.to_dict()))) == g
+
+
+def test_geometry_sidecar_rejects_unknown_and_non_dict():
+    with pytest.raises(GeometryError, match="unknown knobs"):
+        EngineGeometry.from_dict({"batch_size": 64, "warp_speed": 9})
+    with pytest.raises(GeometryError, match="JSON object"):
+        EngineGeometry.from_dict([1, 2, 3])
+
+
+def test_geometry_validation():
+    with pytest.raises(GeometryError):
+        EngineGeometry(capacity=0)
+    with pytest.raises(GeometryError):
+        EngineGeometry(ring_depth=1)
+    with pytest.raises(GeometryError):
+        EngineGeometry(late_capacity=-1)
+    assert issubclass(GeometryError, ValueError)
+
+
+def test_geometry_derivation_preserves_non_retunable_fields():
+    g = EngineGeometry(capacity=1 << 13, batch_size=512, micro_batch=2,
+                       slack_ms=40, late_capacity=96, ring_depth=4,
+                       ring_block=512)
+    e = g.engine_config(EngineConfig(overflow_policy="grow",
+                                     annex_capacity=64))
+    assert (e.capacity, e.batch_size, e.micro_batch) == (1 << 13, 512, 2)
+    assert e.overflow_policy == "grow" and e.annex_capacity == 64
+    s = g.shaper_config(ShaperConfig(late_routing="combined"))
+    assert (s.slack_ms, s.late_capacity) == (40, 96)
+    assert s.late_routing == "combined"
+    r = g.ring_config()
+    assert (r.depth, r.block_size) == (4, 512)
+    # 0 means "module default": block stays batch-derived (None)
+    assert g.replace(ring_block=0).ring_config().block_size is None
+
+
+def test_shape_delta_separates_transplant_from_bit_exact():
+    g = EngineGeometry()
+    grown = g.replace(batch_size=g.batch_size * 2, micro_batch=4)
+    assert grown.shape_delta(g) == frozenset({"batch_size"})
+    assert grown.delta(g) == frozenset({"batch_size", "micro_batch"})
+    assert "micro_batch" not in SHAPE_AFFECTING
+    assert g.shape_delta(g) == frozenset()
+
+
+# -- live retune twins (the tentpole guarantee) ------------------------------
+
+def _oracle_rows(n):
+    ref = pipeline_factory()
+    return [ref.lowered_results(o) for o in ref.run(n)]
+
+
+def _sup(tmp_path, obs=None, name="ck"):
+    return Supervisor(str(tmp_path / name), clock=ManualClock(), obs=obs,
+                      checkpoint_every=2, max_restarts=2, seed=9)
+
+
+def test_retune_twin_shape_neutral_delta(tmp_path):
+    """A shaper-knob delta (shape_delta empty) restores bit-exactly —
+    and still goes through the full drain → commit → rebuild → restore
+    path (counted as one retune, one retrace)."""
+    obs = _obs.Observability(flight=_obs.FlightRecorder())
+    sup = _sup(tmp_path, obs)
+    base = EngineGeometry.from_pipeline(pipeline_factory())
+    rows = run_retuned_pipeline(
+        pipeline_factory, 6, sup,
+        schedule={2: base.replace(late_capacity=128)})
+    assert rows == _oracle_rows(6)
+    snap = obs.registry.snapshot()
+    assert snap["autotune_retunes"] == 1
+    assert snap["autotune_retraces"] == 1
+    names = _autotune_events(obs)
+    assert "begin" in names and "retrace" in names and "commit" in names
+
+
+def test_retune_twin_batch_span_both_directions(tmp_path):
+    """The adaptive bench arm's moves: grow the batch span, then shrink
+    it back down — the retuned run must bit-match the never-retuned
+    oracle through BOTH transplants."""
+    base = EngineGeometry.from_pipeline(pipeline_factory())
+    sup = _sup(tmp_path)
+    rows = run_retuned_pipeline(
+        pipeline_factory, 8, sup,
+        schedule={2: base.replace(batch_size=8192, late_capacity=32),
+                  4: base.replace(batch_size=1024, late_capacity=256)})
+    assert rows == _oracle_rows(8)
+
+
+def test_retune_twin_capacity_growth(tmp_path):
+    base = EngineGeometry.from_pipeline(pipeline_factory())
+    sup = _sup(tmp_path)
+    rows = run_retuned_pipeline(
+        pipeline_factory, 6, sup,
+        schedule={2: base.replace(capacity=1 << 13)})
+    assert rows == _oracle_rows(6)
+
+
+def test_retune_shrink_capacity_raises_before_committing(tmp_path):
+    p = pipeline_factory()
+    p.reset()
+    p.run(2)
+    sup = _sup(tmp_path)
+    base = EngineGeometry.from_pipeline(p)
+    with pytest.raises(GeometryError, match="shrink"):
+        apply_geometry(p, base.replace(capacity=base.capacity // 2),
+                       factory=pipeline_factory, supervisor=sup, pos=2)
+    assert sup._verified_ckpt() is None      # nothing was committed
+
+
+def test_retune_equal_geometry_is_identity(tmp_path):
+    p = pipeline_factory()
+    p.reset()
+    p.run(1)
+    sup = _sup(tmp_path)
+    assert apply_geometry(p, EngineGeometry.from_pipeline(p),
+                          factory=pipeline_factory, supervisor=sup,
+                          pos=1) is p
+
+
+def test_retune_warm_cache_skips_recompile(tmp_path):
+    """Returning to an already-seen geometry is a warm bucket: the
+    GeometryCache hands back the old step, the retrace counter does NOT
+    advance, and the twin guarantee still holds."""
+    obs = _obs.Observability(flight=_obs.FlightRecorder())
+    sup = _sup(tmp_path, obs)
+    base = EngineGeometry.from_pipeline(pipeline_factory())
+    big = base.replace(batch_size=2048)
+    cache = GeometryCache()
+    rows = run_retuned_pipeline(
+        pipeline_factory, 8, sup, cache=cache,
+        schedule={2: big, 4: base})    # out and BACK to the start
+    assert rows == _oracle_rows(8)
+    snap = obs.registry.snapshot()
+    assert snap["autotune_retunes"] == 2
+    assert snap["autotune_retraces"] == 1    # the return was warm
+    names = _autotune_events(obs)
+    assert "warm" in names and names.count("retrace") == 1
+    assert cache.hits >= 1
+
+
+def test_retuned_pipeline_without_schedule_matches_plain_run(tmp_path):
+    sup = _sup(tmp_path)
+    assert run_retuned_pipeline(pipeline_factory, 4, sup) \
+        == _oracle_rows(4)
+
+
+# -- operator retune ---------------------------------------------------------
+
+def _make_operator(config=None):
+    op = TpuWindowOperator(config=config or EngineConfig(
+        capacity=1 << 10, batch_size=64, annex_capacity=32,
+        min_trigger_pad=32))
+    op.add_window_assigner(TumblingWindow(Time, 100))
+    op.add_aggregation(SumAggregation())
+    op.set_max_lateness(500)
+    return op
+
+
+def _int_batches(n_batches=6, per=40):
+    rng = np.random.default_rng(7)
+    out = []
+    for b in range(n_batches):
+        vals = rng.integers(0, 100, size=per).astype(np.float64)
+        ts = np.sort(rng.integers(b * 200, (b + 1) * 200, size=per))
+        out.append((vals, ts))
+    return out
+
+
+def _drive(op, batches, retune_at=None, **retune_kw):
+    rows = []
+    for i, (vals, ts) in enumerate(batches):
+        op.process_elements(vals, ts)
+        rows.extend(str(w) for w in op.process_watermark(int(ts[-1])))
+        if retune_at is not None and i == retune_at:
+            op = apply_geometry_operator(op, pos=i + 1, **retune_kw)
+    rows.extend(str(w) for w in op.process_watermark(10_000))
+    return rows
+
+
+def test_operator_retune_twin_launch_knob_delta(tmp_path):
+    """A capacity-preserving launch-knob delta (batch span) on the
+    batch-at-a-time operator: old state, new geometry, output identical
+    to the never-retuned oracle (integer values keep float sums exact
+    across the different launch batching)."""
+    batches = _int_batches()
+    op = _make_operator()
+    base = EngineGeometry.from_operator(op)
+    target = base.replace(batch_size=128)
+
+    def build(geometry):
+        return _make_operator(config=geometry.engine_config(op.config))
+
+    sup = _sup(tmp_path)
+    rows = _drive(op, batches, retune_at=2, geometry=target, build=build,
+                  supervisor=sup)
+    assert rows == _drive(_make_operator(), batches)
+    # the committed bundle carries the NEW geometry sidecar
+    assert sup.geometry == target
+
+
+def test_operator_retune_capacity_change_raises(tmp_path):
+    op = _make_operator()
+    base = EngineGeometry.from_operator(op)
+    with pytest.raises(GeometryError, match="capacity"):
+        apply_geometry_operator(
+            op, base.replace(capacity=base.capacity * 2),
+            build=lambda g: _make_operator(), supervisor=_sup(tmp_path),
+            pos=0)
+
+
+# -- the controller ----------------------------------------------------------
+
+G_A = EngineGeometry(batch_size=1024)
+G_B = EngineGeometry(batch_size=8192)
+G_C = EngineGeometry(batch_size=2048)
+
+
+def _ctrl(admission, policy=None, candidates=None, current="a"):
+    return GeometryController(
+        candidates or {"a": G_A, "b": G_B}, admission, current=current,
+        policy=policy or ControllerPolicy(confirm=2, cooldown=2,
+                                          drift_window=3))
+
+
+def test_controller_validates_candidates_and_policy():
+    with pytest.raises(GeometryError, match="empty"):
+        GeometryController({}, lambda g, f: 1.0, current="a")
+    with pytest.raises(GeometryError, match="not in candidate set"):
+        GeometryController({"a": G_A}, lambda g, f: 1.0, current="z")
+    with pytest.raises(GeometryError, match="confirm"):
+        ControllerPolicy(confirm=0)
+
+
+def test_controller_steady_state_is_silent():
+    """Zero steady-state retunes, zero flight noise: with the current
+    geometry admissible and no drift, every audit returns None and
+    writes NOTHING — even when another candidate has more headroom."""
+    obs = _obs.Observability(flight=_obs.FlightRecorder())
+    ctrl = _ctrl(lambda g, f: float(g.batch_size))   # b always "better"
+    for _ in range(50):
+        assert ctrl.observe({"arrival_rate_per_s": 10.0},
+                            obs=obs) is None
+    assert ctrl.decisions == 0 and ctrl.current == "a"
+    assert _autotune_events(obs) == []
+
+
+def test_controller_confirm_hysteresis_and_blip_expiry():
+    inadmissible = {"flip": True}
+
+    def admission(g, f):
+        if g is G_A:
+            return -1.0 if f["flip"] else 5.0
+        return 10.0
+
+    ctrl = _ctrl(admission)
+    obs = _obs.Observability(flight=_obs.FlightRecorder())
+    # audit 1: current inadmissible -> propose b, but do NOT decide yet
+    assert ctrl.observe(inadmissible, obs=obs) is None
+    # the blip ends: pending expires without a decision
+    assert ctrl.observe({"flip": False}, obs=obs) is None
+    assert ctrl.decisions == 0
+    # a sustained excursion: propose then confirm on the 2nd audit
+    assert ctrl.observe(inadmissible, obs=obs) is None
+    assert ctrl.observe(inadmissible, obs=obs) == G_B
+    assert ctrl.decisions == 1 and ctrl.current == "b"
+    names = _autotune_events(obs)
+    assert names.count("propose:b") == 2 and names[-1] == "decide:b"
+
+
+def test_controller_cooldown_sits_out_after_deciding():
+    def admission(g, f):
+        return -1.0 if g is ctrl.candidates[ctrl.current] else 10.0
+
+    ctrl = _ctrl(lambda g, f: -1.0 if g is G_A else 10.0)
+    obs = _obs.Observability(flight=_obs.FlightRecorder())
+    ctrl.observe({}, obs=obs)
+    assert ctrl.observe({}, obs=obs) == G_B
+    # now b is current; make IT inadmissible — cooldown still wins
+    ctrl.admission = lambda g, f: -1.0 if g is G_B else 10.0
+    for _ in range(2):                       # policy.cooldown audits
+        assert ctrl.observe({}, drifted=True, obs=obs) is None
+    names = _autotune_events(obs)
+    assert names.count("cooldown") == 2
+    # cooldown over: the excursion is re-considered from scratch
+    ctrl.observe({}, obs=obs)
+    assert ctrl.observe({}, obs=obs) == G_A
+
+
+def test_controller_saturated_cues_the_ladder():
+    ctrl = _ctrl(lambda g, f: -5.0)
+    obs = _obs.Observability(flight=_obs.FlightRecorder())
+    assert ctrl.observe({}, drifted=True, obs=obs) is None
+    assert ctrl.saturated is True
+    assert "no_admissible" in _autotune_events(obs)
+    ctrl.admission = lambda g, f: 5.0
+    ctrl.observe({})
+    assert ctrl.saturated is False
+
+
+def test_controller_tiebreak_is_candidate_order():
+    """Equal headroom resolves by insertion order, deterministically."""
+    cands = {"a": G_A, "b": G_B, "c": G_C}
+    ctrl = _ctrl(lambda g, f: -1.0 if g is G_A else 7.0,
+                 candidates=cands,
+                 policy=ControllerPolicy(confirm=1, cooldown=0))
+    assert ctrl.observe({}) == G_B           # b before c, every time
+
+
+def test_controller_drift_window_considers_moves_while_admissible():
+    """A drift event opens the consideration window even when the
+    current geometry still admits the load (the excursion may have a
+    better home); the window closes after policy.drift_window audits."""
+    ctrl = _ctrl(lambda g, f: 1.0 if g is G_A else 10.0)
+    assert ctrl.observe({}, drifted=True) is None       # propose b
+    assert ctrl.observe({}) == G_B                      # confirm
+    assert ctrl.decisions == 1
+
+
+# -- the degradation ladder --------------------------------------------------
+
+def test_ladder_validation():
+    with pytest.raises(GeometryError):
+        DegradationLadder(sample_mod=1)
+    with pytest.raises(GeometryError):
+        DegradationLadder(relax_after=0)
+    assert RUNG_NAMES[RUNG_NONE] == "none"
+    assert RUNG_NAMES[RUNG_BACKPRESSURE] == "backpressure"
+
+
+def test_ladder_escalates_relaxes_and_conserves():
+    lad = DegradationLadder(sample_mod=4, relax_after=2)
+    ts = np.arange(100, dtype=np.int64)
+    for expect in (1, 2, 3, 3):              # capped at backpressure
+        lad.admit(ts, watermark=50)
+        assert lad.conserved
+        assert lad.audit(budget=10) == expect
+    assert lad.backpressure
+    for expect in (3, 2, 2, 1, 1, 0):        # one rung per relax_after
+        lad.admit(ts[:5], watermark=0)
+        assert lad.audit(budget=1000) == expect
+    assert lad.rung == RUNG_NONE and lad.conserved
+    assert lad.offered == lad.admitted + lad.shed
+
+
+def test_ladder_rung1_sheds_exactly_the_late_stratum():
+    lad = DegradationLadder()
+    lad.admit(np.arange(10), watermark=0)
+    lad.audit(budget=1)                      # -> rung 1
+    ts = np.array([5, 40, 39, 41, 100])
+    keep = lad.admit(ts, watermark=40)
+    assert np.array_equal(keep, ts >= 40)
+
+
+def test_ladder_sampled_admission_is_global_position_deterministic():
+    """Rung-2 survivors depend on GLOBAL offered position, so an oracle
+    replay of the same offered stream — regardless of how it is split
+    into batches — reproduces the survivor set bit-exactly."""
+
+    def escalate(lad):
+        for _ in range(2):
+            lad.admit(np.arange(8), watermark=100)
+            lad.audit(budget=1)
+        assert lad.rung == 2
+
+    ts = np.arange(1000, 1097)               # 97 on-time tuples
+    a = DegradationLadder(sample_mod=4)
+    escalate(a)
+    keep_a = a.admit(ts, watermark=1000)
+    b = DegradationLadder(sample_mod=4)
+    escalate(b)
+    parts = [b.admit(ts[:30], watermark=1000),
+             b.admit(ts[30:70], watermark=1000),
+             b.admit(ts[70:], watermark=1000)]
+    assert np.array_equal(keep_a, np.concatenate(parts))
+    assert a.shed == b.shed and a.conserved and b.conserved
+
+
+def test_ladder_flight_edges_and_healthz_rung(tmp_path):
+    """Transitions are edge-triggered in the flight ring; the rung gauge
+    opts /healthz into the ``degradation`` check, which goes unhealthy
+    while any rung is active and recovers fully at rung 0."""
+    obs = _obs.Observability(flight=_obs.FlightRecorder())
+    lad = DegradationLadder(sample_mod=4, relax_after=1, obs=obs)
+    policy = HealthPolicy()
+    assert policy.verdict(obs)["checks"]["degradation"]["ok"]
+    lad.admit(np.arange(50), watermark=25)
+    lad.audit(budget=10)
+    v = policy.verdict(obs)
+    assert not v["healthy"]
+    assert v["checks"]["degradation"] == {"ok": False, "active_rung": 1.0}
+    lad.admit(np.arange(3), watermark=10)    # rung 1: all three are late
+    lad.audit(budget=1000)                   # relax back to rung 0
+    v = policy.verdict(obs)
+    assert v["checks"]["degradation"]["ok"] and v["healthy"]
+    degrade = [e["name"] for e in obs.flight.events()
+               if e["kind"] == "degrade"]
+    assert degrade == ["enter:1", "exit:1"]  # edges only, no level spam
+    assert obs.counter(_obs.DEGRADE_SHED_TUPLES).value == lad.shed > 0
+
+
+# -- restart after a committed retune (satellite: supervisor sidecar) --------
+
+def test_restart_after_committed_retune_restores_retuned_geometry(
+        tmp_path):
+    """The PR 3 config-sidecar discipline, extended to the full knob
+    vector: a supervisor that restarts AFTER a committed retune must
+    rebuild at the RETUNED geometry (from the geometry.json sidecar),
+    not the factory's, and later commits keep carrying it."""
+    base = EngineGeometry.from_pipeline(pipeline_factory())
+    target = base.replace(batch_size=1024, late_capacity=64)
+    sup = _sup(tmp_path)
+    run_retuned_pipeline(pipeline_factory, 4, sup, schedule={2: target})
+
+    sup2 = _sup(tmp_path)                    # a fresh process, same dir
+    p2 = sup2._pipeline_start(pipeline_factory)
+    assert EngineGeometry.from_pipeline(p2) \
+        .replace(late_capacity=target.late_capacity) == target
+    assert p2.config.batch_size == 1024
+    assert sup2.geometry == target
+    # the restored pipeline continues bit-identically to the oracle
+    assert int(p2._interval) == 4
+    rows = [p2.lowered_results(o) for o in p2.run(2)]
+    assert rows == _oracle_rows(6)[4:]

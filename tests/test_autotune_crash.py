@@ -1,0 +1,294 @@
+"""Crash certification for the actuation plane (ISSUE 18 tentpole part
+2 + satellite): the PR 8 crash-point-sweep discipline applied to the
+FULL retune commit path — every flight-event emit point (including the
+``autotune`` begin/retrace/commit events themselves) and every fsio
+write/fsync/replace inside the retune's checkpoint bundle (state npz,
+config sidecar, the NEW ``geometry.json`` sidecar, delivery ledger,
+manifest, pointer) with torn/short/ENOSPC variants — armed one at a
+time in a fresh environment, recovered under the Supervisor, and
+required to deliver output bit-identical to the uninterrupted oracle
+through an EXACTLY_ONCE sink whose collect hook raises on any repeated
+``(interval, row)`` tag.
+
+Plus the chaos soak: repeated injected crashes straddling BOTH retune
+boundaries on a ManualClock with the degradation ladder live, and the
+mesh-serving twin — threading the sensor plane (obs + WorkloadMonitor)
+through ``run_supervised_mesh`` never changes delivered output."""
+
+import os
+
+import numpy as np
+
+from scotty_tpu import (SlidingWindow, SumAggregation, TumblingWindow,
+                        WindowMeasure)
+from scotty_tpu import obs as _obs
+from scotty_tpu.autotune import (DegradationLadder, EngineGeometry,
+                                 RUNG_BACKPRESSURE, RUNG_NONE,
+                                 run_retuned_pipeline)
+from scotty_tpu.delivery import EXACTLY_ONCE, TransactionalSink
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.engine.pipeline import AlignedStreamPipeline
+from scotty_tpu.mesh_serving import MeshQueryService, run_supervised_mesh
+from scotty_tpu.obs.server import HealthPolicy
+from scotty_tpu.resilience import ChaosError, ManualClock, Supervisor
+from scotty_tpu.resilience.chaos import CrashPlan, crash_point_sweep
+from scotty_tpu.serving import QueryAdmission
+
+Time = WindowMeasure.Time
+CFG = EngineConfig(capacity=1 << 12, batch_size=256, annex_capacity=256,
+                   min_trigger_pad=32)
+
+
+def pipeline_factory(config=None):
+    return AlignedStreamPipeline(
+        [TumblingWindow(Time, 50)], [SumAggregation()],
+        config=config or CFG, throughput=20_000, wm_period_ms=100,
+        max_lateness=100, seed=5, gc_every=10 ** 9, value_scale=1024.0)
+
+
+#: the retune under test: a batch-span move PLUS a shape-neutral shaper
+#: knob — the delta class that exercises retrace, transplant padding and
+#: the full geometry sidecar (not just the EngineConfig half)
+_BASE = EngineGeometry.from_pipeline(pipeline_factory())
+_BIG = _BASE.replace(batch_size=512, late_capacity=512)
+_SMALL = _BASE.replace(batch_size=128)
+
+
+def _fresh_dir(tmp_path, counter=[0]):
+    counter[0] += 1
+    d = os.path.join(str(tmp_path), f"env{counter[0]}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _retune_env_factory(tmp_path, schedule, n_intervals):
+    """make_env for the sweep: a supervised aligned pipeline whose
+    checkpoint at the scheduled boundaries IS a live retune commit, with
+    an exactly-once sink; run() returns the delivered-item stream (the
+    downstream consumer's exact view), and the collect hook fails the
+    armed run itself on any duplicated (interval, row) tag."""
+
+    def make_env():
+        d = _fresh_dir(tmp_path)
+        obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=2048))
+
+        def run():
+            sup = Supervisor(os.path.join(d, "ck"), clock=ManualClock(),
+                             obs=obs, checkpoint_every=2, max_restarts=8,
+                             seed=3)
+            sup.sink = TransactionalSink(mode=EXACTLY_ONCE, obs=obs)
+            seen = set()
+            delivered = []
+
+            def collect(item):
+                tag = (item[0], item[1])
+                if tag in seen:
+                    raise AssertionError(
+                        f"duplicate delivery tag {tag}: exactly-once "
+                        f"broken across the retune commit")
+                seen.add(tag)
+                delivered.append(item)
+
+            run_retuned_pipeline(pipeline_factory, n_intervals, sup,
+                                 schedule=dict(schedule),
+                                 collect=collect)
+            return delivered
+
+        return obs, run
+
+    return make_env
+
+
+def _assert_green(report, min_sites=1):
+    assert report.sites >= min_sites
+    assert report.fired == report.ran       # every armed site was reached
+    assert report.oracle_len > 0
+    assert report.failures == [], (
+        f"{len(report.failures)} of {report.ran} crash sites broke the "
+        f"retune commit's exactly-once twin — first: {report.failures[0]}")
+
+
+# -- site enumeration sanity -------------------------------------------------
+
+def test_enumeration_covers_retune_commit_sites(tmp_path):
+    """The site list spans the whole retune story: the autotune
+    begin/retrace/commit flight events are themselves armable crash
+    sites, and the committed bundle's NEW geometry.json sidecar is an
+    fsio site with fault variants — alongside the ledger and the seal."""
+    make_env = _retune_env_factory(tmp_path, {2: _BIG}, n_intervals=4)
+    obs, run = make_env()
+    sites = CrashPlan().record(obs, run)
+    assert len(sites) >= 40
+    flight = [s for s in sites if s.domain == "flight"]
+    autotune = {s.name for s in flight if s.kind == "autotune"}
+    assert {"begin", "retrace", "commit"} <= autotune
+    fs_names = {s.name for s in sites if s.domain == "fs"}
+    assert "geometry.json" in fs_names       # the knob vector is a site
+    assert "ledger.json" in fs_names
+    assert "MANIFEST.json" in fs_names
+    geo = [s for s in sites if s.domain == "fs"
+           and s.name == "geometry.json"]
+    assert {s.fault for s in geo if s.kind == "write"} \
+        == {"crash", "torn", "short", "enospc"}
+
+
+# -- the sweeps --------------------------------------------------------------
+
+def test_retune_commit_path_every_site_exactly_once(tmp_path):
+    """The headline certification: crash at EVERY enumerated site of a
+    run whose interval-2 checkpoint is a live batch-span retune —
+    recovery must neither lose, double, nor half-apply the retune at any
+    of them (crash before the seal replays and re-applies; crash after
+    resumes past it at the committed geometry)."""
+    report = crash_point_sweep(
+        _retune_env_factory(tmp_path, {2: _BIG}, n_intervals=4))
+    _assert_green(report, min_sites=40)
+
+
+def test_two_retune_schedule_sampled_sites(tmp_path):
+    """Sampled sweep over a DOUBLE retune (span up at 2, back down at
+    4): sites in the second retune's commit arm against a pipeline that
+    is itself the product of a retune — the stacked-retune path."""
+    report = crash_point_sweep(
+        _retune_env_factory(tmp_path, {2: _BIG, 4: _SMALL},
+                            n_intervals=6),
+        sample_every=5)
+    _assert_green(report, min_sites=60)
+
+
+# -- chaos soak --------------------------------------------------------------
+
+def test_chaos_soak_crashes_straddling_retunes(tmp_path):
+    """Injected crashes at positions 1, 3 and 5 straddle both scheduled
+    retunes (at 2 and 4): before the first, between the two, after the
+    second. Every restart restores at the committed geometry, the run
+    bit-matches the never-crashed plain-pipeline oracle, delivery stays
+    exactly-once, and the supervisor ends at the final geometry."""
+    obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=2048))
+    sup = Supervisor(os.path.join(str(tmp_path), "ck"),
+                     clock=ManualClock(), obs=obs, checkpoint_every=2,
+                     max_restarts=8, seed=3)
+    sup.sink = TransactionalSink(mode=EXACTLY_ONCE, obs=obs)
+    crash_at = {1, 3, 5}
+    fired = []
+
+    def fault(pos):
+        if pos in crash_at:
+            crash_at.remove(pos)
+            fired.append(pos)
+            raise ChaosError(f"chaos @ {pos}")
+
+    seen = set()
+
+    def collect(item):
+        tag = (item[0], item[1])
+        assert tag not in seen, f"duplicate delivery {tag}"
+        seen.add(tag)
+
+    rows = run_retuned_pipeline(pipeline_factory, 6, sup,
+                                schedule={2: _BIG, 4: _SMALL},
+                                fault=fault, collect=collect)
+    ref = pipeline_factory()
+    assert rows == [ref.lowered_results(o) for o in ref.run(6)]
+    assert fired == [1, 3, 5]
+    assert sup.geometry == _SMALL
+    assert len(seen) == sum(len(r) for r in rows)
+    snap = obs.registry.snapshot()
+    assert snap["autotune_retunes"] == 2
+    # each crash replays the uncommitted tail; those re-emissions are
+    # exactly the duplicates the sink must swallow, not deliver
+    assert snap["delivery_duplicates_suppressed"] > 0
+
+
+def test_ladder_soak_survivors_replay_bit_exact(tmp_path):
+    """Chaos soak for the shedding side: a seeded 48-step offered-load
+    storm (rate spike + lateness burst) drives the ladder through every
+    rung up to backpressure and back to rung 0. The kept-survivor masks
+    must replay bit-identically through a fresh ladder fed the same
+    stream, conservation must hold exactly at every step, and /healthz
+    must go unhealthy while a rung is active and recover at rung 0."""
+    rng = np.random.default_rng(7)
+    steps = []
+    for s in range(48):
+        rate = 2000 if 16 <= s < 32 else 200
+        late_frac = 0.5 if 24 <= s < 36 else 0.05
+        n = rng.poisson(rate)
+        ts = np.sort(rng.integers(0, 1000, size=n)) + s * 1000
+        late = rng.random(n) < late_frac
+        ts = np.where(late, ts - 1500, ts)
+        steps.append(ts)
+
+    def drive(obs=None):
+        lad = DegradationLadder(sample_mod=4, relax_after=3, obs=obs)
+        policy = HealthPolicy()
+        masks, rungs = [], []
+        saw_unhealthy = False
+        for s, ts in enumerate(steps):
+            keep = lad.admit(ts, watermark=s * 1000)
+            assert lad.conserved, f"offered != admitted + shed at {s}"
+            masks.append(np.asarray(keep).copy())
+            lad.audit(budget=400.0)
+            rungs.append(lad.rung)
+            if obs is not None and lad.rung > RUNG_NONE:
+                v = policy.verdict(obs)
+                assert not v["healthy"]
+                assert v["checks"]["degradation"] == {
+                    "ok": False, "active_rung": float(lad.rung)}
+                saw_unhealthy = True
+        return lad, masks, rungs, saw_unhealthy
+
+    obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=2048))
+    lad, masks, rungs, saw_unhealthy = drive(obs)
+    assert max(rungs) == RUNG_BACKPRESSURE   # the storm hit the top rung
+    assert rungs[-1] == RUNG_NONE            # ...and fully recovered
+    assert saw_unhealthy
+    v = HealthPolicy().verdict(obs)
+    assert v["healthy"] and v["checks"]["degradation"]["ok"]
+    assert obs.registry.snapshot()["degrade_shed_tuples"] == lad.shed > 0
+    # bit-exact replay: same stream, fresh ladder, identical survivors
+    _, masks2, rungs2, _ = drive()
+    assert rungs == rungs2
+    assert all(np.array_equal(a, b) for a, b in zip(masks, masks2))
+
+
+# -- mesh-serving twin (satellite: sensor plane through the mesh loop) -------
+
+_MESH_CFG = EngineConfig(capacity=64, annex_capacity=8, min_trigger_pad=32)
+_MESH_CELL = [0]
+
+
+def _mesh_delivered(tmp_path, name, obs):
+    d = os.path.join(str(tmp_path), name)
+    os.makedirs(d, exist_ok=True)
+
+    def make_service(shards):
+        return MeshQueryService(
+            [SumAggregation()], slice_grid=500, max_window_size=4000,
+            n_keys=16, n_shards=shards, throughput=16_000,
+            wm_period_ms=1000, max_lateness=1000, seed=3,
+            config=_MESH_CFG, admission=QueryAdmission(max_queries=8),
+            windows=[TumblingWindow(Time, 1000)], obs=obs,
+            trace_cell=_MESH_CELL)
+
+    sup = Supervisor(os.path.join(d, "ck"), clock=ManualClock(),
+                     obs=obs, max_restarts=4, seed=11)
+    churn = {0: [("register", SlidingWindow(Time, 2000, 500), "acme")]}
+    return run_supervised_mesh(
+        make_service, 3, sup, sink=TransactionalSink(mode=EXACTLY_ONCE),
+        churn=churn, reshard_at={1: 4}, initial_shards=8,
+        checkpoint_every=2, obs=obs)
+
+
+def test_mesh_sensor_plane_never_changes_delivery(tmp_path):
+    """The mesh loop's obs threading (ISSUE 18 satellite) is a pure
+    observer: a churned + resharded supervised mesh run with the full
+    sensor plane attached (flight ring + WorkloadMonitor sampled at
+    every flight_sync) delivers output identical to the same run with
+    no obs at all — and the sensor plane actually recorded."""
+    plain = _mesh_delivered(tmp_path, "plain", obs=None)
+    obs = _obs.Observability(flight=_obs.FlightRecorder(capacity=4096))
+    obs.attach_workload(clock=ManualClock(), audit_interval_s=1.0)
+    sensed = _mesh_delivered(tmp_path, "sensed", obs=obs)
+    assert sensed == plain and len(plain) > 0
+    assert obs.flight.events()               # the ring saw the run
+    assert HealthPolicy().verdict(obs)["healthy"]
