@@ -18,7 +18,7 @@ f-string names (``f"device_late_age_ms_le_{e}"`` contributes
   (parsed from obs/diff.py's AST, never imported);
 * every metric-family token in the docs
   (``(device|resilience|shaper|serving|ingest_ring|soak|delivery|
-  ckpt|flight|health|delivery)_…`` — the prefixed families are where
+  ckpt|flight|health|slo)_…`` — the prefixed families are where
   doc drift happens; placeholder spellings like
   ``serving_tenant_active_<tenant>`` resolve via the f-string
   prefixes).
@@ -36,7 +36,7 @@ _NAME_RE = re.compile(r"^[a-z][a-z0-9_]{3,}$")
 _TOKEN_RE = re.compile(r"[a-z][a-z0-9_]{3,}")
 _DOC_METRIC_RE = re.compile(
     r"\b((?:device|resilience|shaper|serving|ingest_ring|soak|delivery"
-    r"|ckpt|flight|health|latency|workload|costmodel)_[a-z0-9_]+)")
+    r"|ckpt|flight|health|latency|workload|costmodel|slo)_[a-z0-9_]+)")
 
 
 def _universe(project: Project) -> Tuple[Set[str], Set[str]]:

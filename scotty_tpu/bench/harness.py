@@ -230,6 +230,16 @@ class BenchmarkConfig:
     #: (FusedPipelineDriver.run_streamed; 0 = whole-interval steps) —
     #: the LatencyHeadline cell's micro-batched first-emit arm reads it
     micro_batch: int = 0
+    #: SloChurn cell (ISSUE 19): tenants sharing the served grid; the
+    #: seeded HOT one offers ``slo_hot_factor`` times its fair share of
+    #: registrations and tuples and must trip exactly its own budget
+    slo_tenants: int = 6
+    #: offered-load multiplier of the hot tenant vs a fair share
+    slo_hot_factor: int = 8
+    #: delivered-share SLO objective each tenant is held to
+    slo_delivered_share: float = 0.90
+    #: fast+slow burn-rate threshold that latches an slo_burn event
+    slo_burn_threshold: float = 2.0
 
     @staticmethod
     def from_json(path: str) -> "BenchmarkConfig":
@@ -270,6 +280,10 @@ class BenchmarkConfig:
             pallas_sort_split=raw.get("pallasSortSplit", False),
             pallas_slice_merge=raw.get("pallasSliceMerge", False),
             micro_batch=raw.get("microBatch", 0),
+            slo_tenants=raw.get("sloTenants", 6),
+            slo_hot_factor=raw.get("sloHotFactor", 8),
+            slo_delivered_share=raw.get("sloDeliveredShare", 0.90),
+            slo_burn_threshold=raw.get("sloBurnThreshold", 2.0),
         )
 
 

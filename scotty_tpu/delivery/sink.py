@@ -112,6 +112,13 @@ class TransactionalSink:
                 # purpose — a stamp must never become a new crash
                 # site between sequencing and delivery.
                 self.obs.latency.sink_delivered()
+            slo = getattr(self.obs, "slo", None)
+            if slo is not None:
+                # SLO delivery stamp (ISSUE 19): same AFTER-the-high-
+                # water placement as the latency stamp above — a
+                # delivered-count tick must never become a new crash
+                # site inside the exactly-once emission path
+                slo.sink_delivered()
         return True
 
     def filter(self, items):

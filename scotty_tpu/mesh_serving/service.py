@@ -218,6 +218,15 @@ class MeshQueryService:
         if self.obs is not None:
             self.obs.flight_event(kind, name, value)
 
+    def _attr(self, tenant: str, family: str, delta: int = 1) -> None:
+        """Feed the per-tenant attribution ledger (ISSUE 19) when one is
+        attached — same delta as the engine-level counter at every call
+        site, so the conservation identity holds by construction."""
+        if self.obs is not None:
+            attribution = getattr(self.obs, "attribution", None)
+            if attribution is not None:
+                attribution.count(tenant, family, delta)
+
     def _reconcile_retraces(self) -> None:
         """Fold ACTUAL jit traces into the counters: the shared trace
         cell minus the initial build and minus the reshard-attributed
@@ -292,6 +301,7 @@ class MeshQueryService:
             shard_active=self._shard_active(tenant))
         if reason is not None:
             self._count(_obs.SERVING_REJECTED)
+            self._attr(tenant, "rejected")
             self._flight(_flight.QUERY_REJECT, f"{tenant}:{window}",
                          float(self.tenant_shard(tenant)))
             if self.admission.reject_callback is not None:
@@ -312,13 +322,20 @@ class MeshQueryService:
             want_slots = pad_pow2(self.table.n_slots + 1, self.min_slots)
         if want_lanes != geom.triggers_per_slot \
                 or want_slots != geom.n_slots:
+            # a register that forces a COLD bucket is the retrace this
+            # tenant caused — itemized on the ledger at the forcing site
+            miss_before = self._counters.get(_obs.SERVING_CACHE_MISSES, 0)
             self._rebucket(want_slots, want_lanes)
+            if self._counters.get(_obs.SERVING_CACHE_MISSES,
+                                  0) > miss_before:
+                self._attr(tenant, "retraces")
         else:
             self._count(_obs.SERVING_CACHE_HITS)
 
         handle = self.table.allocate(kind, grid, size, tenant)
         self._dirty.add(handle.slot)
         self._count(_obs.SERVING_REGISTERED)
+        self._attr(tenant, "registered")
         self._flight(_flight.MESH_QUERY_REGISTER, f"{tenant}:{window}",
                      float(self.tenant_shard(tenant)))
         self._gauges()
@@ -331,6 +348,7 @@ class MeshQueryService:
         slot = self.table.release(handle)
         self._dirty.add(slot)
         self._count(_obs.SERVING_CANCELLED)
+        self._attr(handle.tenant, "cancelled")
         self._flight(_flight.MESH_QUERY_CANCEL,
                      f"{handle.tenant}:slot{slot}",
                      float(self.tenant_shard(handle.tenant)))
@@ -456,6 +474,26 @@ class MeshQueryService:
                      [lw[i] for lw in lowered]))
         return out
 
+    def account_emissions(self, rows_by_slot: dict,
+                          watermark: Optional[float] = None) -> None:
+        """Fold one interval's slot-attributed global emissions into the
+        attached per-tenant attribution plane (ISSUE 19): windows and
+        late repairs per owning tenant, plus per-query freshness. A
+        no-op without ``obs.attribution``; host-side only (the rows
+        were already fetched by :meth:`global_rows_by_slot`, the
+        watermark is the host interval counter — zero device syncs)."""
+        attribution = getattr(self.obs, "attribution", None) \
+            if self.obs is not None else None
+        if attribution is None:
+            return
+        if watermark is None:
+            watermark = float(self.interval * self.wm_period_ms)
+        slot_tenant = {int(s): self.table.tenants[int(s)]
+                       for s in np.flatnonzero(self.table.active)}
+        attribution.account_rows(rows_by_slot, slot_tenant,
+                                 float(watermark),
+                                 float(self.wm_period_ms))
+
     def key_rows_by_slot(self, interval_out, key_idx: int) -> dict:
         """One LOGICAL key's emissions attributed to slots (a device
         row-gather before the fetch — sampling keys never pulls the full
@@ -556,6 +594,15 @@ class MeshQueryService:
             # the trace cell when the first post-reshard step runs)
             self._reshard_credits += 1
             self._count(_obs.MESH_RESHARD_RETRACES)
+            # no single tenant forced a reshard compile: apportion it
+            # across the active set (largest remainder, exact) so the
+            # ledger's retrace total still conserves against the
+            # engine's itemized count
+            attribution = getattr(self.obs, "attribution", None) \
+                if self.obs is not None else None
+            if attribution is not None:
+                attribution.apportion_count(
+                    "retraces", 1, self.table.tenant_rollup())
         # restore from THE bundle the commit above just landed — not the
         # lineage walk's "newest that verifies": a fallback there would
         # silently rewind the stream (and re-emit intervals) instead of

@@ -156,6 +156,16 @@ def run_supervised_mesh(make_service: Callable[[int], object],
                         apply_churn(svc, churn[i])
                     out = svc.run(1)[0]
                     rows = svc.global_rows_by_slot(out)
+                    # per-tenant attribution + per-query freshness
+                    # (ISSUE 19): fold the rows ALREADY fetched above
+                    # into the ledger — zero extra syncs, and a replayed
+                    # restart re-accounts exactly what it re-computes,
+                    # so conservation against the engine counters holds
+                    # across crash/restore
+                    if obs is not None \
+                            and getattr(obs, "attribution",
+                                        None) is not None:
+                        svc.account_emissions(rows)
                     gens = svc.table.gens
                     items = [
                         (i, slot, int(gens[slot]),

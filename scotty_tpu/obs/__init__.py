@@ -144,6 +144,32 @@ mid-measure must not pass as clean):
 ``degrade_shed_tuples``        counter: tuples the ladder refused
                                (exact: offered = admitted + shed)
 =============================  ===========================================
+
+Per-tenant SLO accounting contract (ISSUE 19 — :mod:`.slo` +
+:mod:`.attribution`: per-query freshness, exact per-tenant resource
+ledgers, and declared objectives judged by error-budget burn rates.
+All host-side at the existing drain points; ``slo_budget_exhausted``
+APPEARING and burn growth gate the default ``obs diff``;
+``python -m scotty_tpu.obs slo <export>`` is the offline face):
+
+===============================  =========================================
+``slo_evaluations``              counter: SLO policy drain-point ticks
+``slo_burn_events``              counter: (tenant, objective) pairs that
+                                 STARTED burning (edge-triggered; gated)
+``slo_budget_exhausted``         counter: pairs whose slow-window budget
+                                 fully burned (APPEARING gates)
+``slo_burning_tenants``          gauge: tenants currently latched burning
+``slo_worst_fast_burn``          gauge: worst fast-window burn rate
+``slo_freshness_worst_ms``       gauge: worst per-query staleness across
+                                 active slots (clock now - newest
+                                 delivered window end)
+``slo_emission_lag_worst_ms``    gauge: worst per-query event-time lag
+                                 (watermark - newest window end)
+``slo_tenant_<family>_<tenant>``  gauge: one tenant's ledger cell, top-k
+                                 capped (families: windows, rejected,
+                                 shed, ...); the remainder folds into
+                                 ``slo_tenant_<family>_other``
+===============================  =========================================
 """
 
 from __future__ import annotations
@@ -329,6 +355,33 @@ from .workload import (  # noqa: E402  (contract re-export)
     feature_gauge,
 )
 
+# per-tenant SLO accounting contract (ISSUE 19 — scotty_tpu.obs.slo /
+# .attribution: per-query freshness, exact per-tenant ledgers and
+# error-budget burn gating. Same single-definition discipline: each
+# name lives in the module that records under it and is re-exported
+# here so METRIC_HELP and the diff gate cannot drift from the
+# recording side. slo_budget_exhausted APPEARING gates the default
+# ``obs diff`` — a run that burned a tenant's whole error budget must
+# never pass as clean.
+from .attribution import (  # noqa: E402  (contract re-export)
+    ATTRIBUTION_FAMILIES,
+    SLO_EMISSION_LAG_WORST_MS,
+    SLO_FRESHNESS_WORST_MS,
+    FreshnessTracker,
+    TenantAttribution,
+    apportion,
+    attribution_metric,
+)
+from .slo import (  # noqa: E402  (contract re-export)
+    SLO_BUDGET_EXHAUSTED,
+    SLO_BURN_EVENTS,
+    SLO_BURNING_TENANTS,
+    SLO_EVALUATIONS,
+    SLO_WORST_FAST_BURN,
+    ErrorBudget,
+    SloPolicy,
+)
+
 # resilience contract (scotty_tpu.resilience — counters)
 RESILIENCE_SHED_TUPLES = "resilience_shed_tuples"
 RESILIENCE_GROW_EVENTS = "resilience_grow_events"
@@ -505,6 +558,25 @@ METRIC_HELP = {
     DEGRADE_SHED_TUPLES:
         "tuples the degradation ladder refused (exact conservation: "
         "offered = admitted + shed; gated by the default obs diff)",
+    SLO_EVALUATIONS: "SLO policy drain-point evaluation ticks",
+    SLO_BURN_EVENTS:
+        "(tenant, objective) error budgets that STARTED burning at >= "
+        "the alert threshold on both sliding windows (edge-triggered; "
+        "gated by the default obs diff)",
+    SLO_BUDGET_EXHAUSTED:
+        "(tenant, objective) pairs whose slow-window error budget fully "
+        "burned (APPEARING gates the default obs diff)",
+    SLO_BURNING_TENANTS: "tenants with at least one latched burning "
+        "objective",
+    SLO_WORST_FAST_BURN:
+        "worst fast-window burn rate across every (tenant, objective) "
+        "budget (gated by the default obs diff)",
+    SLO_FRESHNESS_WORST_MS:
+        "worst per-query staleness across active slots (clock now - "
+        "newest delivered window end, ms)",
+    SLO_EMISSION_LAG_WORST_MS:
+        "worst per-query event-time emission lag (watermark - newest "
+        "delivered window end, ms)",
 }
 
 
@@ -528,7 +600,8 @@ class Observability:
                  annotate: bool = False,
                  flight: Optional[FlightRecorder] = None,
                  postmortem_dir: Optional[str] = None,
-                 latency=None, workload=None):
+                 latency=None, workload=None, slo=None,
+                 attribution=None):
         self.registry = registry or MetricsRegistry()
         self.spans = spans or SpanRecorder(annotate=annotate)
         self.flight = flight
@@ -543,6 +616,14 @@ class Observability:
         #: :meth:`attach_workload`.
         self.workload = workload.bind(self) if workload is not None \
             else None
+        #: per-tenant SLO plane (ISSUE 19): None by default — same
+        #: one-attribute-check discipline. The policy evaluates inside
+        #: :meth:`flight_sync`; the attribution ledger is fed by the
+        #: serving layers. Attach with :meth:`attach_slo` /
+        #: :meth:`attach_attribution`.
+        self.slo = slo.bind(self) if slo is not None else None
+        self.attribution = attribution.bind(self) \
+            if attribution is not None else None
         self._flight_prev: dict = {}
         #: crash-site seam (ISSUE 8): when set, called as
         #: ``flight_hook(kind, name, value)`` BEFORE every flight event
@@ -638,6 +719,12 @@ class Observability:
         audit's fresh gauges."""
         if self.workload is not None:
             self.workload.sample()
+        if self.slo is not None:
+            # the SLO tick rides the same drain point, AFTER the
+            # workload sample and BEFORE the ring sample — so the
+            # sampled counter deltas already include this tick's
+            # verdicts. Host-side dict work only: zero new syncs.
+            self.slo.evaluate()
         if self.flight is None:
             return
         from . import flight as _flight
@@ -673,6 +760,33 @@ class Observability:
             monitor = WorkloadMonitor(**kwargs)
         self.workload = monitor.bind(self)
         return monitor
+
+    # -- per-tenant SLO accounting plane (ISSUE 19) -----------------------
+    def attach_slo(self, policy=None, **kwargs):
+        """Attach (and return) a :class:`.slo.SloPolicy` — construction
+        kwargs (``freshness_ms=``, ``delivered_share=``, ``clock=``, …)
+        pass through when no policy is given; detach with
+        ``obs.slo = None``. The policy evaluates one tick at every
+        :meth:`flight_sync` (i.e. at the existing drain points only)."""
+        from .slo import SloPolicy
+
+        if policy is None:
+            policy = SloPolicy(**kwargs)
+        self.slo = policy.bind(self)
+        return policy
+
+    def attach_attribution(self, attribution=None, **kwargs):
+        """Attach (and return) a :class:`.attribution.TenantAttribution`
+        ledger — construction kwargs (``clock=``, ``top_k=``, …) pass
+        through when none is given; detach with
+        ``obs.attribution = None``. Serving layers feed it through
+        their ``_attr`` / ``account_emissions`` seams."""
+        from .attribution import TenantAttribution
+
+        if attribution is None:
+            attribution = TenantAttribution(**kwargs)
+        self.attribution = attribution.bind(self)
+        return attribution
 
     def record_failure(self, exc: BaseException, kind: str = "overflow",
                        config=None, checkpoint: Optional[str] = None):
@@ -721,6 +835,10 @@ class Observability:
         out = {"metrics": self.snapshot(), "spans": self.spans.summary()}
         if self.workload is not None:
             out["fingerprint"] = self.workload.fingerprint().to_dict()
+        if self.attribution is not None:
+            out["attribution"] = self.attribution.export()
+        if self.slo is not None:
+            out["slo"] = self.slo.export()
         return out
 
     def write_jsonl(self, path, label: Optional[str] = None) -> dict:
@@ -778,4 +896,9 @@ __all__ = [
     "RESILIENCE_GROW_SPAN",
     "AUTOTUNE_RETUNES", "AUTOTUNE_RETRACES", "AUTOTUNE_RETUNE_SPAN",
     "DEGRADE_ACTIVE_RUNG", "DEGRADE_SHED_TUPLES",
+    "SloPolicy", "ErrorBudget", "TenantAttribution", "FreshnessTracker",
+    "apportion", "attribution_metric", "ATTRIBUTION_FAMILIES",
+    "SLO_EVALUATIONS", "SLO_BURN_EVENTS", "SLO_BUDGET_EXHAUSTED",
+    "SLO_BURNING_TENANTS", "SLO_WORST_FAST_BURN",
+    "SLO_FRESHNESS_WORST_MS", "SLO_EMISSION_LAG_WORST_MS",
 ]

@@ -1,0 +1,334 @@
+"""Per-tenant resource attribution + per-query freshness (ISSUE 19).
+
+The north star is ONE service answering thousands of registered queries
+for millions of tenants — but until this module the engine was
+observable only in aggregate: nothing said "tenant T's sliding-60s
+query is 4 s stale" or "tenant U consumed 80% of the shed budget".
+This module is the accounting half of the SLO plane
+(:mod:`scotty_tpu.obs.slo` is the judgement half): exact integer
+ledgers per tenant per resource family, plus a per-slot freshness
+tracker, all fed ONLY from data the serving layers already hold
+host-side at their drain points.
+
+Contract (the reason this module can exist at all):
+
+* **zero new device syncs** — every input (trigger rows from
+  ``results_by_slot`` / ``global_rows_by_slot``, the watermark, the
+  admission verdicts, the rebucket cache outcome) is already host-known
+  when the serving layer calls in. No step HLO changes; the seven
+  default-off step pins stay byte-identical.
+* **exact conservation** — ``count`` adds the same delta to the
+  per-tenant cell and the per-family total, so for every family
+  ``sum_t rollup[t][family] == totals()[family]`` by construction, and
+  the differential suite (tests/test_attribution.py) asserts the
+  per-tenant sums ALSO equal the engine-level counters
+  (``serving_registered`` / ``serving_cancelled`` / ``serving_rejected``)
+  under churn, a mesh reshard and a supervisor crash/restore.
+* **bounded cardinality** — gauges ride the PR 12
+  ``emit_tenant_gauges`` top-k cap (named gauges for the top-k tenants
+  by count, the remainder folded into one ``*_other`` gauge, stale
+  gauges zeroed on last cancel), so a 10 K-tenant table exports a
+  bounded ``slo_tenant_*`` family. The full exact ledger is still in
+  ``export()``.
+* **deterministic apportioning** — resources shed without tenant
+  identity (the PR 18 ladder drops tuples, not queries) are split by
+  :func:`apportion`: largest-remainder over caller-chosen weights,
+  ties broken by tenant name. Integer-exact: the shares always sum to
+  the total.
+
+Clock discipline: staleness is wall-progress measured on the injectable
+:class:`~scotty_tpu.resilience.clock.Clock` — tests drive a
+``ManualClock``, production a monotonic ``SystemClock``. Never
+``time.time()`` (the no-wall-clock lint enforces this).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from functools import lru_cache
+from typing import Dict, Iterable, Mapping, Optional, Tuple
+
+from ..resilience.clock import Clock, SystemClock
+
+# -- resource families --------------------------------------------------
+#: every ledger family this plane accounts. ``windows`` / ``repairs``
+#: come from the emission rows at drain points; ``registered`` /
+#: ``cancelled`` / ``rejected`` from the serving control plane;
+#: ``admitted`` / ``shed`` from the data plane (PR 3 policy + PR 18
+#: ladder, apportioned); ``retraces`` itemized at the rebucket /
+#: reshard sites that force them.
+ATTRIBUTION_FAMILIES = (
+    "windows", "repairs", "registered", "cancelled", "rejected",
+    "admitted", "shed", "retraces",
+)
+
+# -- freshness gauges (single definition; re-exported by obs) -----------
+SLO_FRESHNESS_WORST_MS = "slo_freshness_worst_ms"
+SLO_EMISSION_LAG_WORST_MS = "slo_emission_lag_worst_ms"
+
+_TENANT_RE = re.compile(r"[^0-9a-zA-Z_]")
+
+
+@lru_cache(maxsize=4096)
+def attribution_metric(family: str, tenant: str) -> str:
+    """The bounded per-tenant gauge name for one ledger family —
+    ``slo_tenant_<family>_<tenant>`` with the tenant sanitized the same
+    way ``serving_tenant_active_*`` sanitizes (PR 12). Cached: this
+    runs per tenant per family per drain tick on the gauge path, and
+    the top-k cap bounds the live name set far under the cache size."""
+    return f"slo_tenant_{family}_{_TENANT_RE.sub('_', tenant)}"
+
+
+def apportion(total: int, weights: Mapping[str, float]) -> Dict[str, int]:
+    """Split ``total`` integer units across ``weights`` exactly.
+
+    Largest-remainder apportioning with ties broken by name, so the
+    split is deterministic and ``sum(result.values()) == total``
+    always — the property the conservation suite leans on when the
+    ladder sheds tuples that carry no tenant identity. Zero/negative
+    weights get nothing; with no positive weight everything lands on
+    the lexicographically first name (or ``{}`` when empty)."""
+    total = int(total)
+    if total == 0 or not weights:
+        return {}
+    pos = {k: float(v) for k, v in weights.items() if v > 0}
+    if not pos:
+        first = min(weights)
+        return {first: total}
+    wsum = sum(pos.values())
+    floors: Dict[str, int] = {}
+    rema: list = []
+    assigned = 0
+    for name in sorted(pos):
+        exact = total * pos[name] / wsum
+        fl = int(exact)
+        floors[name] = fl
+        assigned += fl
+        rema.append((-(exact - fl), name))
+    rema.sort()
+    for _, name in rema[: total - assigned]:
+        floors[name] += 1
+    return {k: v for k, v in floors.items() if v}
+
+
+class FreshnessTracker:
+    """Per-query (per-slot) staleness + emission lag.
+
+    Event time and wall time are different axes: the watermark advances
+    in event-time ms, the clock in seconds. The tracker pins
+    ``t0 = clock.now()`` at the first observation and treats event-time
+    0 as that instant, so **staleness** = wall ms elapsed since t0
+    minus the newest delivered window end — "how long ago, in wall
+    terms, is the newest result this query has" — while **emission
+    lag** = watermark − newest window end, the purely event-time
+    measure of how far the query's output trails the stream. Both are
+    clamped at 0."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or SystemClock()
+        self._t0: Optional[float] = None
+        self._newest_we: Dict[int, int] = {}     # slot -> newest window end
+        self._slot_tenant: Dict[int, str] = {}
+        self._watermark = 0.0
+
+    def observe(self, rows_by_slot: Mapping[int, Iterable],
+                slot_tenant: Mapping[int, str], watermark: float) -> None:
+        """Fold one drain point's delivered rows. ``slot_tenant`` is the
+        CURRENT active-slot → tenant map; slots no longer in it are
+        dropped (a cancelled query has no freshness)."""
+        if self._t0 is None:
+            self._t0 = self.clock.now()
+        self._watermark = float(watermark)
+        self._slot_tenant = {int(s): t for s, t in slot_tenant.items()}
+        for slot in list(self._newest_we):
+            if slot not in self._slot_tenant:
+                del self._newest_we[slot]
+        for slot, rows in rows_by_slot.items():
+            slot = int(slot)
+            if slot not in self._slot_tenant:
+                continue
+            newest = max((int(r[1]) for r in rows), default=None)
+            if newest is not None and \
+                    newest > self._newest_we.get(slot, -1):
+                self._newest_we[slot] = newest
+
+    def _elapsed_ms(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return (self.clock.now() - self._t0) * 1000.0
+
+    def snapshot(self) -> Dict[int, Dict[str, float]]:
+        """Per-slot freshness at call time: ``staleness_ms``,
+        ``emission_lag_ms``, ``newest_window_end`` and the owning
+        tenant. Active slots that never delivered a row measure from
+        event-time 0 (maximally stale)."""
+        now_ms = self._elapsed_ms()
+        out: Dict[int, Dict[str, float]] = {}
+        for slot, tenant in sorted(self._slot_tenant.items()):
+            we = self._newest_we.get(slot, 0)
+            out[slot] = {
+                "tenant": tenant,
+                "newest_window_end": float(we),
+                "staleness_ms": max(0.0, now_ms - we),
+                "emission_lag_ms": max(0.0, self._watermark - we),
+            }
+        return out
+
+    def worst_by_tenant(self) -> Dict[str, Tuple[float, int]]:
+        """Each tenant's worst (staleness_ms, slot) across its active
+        queries — the row the SLO freshness objective judges."""
+        worst: Dict[str, Tuple[float, int]] = {}
+        for slot, row in self.snapshot().items():
+            t = row["tenant"]
+            cur = worst.get(t)
+            if cur is None or row["staleness_ms"] > cur[0]:
+                worst[t] = (row["staleness_ms"], slot)
+        return worst
+
+    def worst(self) -> Tuple[float, float]:
+        """(worst staleness_ms, worst emission_lag_ms) across every
+        active slot — the two bounded gauges."""
+        snap = self.snapshot()
+        if not snap:
+            return (0.0, 0.0)
+        return (max(r["staleness_ms"] for r in snap.values()),
+                max(r["emission_lag_ms"] for r in snap.values()))
+
+    def export(self) -> Dict:
+        return {"watermark": self._watermark,
+                "slots": {str(k): v for k, v in self.snapshot().items()}}
+
+
+class TenantAttribution:
+    """The exact per-tenant ledger (module docstring).
+
+    Attach with ``obs.attach_attribution(TenantAttribution(...))``;
+    serving layers feed it through ``QueryService._attr`` /
+    ``account_emissions`` and the bench/connector loops through the
+    same surfaces. Thread-safe: one lock around the dicts, exactly the
+    ``MetricsRegistry`` discipline."""
+
+    def __init__(self, clock: Optional[Clock] = None, top_k: int = 8,
+                 gauge_families: Tuple[str, ...] = ("windows", "rejected",
+                                                    "shed"),
+                 gauge_every: int = 4):
+        for fam in gauge_families:
+            if fam not in ATTRIBUTION_FAMILIES:
+                raise ValueError(
+                    f"unknown attribution family {fam!r}; "
+                    f"known: {ATTRIBUTION_FAMILIES}")
+        self.clock = clock or SystemClock()
+        self.top_k = int(top_k)
+        self.gauge_families = tuple(gauge_families)
+        #: gauges are a sampled surface — refreshed every Nth drain
+        #: tick (the first tick always emits) and at ``export()``, so
+        #: the per-interval gauge cost amortizes while the exact
+        #: ledger stays exact every tick. 1 = emit every tick.
+        self.gauge_every = max(1, int(gauge_every))
+        self.freshness = FreshnessTracker(clock=self.clock)
+        self.obs = None
+        self._lock = threading.Lock()
+        self._by_tenant: Dict[str, Dict[str, int]] = {}
+        self._totals: Dict[str, int] = {f: 0 for f in ATTRIBUTION_FAMILIES}
+        self._gauged: Dict[str, set] = {f: set() for f in gauge_families}
+        self._accounts = 0
+
+    def bind(self, obs) -> "TenantAttribution":
+        self.obs = obs
+        return self
+
+    # -- the ledger ----------------------------------------------------
+    def count(self, tenant: str, family: str, delta: int = 1) -> None:
+        """Add ``delta`` to one tenant's family cell AND the family
+        total — one lock, one delta, conservation by construction."""
+        if family not in self._totals:
+            raise ValueError(
+                f"unknown attribution family {family!r}; "
+                f"known: {ATTRIBUTION_FAMILIES}")
+        delta = int(delta)
+        if delta == 0:
+            return
+        with self._lock:
+            cell = self._by_tenant.setdefault(tenant, {})
+            cell[family] = cell.get(family, 0) + delta
+            self._totals[family] += delta
+
+    def apportion_count(self, family: str, total: int,
+                        weights: Mapping[str, float]) -> Dict[str, int]:
+        """Attribute ``total`` identity-less units (ladder sheds,
+        reshard retraces) across tenants by :func:`apportion` — exact,
+        deterministic — and fold the shares into the ledger."""
+        shares = apportion(total, weights)
+        for tenant, n in shares.items():
+            self.count(tenant, family, n)
+        return shares
+
+    def account_rows(self, rows_by_slot: Mapping[int, Iterable],
+                     slot_tenant: Mapping[int, str], watermark: float,
+                     wm_period_ms: float) -> None:
+        """Fold one drain point's delivered rows: ``windows`` per
+        owning tenant, ``repairs`` for rows whose window closed more
+        than one watermark period ago (a late-data retraction re-emit,
+        the PR 3 repair path), then freshness + the bounded gauges.
+        Everything here is host-side dict work on data the caller
+        already fetched."""
+        late_edge = float(watermark) - float(wm_period_ms)
+        for slot, rows in rows_by_slot.items():
+            tenant = slot_tenant.get(int(slot))
+            if tenant is None:
+                continue
+            rows = list(rows)
+            if not rows:
+                continue
+            self.count(tenant, "windows", len(rows))
+            repairs = sum(1 for r in rows if float(r[1]) <= late_edge)
+            if repairs:
+                self.count(tenant, "repairs", repairs)
+        self.freshness.observe(rows_by_slot, slot_tenant, watermark)
+        if self._accounts % self.gauge_every == 0:
+            self._emit_gauges()
+        self._accounts += 1
+
+    # -- views ---------------------------------------------------------
+    def rollup(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {t: dict(fams) for t, fams in self._by_tenant.items()}
+
+    def totals(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._totals)
+
+    def conservation_ok(self) -> bool:
+        """Every family's per-tenant cells sum to its total. True by
+        construction — asserted anyway by the differential suite so a
+        future refactor can't quietly break the ledger."""
+        roll, tot = self.rollup(), self.totals()
+        for fam in ATTRIBUTION_FAMILIES:
+            if sum(c.get(fam, 0) for c in roll.values()) != tot[fam]:
+                return False
+        return True
+
+    def export(self) -> Dict:
+        self._emit_gauges()        # sampled surface: fresh at export
+        return {"tenants": self.rollup(), "totals": self.totals(),
+                "freshness": self.freshness.export()}
+
+    # -- bounded gauges ------------------------------------------------
+    def _emit_gauges(self) -> None:
+        if self.obs is None:
+            return
+        # lazy import: serving imports obs at module load; the gauge
+        # helper only at emission time — no cycle
+        from ..serving.service import emit_tenant_gauges
+
+        roll = self.rollup()
+        for fam in self.gauge_families:
+            counts = {t: c[fam] for t, c in roll.items() if c.get(fam)}
+            self._gauged[fam] = emit_tenant_gauges(
+                self.obs, counts, self._gauged[fam], self.top_k,
+                metric_for=lambda t, fam=fam: attribution_metric(fam, t),
+                other_name=f"slo_tenant_{fam}_other")
+        worst_stale, worst_lag = self.freshness.worst()
+        self.obs.gauge(SLO_FRESHNESS_WORST_MS).set(worst_stale)
+        self.obs.gauge(SLO_EMISSION_LAG_WORST_MS).set(worst_lag)

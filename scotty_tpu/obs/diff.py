@@ -188,12 +188,83 @@ DEFAULT_THRESHOLDS = {
         "autotune_retraces": {"direction": "lower", "default": 0},
         "degrade_active_rung": {"direction": "lower", "default": 0},
         "degrade_shed_tuples": {"direction": "lower", "default": 0},
+        # per-tenant SLO contract (ISSUE 19): an error budget exhausting
+        # or burn events firing between two exports of the same workload
+        # gate — a certified number measured while a tenant was burning
+        # its budget must not pass as clean. The worst fast-burn gauge
+        # gates as a continuous companion (growth past 10% flags the
+        # budget heading toward the discrete gates before they fire).
+        # All lazily created ("default": 0 gates the appearing case).
+        "slo_budget_exhausted": {"direction": "lower", "default": 0},
+        "slo_burn_events": {"direction": "lower", "default": 0,
+                            "rel_tol": 0.10},
+        "slo_worst_fast_burn": {"direction": "lower", "default": 0,
+                                "rel_tol": 0.10},
     },
     "require_cells": True,
 }
 
+#: registry-derived suffixes (MetricsRegistry.snapshot): a histogram
+#: ``emit_latency_ms`` exports ``emit_latency_ms_p99`` etc., every
+#: counter derives ``_per_s`` — a threshold key carrying one of these
+#: is known iff its base name is
+_DERIVED_SUFFIXES = ("_count", "_mean", "_p50", "_p99", "_min", "_max",
+                     "_per_s")
+
+#: families whose member names embed run identity (tenant names, stage
+#: labels, shard ordinals, breakdown buckets) and therefore cannot be
+#: enumerated statically — any key under these prefixes is gateable
+_DYNAMIC_PREFIXES = ("serving_tenant_", "slo_tenant_", "latency_stage_",
+                     "latency_shard_", "workload_", "device_",
+                     "resilience_", "autotune_")
+
+#: bench cell-row fields that are not registry metrics (BenchResult
+#:.to_dict headline columns + the synthetic error flag _cells adds)
+_CELL_ROW_KEYS = frozenset({
+    "tuples_per_sec", "p99_emit_ms", "windows_emitted", "tuples",
+    "wall_s", "cell_wall_s", "rtt_floor_ms", "error", "elapsed_s",
+})
+
+
+def known_metric_keys() -> set:
+    """Every metric name a threshold file may gate: the documented
+    registry names (obs.METRIC_HELP), the default gate keys, the bench
+    cell-row columns (headline fields + the runner's extras whitelist).
+    Dynamic families and derived suffixes are handled by
+    :func:`_key_known`, not enumerated here."""
+    known = set(DEFAULT_THRESHOLDS["metrics"])
+    known |= _CELL_ROW_KEYS
+    from . import METRIC_HELP
+
+    known.update(METRIC_HELP)
+    try:
+        from ..bench.runner import CELL_EXTRA_FIELDS
+
+        known.update(CELL_EXTRA_FIELDS)
+    except ImportError:                  # bench layer absent: the core
+        pass                             # universe still gates
+    return known
+
+
+def _key_known(name: str, known: set) -> bool:
+    if name in known:
+        return True
+    if any(name.startswith(p) and len(name) > len(p)
+           for p in _DYNAMIC_PREFIXES):
+        return True
+    for suf in _DERIVED_SUFFIXES:
+        if name.endswith(suf) and name[:-len(suf)] in known:
+            return True
+    return False
+
 
 def load_thresholds(path: Optional[str]) -> dict:
+    """Load (and validate) a ``--thresholds`` file. A key that matches
+    no metric any code creates is REJECTED with near-miss suggestions —
+    before this check a typo'd key silently gated nothing, which is the
+    exact failure mode a threshold file exists to prevent (ISSUE 19
+    satellite; the static half of the same contract is the analysis
+    ``metric-coherence`` rule over DEFAULT_THRESHOLDS)."""
     if path is None:
         return DEFAULT_THRESHOLDS
     with open(path) as f:
@@ -202,6 +273,20 @@ def load_thresholds(path: Optional[str]) -> dict:
         raise ValueError(
             f"threshold file {path}: needs a 'metrics' object "
             "({name: {direction, rel_tol, abs_tol}})")
+    known = known_metric_keys()
+    unknown = [k for k in raw["metrics"] if not _key_known(k, known)]
+    if unknown:
+        import difflib
+
+        hints = []
+        for k in unknown:
+            close = difflib.get_close_matches(k, sorted(known), n=3)
+            hints.append(f"{k!r}" + (f" (did you mean: "
+                                     f"{', '.join(close)}?)"
+                                     if close else ""))
+        raise ValueError(
+            f"threshold file {path}: unknown metric key(s) — these "
+            f"would silently gate nothing: {'; '.join(hints)}")
     raw.setdefault("require_cells", True)
     return raw
 

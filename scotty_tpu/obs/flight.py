@@ -60,6 +60,12 @@ Event-kind vocabulary (plain interned strings; recorders pass these,
                 ``warm`` / ``retrace`` / ``commit`` …)
 ``degrade``     a degradation-ladder rung transition, edge-triggered
                 (name = ``enter:<rung>``/``exit:<rung>``, value = rung)
+``slo_burn``    a (tenant, objective) error budget started burning at
+                >= the alert threshold (name = ``tenant:objective``,
+                value = fast burn rate) — edge-triggered
+``slo_recover``  the pair stopped burning (value = fast burn rate)
+``slo_exhausted``  the pair's slow-window budget fully consumed
+                (value = slow burn rate) — edge-triggered
 ``crash``       generic fatal failure (``record_failure`` when no more
                 specific kind applies)
 ==============  ============================================================
@@ -190,6 +196,16 @@ WORKLOAD_DRIFT = "workload_drift"
 # ladder writes nothing
 AUTOTUNE = "autotune"
 DEGRADE = "degrade"
+# per-tenant SLO accounting plane (ISSUE 19 — scotty_tpu.obs.slo):
+# EDGE-TRIGGERED error-budget transitions only (name =
+# "<tenant>:<objective>"): a (tenant, objective) pair starting to burn
+# at >= the alert threshold on both sliding windows (value = the fast
+# burn rate), the pair recovering, and the slow window's budget fully
+# consumed (value = the slow burn rate) — a steady violation is ONE
+# event, not one per drain
+SLO_BURN = "slo_burn"
+SLO_RECOVER = "slo_recover"
+SLO_EXHAUSTED = "slo_exhausted"
 #: generic fatal failure recorded by ``record_failure`` when no more
 #: specific kind applies (the postmortem CLI's ``crash`` cause class)
 CRASH = "crash"

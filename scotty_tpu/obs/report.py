@@ -310,6 +310,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "is drift.DEFAULT_DRIFT_THRESHOLDS")
     wp.add_argument("--json", action="store_true",
                     help="machine-readable finding list")
+    sp = sub.add_parser(
+        "slo", help="per-tenant SLO verdict over any export carrying "
+                    "an 'slo' section: names every violating tenant, "
+                    "objective, owning stage and query slot with its "
+                    "fast/slow burn rates; exit 0 green / 1 a tenant "
+                    "is burning its error budget / 2 the export "
+                    "carries no SLO section")
+    sp.add_argument("file", help="export to judge (a recorded cell's "
+                                 "result_*.json, a /vars dump, or any "
+                                 "Observability.export with an "
+                                 "attached SloPolicy)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable verdict instead of the "
+                         "violation lines")
     tp = sub.add_parser(
         "trend", help="reconstruct the bench trajectory across "
                       "BENCH_r*.json rounds (+ current bench_results "
@@ -379,6 +393,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return drift_main(args.baseline, args.live,
                           thresholds_path=args.thresholds,
                           as_json=args.json)
+    if args.cmd == "slo":
+        from .slo import slo_main
+
+        return slo_main(args.file, as_json=args.json)
     if args.cmd == "trend":
         from .trend import trend_main
 

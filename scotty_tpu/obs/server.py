@@ -76,6 +76,14 @@ class HealthPolicy:
     active rung IS the ongoing condition, and the verdict recovers the
     moment the ladder steps back to rung 0. Appears only once the
     gauge exists (a ladder was wired), like the drift check.
+    ``slo_unhealthy`` (ISSUE 19) — unhealthy while the attached
+    :class:`~.slo.SloPolicy` has latched burn/exhaustion violations;
+    the verdict names the worst violation's tenant, objective and
+    owning stage so a pager starts triage with the right tenant in
+    hand. Level-triggered off the policy's latch (which is itself
+    edge-triggered with re-arm), so the check recovers the moment the
+    burn clears. Appears only when ``obs.slo`` is attached — a run
+    without an SLO plane probes exactly as before.
 
     ``verdict`` is also callable without a server (tests drive it
     directly) and is safe under concurrent probes (one policy-level lock
@@ -87,13 +95,15 @@ class HealthPolicy:
                  overflow_unhealthy: bool = True,
                  max_first_emit_p99_ms: Optional[float] = None,
                  drift_unhealthy: bool = True,
-                 degrade_unhealthy: bool = True):
+                 degrade_unhealthy: bool = True,
+                 slo_unhealthy: bool = True):
         self.max_watermark_lag_ms = max_watermark_lag_ms
         self.stall_unhealthy = stall_unhealthy
         self.overflow_unhealthy = overflow_unhealthy
         self.max_first_emit_p99_ms = max_first_emit_p99_ms
         self.drift_unhealthy = drift_unhealthy
         self.degrade_unhealthy = degrade_unhealthy
+        self.slo_unhealthy = slo_unhealthy
         self._lock = threading.Lock()
         self._last_stalls = 0.0
         self._last_drift = 0.0
@@ -166,6 +176,24 @@ class HealthPolicy:
                     row["owning_stage"] = tracer.owning_stage_recent()
             checks["first_emit"] = row
             healthy = healthy and row["ok"]
+        if self.slo_unhealthy:
+            slo = getattr(obs, "slo", None)
+            if slo is not None:
+                # SLO-plane runs only: the check appears once a policy
+                # is attached, so a plain run probes unchanged
+                violations = slo.violations()
+                ok = not violations
+                row = {"ok": ok, "violations": len(violations)}
+                if not ok:
+                    worst = violations[0]
+                    row["tenant"] = worst["tenant"]
+                    row["objective"] = worst["objective"]
+                    row["owning_stage"] = worst.get("owning_stage")
+                    row["fast_burn"] = worst["fast_burn"]
+                    if worst.get("query_slot") is not None:
+                        row["query_slot"] = worst["query_slot"]
+                checks["slo"] = row
+                healthy = healthy and ok
         obs.counter(HEALTH_CHECKS).inc()
         if not healthy:
             obs.counter(HEALTH_UNHEALTHY).inc()
@@ -173,10 +201,50 @@ class HealthPolicy:
         return {"healthy": healthy, "checks": checks}
 
 
+def filter_exposition(text: str, prefix: str,
+                      expo_prefix: str = "scotty_") -> str:
+    """Restrict a Prometheus text exposition to metrics whose RAW name
+    (the exposition's ``scotty_`` prefix stripped) starts with
+    ``prefix`` (ISSUE 19 satellite: ``/metrics?prefix=slo_`` scrapes
+    the SLO family without paying for the full exposition at
+    high-cardinality tenant counts). An empty result is a VALID empty
+    exposition — zero matching series is an answer, not an error."""
+    out = []
+    for line in text.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            name = parts[2] if len(parts) > 2 else ""
+        elif line and not line.startswith("#"):
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+        else:
+            continue
+        raw = name[len(expo_prefix):] \
+            if name.startswith(expo_prefix) else name
+        if raw.startswith(prefix):
+            out.append(line)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def filter_export(export: dict, prefix: str) -> dict:
+    """Restrict an ``Observability.export()`` document's metrics
+    snapshot to keys starting with ``prefix`` (the ``/vars?prefix=``
+    face of :func:`filter_exposition`). Non-metric sections
+    (``spans``, ``slo``, ``attribution``, ``fingerprint``) pass through
+    untouched — the filter bounds the high-cardinality part."""
+    out = dict(export)
+    if isinstance(out.get("metrics"), dict):
+        out["metrics"] = {k: v for k, v in out["metrics"].items()
+                          if k.startswith(prefix)}
+    return out
+
+
 class ObsServer:
     """The daemon-thread HTTP server :func:`serve` returns. ``port`` is
     the bound port (useful with ``port=0``); ``close()`` shuts the
-    listener down and joins the thread. Context-manager friendly."""
+    listener down and joins the thread. Context-manager friendly.
+
+    ``/metrics`` and ``/vars`` accept ``?prefix=<raw-name-prefix>``
+    (e.g. ``/metrics?prefix=slo_``) — see :func:`filter_exposition`."""
 
     def __init__(self, obs, host: str = "127.0.0.1", port: int = 0,
                  health: Optional[HealthPolicy] = None):
@@ -196,18 +264,29 @@ class ObsServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                from urllib.parse import parse_qs
+
                 o = outer.obs() if callable(outer.obs) else outer.obs
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                prefix = parse_qs(query).get("prefix", [None])[0]
                 if o is None:
                     self._reply(503, "text/plain",
                                 b"no active observability\n")
                     return
                 if path == "/metrics":
+                    body = o.prometheus()
+                    if prefix is not None:
+                        # an empty filtered exposition is a valid 200,
+                        # never a 500 (regression-tested)
+                        body = filter_exposition(body, prefix)
                     self._reply(200, "text/plain; version=0.0.4",
-                                o.prometheus().encode())
+                                body.encode())
                 elif path == "/vars":
+                    export = o.export()
+                    if prefix is not None:
+                        export = filter_export(export, prefix)
                     self._reply(200, "application/json",
-                                json.dumps(o.export(),
+                                json.dumps(export,
                                            default=float).encode())
                 elif path == "/healthz":
                     v = outer.health.verdict(o)

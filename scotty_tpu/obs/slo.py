@@ -1,0 +1,437 @@
+"""Per-tenant SLO objectives with error-budget burn-rate gating (ISSUE 19).
+
+:mod:`scotty_tpu.obs.attribution` keeps the exact per-tenant ledger;
+this module judges it. Operators declare objectives —
+
+* **freshness**: every active query's staleness ≤ X ms (p-target share
+  of evaluation ticks), read from the attribution plane's
+  :class:`~scotty_tpu.obs.attribution.FreshnessTracker`;
+* **first_emit**: the engine-wide first-emit p99 ≤ Y ms, riding the
+  PR 13 :class:`~scotty_tpu.obs.latency.LatencyTracer` (a per-engine
+  objective, accounted under the pseudo-tenant ``engine`` because the
+  tracer's recent-deque is not tenant-sliced);
+* **delivered_share**: of a tenant's demanded resources
+  (windows delivered + registrations rejected + apportioned sheds),
+  the delivered share ≥ Z — the "did the service actually serve this
+  tenant" objective;
+
+— and each (tenant, objective) pair owns an :class:`ErrorBudget`:
+budget = 1 − target, burn rate = bad-share / budget over a sliding
+window. Alerting is the SRE multi-window shape: a pair is **burning**
+when BOTH the fast and the slow window burn at ≥ ``burn_threshold``
+(the fast window reacts, the slow window suppresses blips), and
+**exhausted** when the slow window's bad share has consumed the whole
+budget (slow burn ≥ 1).
+
+Everything is edge-triggered: a rising burn latches, counts
+``slo_burn_events`` once and records one ``slo_burn`` flight event
+(name ``tenant:objective``); recovery unlatches with ``slo_recover``;
+budget exhaustion mirrors with ``slo_budget_exhausted`` /
+``slo_exhausted`` — the DriftDetector latch discipline, so a steady
+violation is one event, not one per drain.
+
+Evaluation runs inside ``Observability.flight_sync`` at the existing
+drain points — after the workload sample, before the flight-ring
+sample, so the sampled counter deltas already include this tick's SLO
+verdicts. Pure host-side dict work on data already fetched: zero new
+device syncs, all step HLO pins byte-identical.
+
+CLI: ``python -m scotty_tpu.obs slo <export.json>`` (exit 0 green /
+1 violation / 2 no SLO section) names the violating tenant, query
+(slot), objective and owning stage — see :func:`slo_main`.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..resilience.clock import Clock, SystemClock
+from . import flight as _fl
+
+# -- metric names (single definition; re-exported by obs) ---------------
+SLO_EVALUATIONS = "slo_evaluations"
+SLO_BURN_EVENTS = "slo_burn_events"
+SLO_BUDGET_EXHAUSTED = "slo_budget_exhausted"
+SLO_BURNING_TENANTS = "slo_burning_tenants"
+SLO_WORST_FAST_BURN = "slo_worst_fast_burn"
+
+# -- objective vocabulary -----------------------------------------------
+OBJECTIVE_FRESHNESS = "freshness"
+OBJECTIVE_FIRST_EMIT = "first_emit"
+OBJECTIVE_DELIVERED_SHARE = "delivered_share"
+
+#: engine-wide objectives (the PR 13 tracer is not tenant-sliced) are
+#: accounted under this pseudo-tenant so every budget row has the same
+#: (tenant, objective) shape.
+ENGINE_TENANT = "engine"
+
+#: which pipeline stage owns a violation when the latency tracer has no
+#: recent attribution to offer — the triage starting point, not a
+#: verdict (docs/API.md walks the full triage).
+_OBJECTIVE_STAGE = {
+    OBJECTIVE_FRESHNESS: "emit",
+    OBJECTIVE_FIRST_EMIT: "emit",
+    OBJECTIVE_DELIVERED_SHARE: "admission",
+}
+
+
+class _WindowSum:
+    """Trailing-window (good, bad) running sums: O(1) amortized per
+    tick — the per-evaluation cost of the accounting plane must not
+    scale with window length, or the ≤ 2% overhead acceptance decays
+    as the ledger fills."""
+
+    __slots__ = ("window_s", "_q", "good", "bad")
+
+    def __init__(self, window_s: float):
+        self.window_s = float(window_s)
+        self._q: Deque[Tuple[float, int, int]] = deque()
+        self.good = 0
+        self.bad = 0
+
+    def add(self, now: float, good: int, bad: int) -> None:
+        self._q.append((now, good, bad))
+        self.good += good
+        self.bad += bad
+        self.expire(now)
+
+    def expire(self, now: float) -> None:
+        edge = now - self.window_s
+        q = self._q
+        while q and q[0][0] < edge:
+            _, g, b = q.popleft()
+            self.good -= g
+            self.bad -= b
+
+    def bad_share(self, now: float) -> float:
+        self.expire(now)
+        total = self.good + self.bad
+        return self.bad / total if total else 0.0
+
+
+class ErrorBudget:
+    """One (tenant, objective) pair's sliding good/bad ledger.
+
+    ``target`` is the objective's good-share target (e.g. 0.99);
+    budget = 1 − target. ``record`` appends one tick's (good, bad)
+    counts; ``burn(now, window_s)`` is the bad share over the trailing
+    window divided by the budget — burn 1.0 means "erring at exactly
+    the rate that spends the whole budget", burn N means N× that.
+    Events older than the slow window are pruned as time advances, so
+    memory is bounded by tick rate × slow window, and both window
+    sums are maintained incrementally (O(1) amortized per tick)."""
+
+    def __init__(self, target: float, fast_window_s: float = 60.0,
+                 slow_window_s: float = 600.0):
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"SLO target must be in (0, 1), got {target}")
+        if fast_window_s <= 0 or slow_window_s < fast_window_s:
+            raise ValueError(
+                "need 0 < fast_window_s <= slow_window_s, got "
+                f"{fast_window_s}/{slow_window_s}")
+        self.target = float(target)
+        self.budget = 1.0 - float(target)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self._fast = _WindowSum(self.fast_window_s)
+        self._slow = _WindowSum(self.slow_window_s)
+
+    def record(self, now: float, good: int, bad: int) -> None:
+        now, good, bad = float(now), int(good), int(bad)
+        self._fast.add(now, good, bad)
+        self._slow.add(now, good, bad)
+
+    def bad_share(self, now: float, window_s: float) -> float:
+        now, window_s = float(now), float(window_s)
+        if window_s == self.fast_window_s:
+            return self._fast.bad_share(now)
+        if window_s == self.slow_window_s:
+            return self._slow.bad_share(now)
+        # arbitrary window: scan the slow ledger (diagnostics only —
+        # the hot evaluate path always asks for one of the two above)
+        edge = now - window_s
+        good = bad = 0
+        for t, g, b in self._slow._q:
+            if t >= edge:
+                good += g
+                bad += b
+        total = good + bad
+        return bad / total if total else 0.0
+
+    def burn(self, now: float, window_s: float) -> float:
+        return self.bad_share(now, window_s) / self.budget
+
+    def evaluate(self, now: float) -> Dict[str, float]:
+        fast = self.burn(now, self.fast_window_s)
+        slow = self.burn(now, self.slow_window_s)
+        return {"fast_burn": fast, "slow_burn": slow,
+                "exhausted": slow >= 1.0}
+
+
+class SloPolicy:
+    """Declared objectives + per-(tenant, objective) budgets
+    (module docstring). Attach with ``obs.attach_slo(...)``; every
+    ``obs.flight_sync`` then evaluates one tick. Objectives left
+    ``None`` are not declared and never judged."""
+
+    def __init__(self, freshness_ms: Optional[float] = None,
+                 freshness_target: float = 0.99,
+                 first_emit_p99_ms: Optional[float] = None,
+                 first_emit_target: float = 0.99,
+                 delivered_share: Optional[float] = None,
+                 fast_window_s: float = 60.0, slow_window_s: float = 600.0,
+                 burn_threshold: float = 2.0,
+                 clock: Optional[Clock] = None):
+        self.freshness_ms = freshness_ms
+        self.freshness_target = float(freshness_target)
+        self.first_emit_p99_ms = first_emit_p99_ms
+        self.first_emit_target = float(first_emit_target)
+        self.delivered_share = delivered_share
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.clock = clock or SystemClock()
+        self.obs = None
+        self._budgets: Dict[Tuple[str, str], ErrorBudget] = {}
+        self._burning: set = set()          # latched (tenant, objective)
+        self._exhausted: set = set()
+        self._last_rollup: Dict[str, Dict[str, int]] = {}
+        self._stages: Dict[Tuple[str, str], str] = {}
+        self._slots: Dict[Tuple[str, str], Optional[int]] = {}
+        self._sink_delivered = 0
+
+    def bind(self, obs) -> "SloPolicy":
+        self.obs = obs
+        return self
+
+    def sink_delivered(self) -> None:
+        """Host-side stamp from the transactional sink — one delivered
+        item. Called AFTER the high-water advance (the sink's crash-
+        site contract); feeds the export only, never the device."""
+        self._sink_delivered += 1
+
+    # -- objective ticks -----------------------------------------------
+    def _budget(self, tenant: str, objective: str,
+                target: float) -> ErrorBudget:
+        key = (tenant, objective)
+        b = self._budgets.get(key)
+        if b is None:
+            b = ErrorBudget(target, self.fast_window_s, self.slow_window_s)
+            self._budgets[key] = b
+        return b
+
+    def _tick_freshness(self, now: float, attribution) -> None:
+        if self.freshness_ms is None or attribution is None:
+            return
+        for tenant, (stale_ms, slot) in \
+                attribution.freshness.worst_by_tenant().items():
+            bad = stale_ms > float(self.freshness_ms)
+            self._budget(tenant, OBJECTIVE_FRESHNESS,
+                         self.freshness_target).record(
+                now, good=0 if bad else 1, bad=1 if bad else 0)
+            if bad:
+                self._slots[(tenant, OBJECTIVE_FRESHNESS)] = slot
+
+    def _tick_first_emit(self, now: float) -> None:
+        if self.first_emit_p99_ms is None:
+            return
+        tracer = getattr(self.obs, "latency", None) if self.obs else None
+        if tracer is None:
+            return
+        p99 = tracer.first_emit_p99_recent()
+        if p99 is None:                      # below the sample floor
+            return
+        bad = p99 > float(self.first_emit_p99_ms)
+        self._budget(ENGINE_TENANT, OBJECTIVE_FIRST_EMIT,
+                     self.first_emit_target).record(
+            now, good=0 if bad else 1, bad=1 if bad else 0)
+        if bad:
+            self._stages[(ENGINE_TENANT, OBJECTIVE_FIRST_EMIT)] = \
+                tracer.owning_stage_recent()
+
+    def _tick_delivered_share(self, now: float, attribution) -> None:
+        if self.delivered_share is None or attribution is None:
+            return
+        roll = attribution.rollup()
+        for tenant, fams in roll.items():
+            prev = self._last_rollup.get(tenant, {})
+            good = fams.get("windows", 0) - prev.get("windows", 0)
+            bad = (fams.get("rejected", 0) - prev.get("rejected", 0)) \
+                + (fams.get("shed", 0) - prev.get("shed", 0))
+            if good == 0 and bad == 0:       # idle tenant: no verdict
+                continue
+            self._budget(tenant, OBJECTIVE_DELIVERED_SHARE,
+                         self.delivered_share).record(now, good, bad)
+        self._last_rollup = roll
+
+    # -- the drain-point evaluation ------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> Dict:
+        """One tick: fold every declared objective's verdicts into the
+        budgets, re-derive the latched burn/exhaustion sets, emit the
+        edge-triggered events and the bounded gauges. Host-side only."""
+        now = self.clock.now() if now is None else float(now)
+        attribution = getattr(self.obs, "attribution", None) \
+            if self.obs is not None else None
+        self._tick_freshness(now, attribution)
+        self._tick_first_emit(now)
+        self._tick_delivered_share(now, attribution)
+
+        burning: set = set()
+        exhausted: set = set()
+        worst_fast = 0.0
+        rows: Dict[Tuple[str, str], Dict[str, float]] = {}
+        for key, budget in self._budgets.items():
+            row = budget.evaluate(now)
+            rows[key] = row
+            worst_fast = max(worst_fast, row["fast_burn"])
+            if row["fast_burn"] >= self.burn_threshold \
+                    and row["slow_burn"] >= self.burn_threshold:
+                burning.add(key)
+            if row["exhausted"]:
+                exhausted.add(key)
+
+        if self.obs is not None:
+            for tenant, objective in sorted(burning - self._burning):
+                self.obs.counter(SLO_BURN_EVENTS).inc()
+                self.obs.flight_event(
+                    _fl.SLO_BURN, f"{tenant}:{objective}",
+                    rows[(tenant, objective)]["fast_burn"])
+            for tenant, objective in sorted(self._burning - burning):
+                self.obs.flight_event(
+                    _fl.SLO_RECOVER, f"{tenant}:{objective}",
+                    rows.get((tenant, objective),
+                             {}).get("fast_burn", 0.0))
+            for tenant, objective in sorted(exhausted - self._exhausted):
+                self.obs.counter(SLO_BUDGET_EXHAUSTED).inc()
+                self.obs.flight_event(
+                    _fl.SLO_EXHAUSTED, f"{tenant}:{objective}",
+                    rows[(tenant, objective)]["slow_burn"])
+            self.obs.counter(SLO_EVALUATIONS).inc()
+            self.obs.gauge(SLO_BURNING_TENANTS).set(
+                float(len({t for t, _ in burning})))
+            self.obs.gauge(SLO_WORST_FAST_BURN).set(worst_fast)
+        self._burning = burning
+        self._exhausted = exhausted
+        return {"burning": sorted(burning), "exhausted": sorted(exhausted),
+                "worst_fast_burn": worst_fast}
+
+    # -- views ---------------------------------------------------------
+    def _owning_stage(self, tenant: str, objective: str) -> str:
+        stage = self._stages.get((tenant, objective))
+        if stage:
+            return stage
+        tracer = getattr(self.obs, "latency", None) if self.obs else None
+        if tracer is not None and objective != OBJECTIVE_DELIVERED_SHARE:
+            return tracer.owning_stage_recent()
+        return _OBJECTIVE_STAGE.get(objective, "emit")
+
+    def violations(self, now: Optional[float] = None) -> List[Dict]:
+        """Currently latched burn/exhaustion rows, worst fast burn
+        first — each names the tenant, objective, query slot (when the
+        objective is per-query) and owning stage. What ``/healthz`` and
+        the CLI read."""
+        now = self.clock.now() if now is None else float(now)
+        out: List[Dict] = []
+        for key in sorted(self._burning | self._exhausted):
+            tenant, objective = key
+            row = self._budgets[key].evaluate(now)
+            out.append({
+                "tenant": tenant, "objective": objective,
+                "fast_burn": row["fast_burn"],
+                "slow_burn": row["slow_burn"],
+                "exhausted": bool(row["exhausted"]),
+                "query_slot": self._slots.get(key),
+                "owning_stage": self._owning_stage(tenant, objective),
+            })
+        out.sort(key=lambda r: -r["fast_burn"])
+        return out
+
+    def status(self, now: Optional[float] = None) -> Dict:
+        now = self.clock.now() if now is None else float(now)
+        tenants: Dict[str, Dict[str, Dict]] = {}
+        for (tenant, objective), budget in sorted(self._budgets.items()):
+            row = budget.evaluate(now)
+            row["burning"] = (tenant, objective) in self._burning
+            tenants.setdefault(tenant, {})[objective] = row
+        return {
+            "objectives": {
+                OBJECTIVE_FRESHNESS: self.freshness_ms,
+                OBJECTIVE_FIRST_EMIT: self.first_emit_p99_ms,
+                OBJECTIVE_DELIVERED_SHARE: self.delivered_share,
+            },
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "burn_threshold": self.burn_threshold,
+            "sink_delivered": self._sink_delivered,
+            "tenants": tenants,
+            "violations": self.violations(now),
+        }
+
+    def export(self) -> Dict:
+        return self.status()
+
+
+# -- CLI ----------------------------------------------------------------
+def _find_slo(obj) -> Optional[Dict]:
+    """Locate an SLO status section in an export: a ``/vars`` dump
+    (``{"slo": ...}``), a bench result list (cells carrying
+    ``metrics``/``observability`` exports), or the section itself
+    (recognized by its ``violations`` key)."""
+    if isinstance(obj, dict):
+        if "slo" in obj and isinstance(obj["slo"], dict):
+            return obj["slo"]
+        if "violations" in obj and "tenants" in obj:
+            return obj
+        for key in ("metrics", "observability"):
+            if isinstance(obj.get(key), dict):
+                found = _find_slo(obj[key])
+                if found is not None:
+                    return found
+    if isinstance(obj, list):
+        for cell in obj:
+            found = _find_slo(cell)
+            if found is not None:
+                return found
+    return None
+
+
+def slo_main(export_path: str, as_json: bool = False,
+             echo=None) -> int:
+    """``python -m scotty_tpu.obs slo <export.json>``: exit 0 when
+    every declared objective is green, 1 naming each violating
+    tenant / query / objective / owning stage, 2 when the export
+    carries no SLO section at all (nothing attached — an absent plane
+    must not read as green)."""
+    if echo is None:
+        from ..utils import stdout_echo
+
+        echo = stdout_echo
+    with open(export_path) as f:
+        data = json.load(f)
+    slo = _find_slo(data)
+    if slo is None:
+        echo(f"slo: no SLO section in {export_path} "
+             "(no SloPolicy attached?)")
+        return 2
+    violations = slo.get("violations") or []
+    if as_json:
+        echo(json.dumps({"violations": violations}, indent=2,
+                        default=float))
+        return 1 if violations else 0
+    if not violations:
+        echo("slo: all declared objectives green "
+             f"({len(slo.get('tenants', {}))} tenant(s) tracked)")
+        return 0
+    for v in violations:
+        slot = v.get("query_slot")
+        where = f" query_slot={slot}" if slot is not None else ""
+        flag = " BUDGET-EXHAUSTED" if v.get("exhausted") else ""
+        echo(f"slo: VIOLATION tenant={v['tenant']} "
+             f"objective={v['objective']}{where} "
+             f"owning_stage={v.get('owning_stage')} "
+             f"fast_burn={v['fast_burn']:.2f} "
+             f"slow_burn={v['slow_burn']:.2f}{flag}")
+    return 1

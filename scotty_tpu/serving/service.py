@@ -87,34 +87,42 @@ def check_trigger_budget(geometry: SlotGeometry, max_triggers: int) -> None:
 
 
 def emit_tenant_gauges(obs, rollup: dict, gauged: set,
-                       top_k: int) -> set:
-    """Per-tenant active-query gauges with bounded cardinality (ISSUE 13
-    satellite): ``serving_tenant_active_<t>`` used to mint one gauge per
-    tenant name forever — at mesh-service tenant counts that bloats
+                       top_k: int, metric_for=None,
+                       other_name: Optional[str] = None) -> set:
+    """Per-tenant gauges with bounded cardinality (ISSUE 13 satellite):
+    ``serving_tenant_active_<t>`` used to mint one gauge per tenant
+    name forever — at mesh-service tenant counts that bloats
     ``/metrics`` and every ``obs diff`` input. Only the ``top_k``
-    tenants by active count keep named gauges; the remainder folds into
-    one ``serving_tenant_other`` rollup. Ties break by tenant name so
-    the emitted set is deterministic.
+    tenants by count keep named gauges; the remainder folds into one
+    ``serving_tenant_other`` rollup. Ties break by tenant name so the
+    emitted set is deterministic.
 
     ``gauged`` is the caller's set of currently-named tenant metrics;
     tenants that fall out of the top-k (or cancel their last query) are
     zeroed — never left stuck at a stale nonzero value — and the new
     named set is returned. Shared by the single-device and mesh serving
     layers, so the zero-on-last-cancel behavior cannot drift between
-    them."""
+    them — and, since ISSUE 19, by the attribution plane's
+    ``slo_tenant_*`` ledger families via ``metric_for`` (tenant → gauge
+    name; defaults to the active-query naming) and ``other_name`` (the
+    remainder bucket; defaults to ``serving_tenant_other``)."""
     if obs is None:
         return gauged
+    if metric_for is None:
+        metric_for = _tenant_metric
+    if other_name is None:
+        other_name = _obs.SERVING_TENANT_OTHER
     ranked = sorted(rollup.items(), key=lambda kv: (-kv[1], kv[0]))
     named = ranked[:max(0, int(top_k))]
     other = sum(n for _, n in ranked[len(named):])
     for tenant, n in named:
-        obs.gauge(_tenant_metric(tenant)).set(n)
-    obs.gauge(_obs.SERVING_TENANT_OTHER).set(other)
+        obs.gauge(metric_for(tenant)).set(n)
+    obs.gauge(other_name).set(other)
     new_gauged = {t for t, _ in named}
     # a tenant whose last query was cancelled — or that the rollup
     # displaced — must read 0, not its final nonzero value forever
     for tenant in gauged - new_gauged:
-        obs.gauge(_tenant_metric(tenant)).set(0)
+        obs.gauge(metric_for(tenant)).set(0)
     return new_gauged
 
 
@@ -237,6 +245,16 @@ class QueryService:
         if self.obs is not None:
             self.obs.flight_event(kind, name, value)
 
+    def _attr(self, tenant: str, family: str, delta: int = 1) -> None:
+        """Feed the per-tenant attribution ledger (ISSUE 19) when one is
+        attached — the same delta the engine-level counter just took, so
+        the conservation identity (per-tenant sums == engine counters)
+        holds by construction at every call site."""
+        if self.obs is not None:
+            attribution = getattr(self.obs, "attribution", None)
+            if attribution is not None:
+                attribution.count(tenant, family, delta)
+
     def _reconcile_retraces(self) -> None:
         """Fold ACTUAL jit traces into ``serving_retraces``: the counter
         tracks the pipeline's trace counter (minus the initial build),
@@ -294,6 +312,7 @@ class QueryService:
                                       tenant)
         if reason is not None:
             self._count(_obs.SERVING_REJECTED)
+            self._attr(tenant, "rejected")
             self._flight(_flight.QUERY_REJECT, f"{tenant}:{window}")
             if self.admission.reject_callback is not None:
                 self.admission.reject_callback(window, tenant, reason)
@@ -313,7 +332,14 @@ class QueryService:
             want_slots = pad_pow2(self.table.n_slots + 1, self.min_slots)
         if want_lanes != geom.triggers_per_slot \
                 or want_slots != geom.n_slots:
+            # a register that forces a COLD bucket (cache miss → a fresh
+            # compile on the next step) is the retrace this tenant
+            # caused — itemize it on the ledger at the forcing site
+            miss_before = self._counters.get(_obs.SERVING_CACHE_MISSES, 0)
             self._rebucket(want_slots, want_lanes)
+            if self._counters.get(_obs.SERVING_CACHE_MISSES,
+                                  0) > miss_before:
+                self._attr(tenant, "retraces")
         else:
             # a register that stays in the current bucket IS the warm-
             # executable case the cache exists for
@@ -323,6 +349,7 @@ class QueryService:
         handle = self.table.allocate(kind, grid, size, tenant)
         self._dirty.add(handle.slot)
         self._count(_obs.SERVING_REGISTERED)
+        self._attr(tenant, "registered")
         self._flight(_flight.QUERY_REGISTER, f"{tenant}:{window}",
                      float(handle.slot))
         self._gauges()
@@ -334,6 +361,7 @@ class QueryService:
         slot = self.table.release(handle)
         self._dirty.add(slot)
         self._count(_obs.SERVING_CANCELLED)
+        self._attr(handle.tenant, "cancelled")
         self._flight(_flight.QUERY_CANCEL, handle.tenant, float(slot))
         self._gauges()
 
@@ -457,6 +485,28 @@ class QueryService:
                     (int(ws[i]), int(we[i]), int(cnt[i]),
                      [lw[i] for lw in lowered]))
         return out
+
+    def account_emissions(self, rows_by_slot: dict,
+                          watermark: Optional[float] = None) -> None:
+        """Fold one interval's slot-attributed emissions into the
+        attached per-tenant attribution plane (ISSUE 19): windows and
+        late repairs per owning tenant, plus per-query freshness. A
+        no-op without ``obs.attribution``. Host-side only — the rows
+        were already fetched by :meth:`results_by_slot` and the
+        watermark is the host-known interval counter, so this adds
+        zero device syncs and touches no step HLO."""
+        attribution = getattr(self.obs, "attribution", None) \
+            if self.obs is not None else None
+        if attribution is None:
+            return
+        if watermark is None:
+            watermark = float(int(self.pipeline._interval)
+                              * self.wm_period_ms)
+        slot_tenant = {int(s): self.table.tenants[int(s)]
+                       for s in np.flatnonzero(self.table.active)}
+        attribution.account_rows(rows_by_slot, slot_tenant,
+                                 float(watermark),
+                                 float(self.wm_period_ms))
 
     # -- checkpoint / restore (ISSUE 6: restores replay the active set) ----
     def save(self, path: str) -> None:

@@ -1,0 +1,264 @@
+"""Per-tenant attribution differentials (ISSUE 19): the exact
+conservation identity — per-tenant ledger sums equal the engine-level
+serving counters — asserted under single-device churn with quota
+shedding, a mesh reshard, and a supervisor crash/restore; plus the
+top-k gauge folding preserving every family's total and the emission
+(windows/repairs) accounting against independently tallied rows."""
+
+import os
+
+import numpy as np
+import pytest
+
+from scotty_tpu import obs as _obs
+from scotty_tpu.core.aggregates import SumAggregation
+from scotty_tpu.core.windows import (
+    SlidingWindow,
+    TumblingWindow,
+    WindowMeasure,
+)
+from scotty_tpu.engine import EngineConfig
+from scotty_tpu.obs import Observability
+from scotty_tpu.obs.attribution import (
+    ATTRIBUTION_FAMILIES,
+    TenantAttribution,
+    attribution_metric,
+)
+from scotty_tpu.resilience import ManualClock, Supervisor
+from scotty_tpu.serving import QueryAdmission, QueryService
+
+Time = WindowMeasure.Time
+SMALL = EngineConfig(capacity=1 << 12, annex_capacity=8,
+                     min_trigger_pad=32)
+MESH_CFG = EngineConfig(capacity=64, annex_capacity=8, min_trigger_pad=32)
+
+
+def make_service(windows=(), max_queries=64, quota=0, on_reject="fail",
+                 obs=None, seed=7, min_slots=8):
+    return QueryService(
+        [SumAggregation()], slice_grid=100, max_window_size=4000,
+        throughput=10_000, wm_period_ms=1000, max_lateness=1000,
+        seed=seed, config=SMALL,
+        admission=QueryAdmission(max_queries=max_queries,
+                                 per_tenant_quota=quota,
+                                 on_reject=on_reject),
+        windows=list(windows), min_slots=min_slots, obs=obs)
+
+
+# ---------------------------------------------------------------------------
+# the ledger itself
+# ---------------------------------------------------------------------------
+
+
+def test_count_conservation_by_construction_and_unknown_family():
+    att = TenantAttribution(clock=ManualClock())
+    att.count("a", "windows", 3)
+    att.count("b", "windows", 2)
+    att.count("a", "rejected")
+    assert att.totals()["windows"] == 5
+    assert att.rollup()["a"]["windows"] == 3
+    assert att.conservation_ok()
+    att.count("a", "windows", 0)            # zero delta: no tenant churn
+    assert att.totals()["windows"] == 5
+    with pytest.raises(ValueError):
+        att.count("a", "nonsense_family")
+    # apportion_count folds exact largest-remainder shares
+    shares = att.apportion_count("shed", 7, {"a": 3.0, "b": 1.0})
+    assert sum(shares.values()) == 7
+    assert att.totals()["shed"] == 7
+    assert att.conservation_ok()
+
+
+def test_topk_gauge_folding_preserves_family_sum():
+    obs = Observability()
+    att = obs.attach_attribution(
+        clock=ManualClock(), top_k=2, gauge_families=("windows",),
+        gauge_every=1)
+    counts = {"alice": 10, "bob": 7, "carol": 3, "dave": 1}
+    for t, n in counts.items():
+        att.count(t, "windows", n)
+    # one accounted tick emits the gauges (empty rows: ledger unchanged)
+    att.account_rows({}, {}, watermark=0.0, wm_period_ms=1000.0)
+    snap = obs.snapshot()
+    named = {t: snap.get(attribution_metric("windows", t))
+             for t in ("alice", "bob")}
+    assert named == {"alice": 10, "bob": 7}
+    assert snap["slo_tenant_windows_other"] == 3 + 1
+    assert sum(named.values()) + snap["slo_tenant_windows_other"] \
+        == att.totals()["windows"]
+    # the folded tenants never got a named gauge
+    assert attribution_metric("windows", "carol") not in snap
+
+
+# ---------------------------------------------------------------------------
+# single-device churn: ledger == engine counters, exactly
+# ---------------------------------------------------------------------------
+
+
+def test_churn_conservation_vs_engine_counters():
+    obs = Observability()
+    att = obs.attach_attribution(clock=ManualClock())
+    svc = make_service(windows=[SlidingWindow(Time, 4000, 1000)],
+                       max_queries=8, quota=2, on_reject="shed", obs=obs)
+    tally = {t: {f: 0 for f in ATTRIBUTION_FAMILIES}
+             for t in ("default", "alice", "bob")}
+    tally["default"]["registered"] = 1         # the ctor's seed window
+
+    handles = []
+    pool = [TumblingWindow(Time, 500), TumblingWindow(Time, 1000),
+            SlidingWindow(Time, 2000, 500)]
+    rng = np.random.default_rng(3)
+    for i in range(24):
+        tenant = ("alice", "bob")[i % 2]
+        w = pool[int(rng.integers(len(pool)))]
+        h = svc.register(w, tenant=tenant)
+        if h is None:
+            tally[tenant]["rejected"] += 1
+        else:
+            tally[tenant]["registered"] += 1
+            handles.append(h)
+        if len(handles) > 2 and rng.random() < 0.5:
+            victim = handles.pop(int(rng.integers(len(handles))))
+            svc.cancel(victim)
+            tally[victim.tenant]["cancelled"] += 1
+
+    stats = svc.stats()
+    totals = att.totals()
+    for fam, counter in (("registered", "serving_registered"),
+                         ("cancelled", "serving_cancelled"),
+                         ("rejected", "serving_rejected")):
+        assert totals[fam] == stats[counter], fam
+        assert totals[fam] == sum(t[fam] for t in tally.values()), fam
+    roll = att.rollup()
+    for tenant, fams in tally.items():
+        for fam, n in fams.items():
+            if n:
+                assert roll[tenant][fam] == n, (tenant, fam)
+    assert att.conservation_ok()
+
+
+def test_emission_accounting_matches_tallied_rows():
+    obs = Observability()
+    att = obs.attach_attribution(clock=ManualClock())
+    svc = make_service(windows=[TumblingWindow(Time, 1000)], obs=obs,
+                       max_queries=8)
+    h_a = svc.register(TumblingWindow(Time, 500), tenant="acme")
+    h_b = svc.register(SlidingWindow(Time, 2000, 500), tenant="beta")
+    svc.run(3, collect=False)
+    svc.sync()
+    tallied = {"acme": 0, "beta": 0, "default": 0}
+    by_slot = {h_a.slot: "acme", h_b.slot: "beta"}
+    for out in svc.run(4, collect=True):
+        rows = svc.results_by_slot(out)
+        for slot, slot_rows in rows.items():
+            tenant = by_slot.get(slot, "default")
+            tallied[tenant] += len(slot_rows)
+        svc.account_emissions(rows)
+    svc.sync()
+    roll = att.rollup()
+    for tenant, n in tallied.items():
+        assert roll.get(tenant, {}).get("windows", 0) == n, tenant
+    assert att.totals()["windows"] == sum(tallied.values())
+    assert att.conservation_ok()
+    svc.check_overflow()
+
+
+# ---------------------------------------------------------------------------
+# mesh reshard + supervisor crash/restore: the identity survives both
+# ---------------------------------------------------------------------------
+
+_CHURN = {1: [("register", SlidingWindow(Time, 2000, 500), "acme")],
+          3: [("cancel_one", "acme"),
+              ("register", TumblingWindow(Time, 500), "beta")]}
+_RESHARD = {2: 4}
+
+
+def _mesh_env(base_dir, trace_cell):
+    from scotty_tpu.delivery import EXACTLY_ONCE, TransactionalSink
+    from scotty_tpu.mesh_serving import (
+        MeshQueryService,
+        run_supervised_mesh,
+    )
+
+    obs = Observability(flight=_obs.FlightRecorder(capacity=4096))
+    obs.attach_attribution(clock=ManualClock())
+
+    def make_mesh(shards):
+        return MeshQueryService(
+            [SumAggregation()], slice_grid=500, max_window_size=4000,
+            n_keys=16, n_shards=shards, throughput=16_000,
+            wm_period_ms=1000, max_lateness=1000, seed=3, config=MESH_CFG,
+            admission=QueryAdmission(max_queries=8),
+            windows=[TumblingWindow(Time, 1000)], obs=obs,
+            trace_cell=trace_cell)
+
+    def run():
+        sup = Supervisor(os.path.join(base_dir, "ck"),
+                         clock=ManualClock(), obs=obs, max_restarts=8,
+                         seed=11)
+        sink = TransactionalSink(mode=EXACTLY_ONCE, obs=obs)
+        return run_supervised_mesh(
+            make_mesh, 5, sup, sink=sink, churn=_CHURN,
+            reshard_at=_RESHARD, initial_shards=8, checkpoint_every=2,
+            obs=obs)
+
+    return obs, run
+
+
+def _assert_ledger_equals_counters(obs):
+    att = obs.attribution
+    snap = obs.snapshot()
+    totals = att.totals()
+    for fam, counter in (("registered", "serving_registered"),
+                         ("cancelled", "serving_cancelled"),
+                         ("rejected", "serving_rejected")):
+        assert totals[fam] == snap.get(counter, 0), fam
+    assert att.conservation_ok()
+    return att, totals
+
+
+def test_mesh_reshard_conserves_and_itemizes_retraces(tmp_path):
+    cell = [0]
+    obs, run = _mesh_env(str(tmp_path), cell)
+    delivered = run()
+    assert delivered
+    att, totals = _assert_ledger_equals_counters(obs)
+    # the 8→4 reshard's forced retrace is itemized, apportioned over
+    # the tenants active at the reshard
+    assert totals["retraces"] >= 1
+    # emissions were accounted per delivered interval: every delivered
+    # row has an owning tenant in the ledger
+    assert totals["windows"] == sum(
+        len(rows) for (_i, _s, _g, rows) in delivered)
+
+
+def test_crash_restore_replays_ledger_identically(tmp_path):
+    """Arm ONE mid-run crash site (the PR 8 chaos plumbing), recover
+    under the supervisor, and require the delivered output bit-match
+    the uninterrupted oracle AND the attribution identity still hold —
+    the restore replays re-register and re-account through the same
+    call sites, so ledger == counters even across the crash."""
+    from scotty_tpu.resilience.chaos import ArmedFault, CrashPlan
+
+    cell = [0]
+    oracle_box = []
+    obs, run = _mesh_env(os.path.join(str(tmp_path), "oracle"), cell)
+    sites = CrashPlan().record(obs, lambda: oracle_box.extend(run()))
+    _assert_ledger_equals_counters(obs)
+    oracle = list(oracle_box)
+    assert oracle and sites
+
+    emit_sites = [s for s in sites
+                  if s.domain == "flight" and s.kind == "emit"]
+    assert emit_sites
+    site = emit_sites[len(emit_sites) // 2]   # a mid-run emission
+    obs2, run2 = _mesh_env(os.path.join(str(tmp_path), "armed"), cell)
+    armed = ArmedFault(site, obs2)
+    with armed:
+        delivered = run2()
+    assert armed.fired is not None            # the crash actually hit
+    assert list(delivered) == oracle          # exactly-once held
+    _assert_ledger_equals_counters(obs2)
+    att2 = obs2.attribution
+    assert att2.totals()["registered"] \
+        >= obs.attribution.totals()["registered"]  # replays re-account

@@ -1,0 +1,373 @@
+"""Per-tenant SLO plane (ISSUE 19): error-budget burn-rate mechanics on
+a manual clock, edge-triggered burn/recover/exhaustion events, freshness
+objectives naming the stale query slot, the ``/healthz`` SLO check, the
+``obs slo`` CLI exit codes, the ``?prefix=`` endpoint filters, and the
+``obs diff`` unknown-threshold-key rejection.
+
+Everything here runs on :class:`ManualClock` — the plane's clock
+discipline means no test ever sleeps."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from scotty_tpu import obs as _obs
+from scotty_tpu.obs import HealthPolicy, Observability
+from scotty_tpu.obs.attribution import (
+    FreshnessTracker,
+    TenantAttribution,
+    apportion,
+)
+from scotty_tpu.obs.slo import (
+    ENGINE_TENANT,
+    OBJECTIVE_DELIVERED_SHARE,
+    OBJECTIVE_FRESHNESS,
+    ErrorBudget,
+    SloPolicy,
+    slo_main,
+)
+from scotty_tpu.resilience.clock import ManualClock
+
+
+def _get(port, path):
+    try:
+        r = urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                   timeout=5)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# apportion: exact, deterministic
+# ---------------------------------------------------------------------------
+
+
+def test_apportion_exact_sum_and_deterministic_ties():
+    shares = apportion(10, {"a": 1.0, "b": 1.0, "c": 1.0})
+    assert sum(shares.values()) == 10
+    # largest-remainder ties break by sorted tenant name
+    assert shares == apportion(10, {"c": 1.0, "b": 1.0, "a": 1.0})
+    # weights respected exactly when they divide evenly
+    assert apportion(9, {"x": 2.0, "y": 1.0}) == {"x": 6, "y": 3}
+    # no positive weight: everything lands on the min name (never lost)
+    all_zero = apportion(5, {"b": 0.0, "a": 0.0})
+    assert sum(all_zero.values()) == 5 and all_zero.get("a") == 5
+    assert apportion(0, {"a": 1.0}) == {}
+
+
+# ---------------------------------------------------------------------------
+# ErrorBudget: windowed burn, O(1) ledger
+# ---------------------------------------------------------------------------
+
+
+def test_error_budget_burn_and_window_expiry():
+    b = ErrorBudget(0.9, fast_window_s=10.0, slow_window_s=100.0)
+    assert b.budget == pytest.approx(0.1)
+    # 1 bad in 10 ticks = bad_share 0.1 = exactly budget → burn 1.0
+    for t in range(9):
+        b.record(float(t), good=1, bad=0)
+    b.record(9.0, good=0, bad=1)
+    assert b.bad_share(9.0, 10.0) == pytest.approx(0.1)
+    assert b.burn(9.0, 10.0) == pytest.approx(1.0)
+    # the bad tick ages out of the fast window but not the slow one
+    b.record(25.0, good=1, bad=0)
+    assert b.burn(25.0, 10.0) == pytest.approx(0.0)
+    assert b.burn(25.0, 100.0) > 0.0
+    # arbitrary (diagnostic) window falls back to a scan, same answer
+    assert b.bad_share(25.0, 100.0) == pytest.approx(
+        b.bad_share(25.0, 99.5), rel=0.2)
+    ev = b.evaluate(25.0)
+    assert set(ev) == {"fast_burn", "slow_burn", "exhausted"}
+
+
+def test_error_budget_validates_inputs():
+    with pytest.raises(ValueError):
+        ErrorBudget(1.0)
+    with pytest.raises(ValueError):
+        ErrorBudget(0.0)
+    with pytest.raises(ValueError):
+        ErrorBudget(0.9, fast_window_s=60.0, slow_window_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# SloPolicy: edge-triggered latch / recover / exhaustion
+# ---------------------------------------------------------------------------
+
+
+def _burning_policy(clk, obs, ticks=6):
+    """Attach attribution + a delivered_share policy and drive ``ticks``
+    all-bad ticks for tenant ``hot`` (and all-good for ``calm``)."""
+    att = obs.attach_attribution(clock=clk, gauge_every=1)
+    pol = obs.attach_slo(delivered_share=0.9, fast_window_s=5.0,
+                         slow_window_s=10.0, burn_threshold=2.0,
+                         clock=clk)
+    for _ in range(ticks):
+        att.count("hot", "rejected", 3)
+        att.count("calm", "windows", 1)
+        clk.advance(1.0)
+        obs.flight_sync()
+    return att, pol
+
+
+def test_burn_latch_is_edge_triggered_and_recovers():
+    clk = ManualClock()
+    obs = Observability(flight=_obs.FlightRecorder(256))
+    att, pol = _burning_policy(clk, obs)
+    snap = obs.snapshot()
+    # one rising edge for (hot, delivered_share) despite 6 burning ticks
+    assert snap["slo_burn_events"] == 1
+    assert snap["slo_budget_exhausted"] == 1
+    assert snap["slo_burning_tenants"] == 1.0
+    assert snap["slo_worst_fast_burn"] >= 2.0
+    kinds = [e["kind"] for e in obs.flight.events()]
+    assert kinds.count("slo_burn") == 1
+    assert kinds.count("slo_exhausted") == 1
+    v = pol.violations()
+    assert len(v) == 1 and v[0]["tenant"] == "hot"
+    assert v[0]["objective"] == OBJECTIVE_DELIVERED_SHARE
+    assert v[0]["owning_stage"] == "admission"
+    # calm tenant never burned
+    assert all(row["tenant"] != "calm" for row in v)
+
+    # recovery: good ticks + the bad window aging out → slo_recover
+    for _ in range(12):
+        att.count("hot", "windows", 5)
+        clk.advance(1.0)
+        obs.flight_sync()
+    assert pol.violations() == []
+    kinds = [e["kind"] for e in obs.flight.events()]
+    assert kinds.count("slo_recover") == 1
+    # burn event count did NOT re-fire during the burning plateau
+    assert obs.snapshot()["slo_burn_events"] == 1
+
+
+def test_one_objective_burn_threshold_needs_both_windows():
+    """A fast-only spike must not latch: burning requires fast AND slow
+    burn at/over threshold — the SRE multi-window rule."""
+    clk = ManualClock()
+    obs = Observability()
+    att = obs.attach_attribution(clock=clk)
+    pol = obs.attach_slo(delivered_share=0.9, fast_window_s=2.0,
+                         slow_window_s=50.0, burn_threshold=2.0,
+                         clock=clk)
+    # long good history fills the slow window
+    for _ in range(40):
+        att.count("t", "windows", 1)
+        clk.advance(1.0)
+        pol.evaluate()
+    # a 2-tick all-bad spike: fast burn is huge, slow burn still low
+    for _ in range(2):
+        att.count("t", "rejected", 1)
+        clk.advance(1.0)
+        res = pol.evaluate()
+    assert res["burning"] == []
+    assert pol.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# freshness: staleness tracking + the per-query violation row
+# ---------------------------------------------------------------------------
+
+
+def test_freshness_tracker_staleness_and_emission_lag():
+    clk = ManualClock(start=100.0)
+    fr = FreshnessTracker(clock=clk)
+    # slot 3 owned by acme: newest window end 4000 at watermark 5000
+    fr.observe({3: [(3000, 4000, 4, ())]}, {3: "acme"}, watermark=5000.0)
+    snap = fr.snapshot()
+    assert snap[3]["tenant"] == "acme"
+    assert snap[3]["emission_lag_ms"] == pytest.approx(1000.0)
+    assert snap[3]["staleness_ms"] == pytest.approx(0.0)
+    # staleness measures wall progress past the newest window end
+    # (event-time 0 pinned to the first observation): 6.5 s of wall
+    # elapsed minus the 4000 ms-old newest result = 2500 ms stale
+    clk.advance(6.5)
+    stale, slot = fr.worst_by_tenant()["acme"]
+    assert stale == pytest.approx(2500.0) and slot == 3
+    worst_stale, worst_lag = fr.worst()
+    assert worst_stale == pytest.approx(2500.0)
+    assert worst_lag == pytest.approx(1000.0)
+    # slots without a tenant mapping are dropped, not ghosted
+    fr.observe({9: [(0, 1000, 1, ())]}, {3: "acme"}, watermark=5000.0)
+    assert 9 not in fr.snapshot()
+
+
+def test_freshness_violation_names_query_slot():
+    clk = ManualClock()
+    obs = Observability()
+    att = obs.attach_attribution(clock=clk)
+    pol = obs.attach_slo(freshness_ms=1000.0, freshness_target=0.5,
+                         fast_window_s=4.0, slow_window_s=8.0,
+                         burn_threshold=1.0, clock=clk)
+    att.freshness.observe({7: [(0, 1000, 1, ())]}, {7: "acme"},
+                          watermark=1000.0)
+    for _ in range(6):                    # stale grows every tick
+        clk.advance(1.0)
+        pol.evaluate()
+    v = pol.violations()
+    assert v and v[0]["tenant"] == "acme"
+    assert v[0]["objective"] == OBJECTIVE_FRESHNESS
+    assert v[0]["query_slot"] == 7
+
+
+# ---------------------------------------------------------------------------
+# /healthz SLO check + ?prefix= filters
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_goes_red_while_burning_and_recovers():
+    clk = ManualClock()
+    obs = Observability()
+    att, pol = _burning_policy(clk, obs)
+    with obs.serve(port=0, health=HealthPolicy()) as srv:
+        code, text = _get(srv.port, "/healthz")
+        assert code == 503
+        v = json.loads(text)
+        row = v["checks"]["slo"]
+        assert not row["ok"]
+        assert row["tenant"] == "hot"
+        assert row["objective"] == OBJECTIVE_DELIVERED_SHARE
+        # recover, then the same endpoint goes green
+        for _ in range(12):
+            att.count("hot", "windows", 5)
+            clk.advance(1.0)
+            obs.flight_sync()
+        code, _ = _get(srv.port, "/healthz")
+        assert code == 200
+
+
+def test_metrics_and_vars_prefix_filters():
+    clk = ManualClock()
+    obs = Observability()
+    _burning_policy(clk, obs)
+    obs.counter("serving_registered").inc(3)
+    with obs.serve(port=0) as srv:
+        code, text = _get(srv.port, "/metrics?prefix=slo_")
+        assert code == 200
+        lines = [ln for ln in text.splitlines() if ln]
+        assert lines and all("slo_" in ln for ln in lines)
+        code, text = _get(srv.port, "/metrics?prefix=serving_")
+        assert code == 200 and "serving_registered" in text
+        assert "slo_burn_events" not in text
+        # an empty filter result is a VALID 200, not an error
+        code, text = _get(srv.port, "/metrics?prefix=zz_nothing_")
+        assert code == 200
+        assert not [ln for ln in text.splitlines()
+                    if ln and not ln.startswith("#")]
+        code, text = _get(srv.port, "/vars?prefix=slo_")
+        assert code == 200
+        v = json.loads(text)
+        assert all(k.startswith("slo_") for k in v["metrics"])
+        assert v["metrics"]                 # the slo gauges survived
+        code, text = _get(srv.port, "/vars?prefix=zz_nothing_")
+        assert code == 200 and json.loads(text)["metrics"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the CLI verdict: exit 0 / 1 / 2
+# ---------------------------------------------------------------------------
+
+
+def _export_with(pol, obs, path):
+    with open(path, "w") as f:
+        json.dump(obs.export(), f, default=float)
+    return str(path)
+
+
+def test_slo_cli_green_violation_and_absent(tmp_path):
+    clk = ManualClock()
+    obs = Observability()
+    att, pol = _burning_policy(clk, obs)
+    lines = []
+    path = _export_with(pol, obs, tmp_path / "burning.json")
+    assert slo_main(path, echo=lines.append) == 1
+    joined = "\n".join(lines)
+    assert "VIOLATION" in joined and "tenant=hot" in joined
+    assert "objective=delivered_share" in joined
+    assert "owning_stage=admission" in joined
+
+    # json mode carries the violation rows verbatim
+    lines = []
+    assert slo_main(path, as_json=True, echo=lines.append) == 1
+    rows = json.loads("\n".join(lines))["violations"]
+    assert rows[0]["tenant"] == "hot"
+
+    # green export → 0
+    for _ in range(12):
+        att.count("hot", "windows", 5)
+        clk.advance(1.0)
+        obs.flight_sync()
+    lines = []
+    green = _export_with(pol, obs, tmp_path / "green.json")
+    assert slo_main(green, echo=lines.append) == 0
+    assert "green" in lines[0]
+
+    # no SLO section anywhere → 2 (absent plane must not read green)
+    bare = tmp_path / "bare.json"
+    with open(bare, "w") as f:
+        json.dump({"metrics": {"elapsed_s": 1.0}}, f)
+    lines = []
+    assert slo_main(str(bare), echo=lines.append) == 2
+    assert "no SLO section" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# obs diff: unknown threshold keys rejected with near-misses
+# ---------------------------------------------------------------------------
+
+
+def test_diff_thresholds_reject_unknown_keys(tmp_path):
+    from scotty_tpu.obs.diff import (
+        DEFAULT_THRESHOLDS,
+        known_metric_keys,
+        load_thresholds,
+    )
+
+    # the slo gates ship in the defaults
+    for key in ("slo_budget_exhausted", "slo_burn_events",
+                "slo_worst_fast_burn"):
+        assert key in DEFAULT_THRESHOLDS["metrics"]
+
+    # a typo'd key is REJECTED, with a did-you-mean hint
+    bad = tmp_path / "bad.json"
+    with open(bad, "w") as f:
+        json.dump({"metrics": {
+            "slo_burn_eventz": {"direction": "lower", "default": 0}}}, f)
+    with pytest.raises(ValueError) as ei:
+        load_thresholds(str(bad))
+    msg = str(ei.value)
+    assert "slo_burn_eventz" in msg
+    assert "slo_burn_events" in msg          # the near-miss hint
+    assert "silently" in msg
+
+    # known keys of every shape load fine: a cell row key, a dynamic
+    # per-tenant name, and a derived histogram suffix
+    ok = tmp_path / "ok.json"
+    with open(ok, "w") as f:
+        json.dump({"metrics": {
+            "tuples_per_sec": {"direction": "higher", "rel_tol": 0.1},
+            "slo_tenant_windows_acme": {"direction": "higher"},
+            "emit_latency_ms_p99": {"direction": "lower"},
+        }}, f)
+    loaded = load_thresholds(str(ok))
+    assert "tuples_per_sec" in loaded["metrics"]
+    known = known_metric_keys()
+    assert "slo_burn_events" in known
+    assert "tuples_per_sec" in known
+
+
+def test_policy_without_objectives_never_latches():
+    clk = ManualClock()
+    obs = Observability()
+    obs.attach_attribution(clock=clk)
+    pol = obs.attach_slo(clock=clk)       # nothing declared
+    for _ in range(5):
+        clk.advance(1.0)
+        res = pol.evaluate()
+    assert res == {"burning": [], "exhausted": [], "worst_fast_burn": 0.0}
+    assert pol.violations() == []
+    assert pol.export()["tenants"] == {}
+    assert ENGINE_TENANT not in pol.export()["tenants"]
